@@ -1,0 +1,27 @@
+"""grove_tpu: a TPU-native gang-scheduling orchestration framework.
+
+A ground-up rebuild of the capabilities of NVIDIA Grove (reference:
+/root/reference, Go/Kubernetes operator) with one fundamental difference:
+where Grove delegates all placement to the external KAI scheduler, grove_tpu
+implements the gang placement engine itself as a TPU-native service — all
+pending PodGangs are batched into a (gang x clique x node) cost tensor with
+topology pack constraints as penalty masks and solved with vectorized
+Sinkhorn/auction assignment under JAX jit/pjit.
+
+Package layout:
+  api/        CRD-equivalent workload model (PodCliqueSet/PodClique/
+              PodCliqueScalingGroup/ClusterTopology) + scheduler contract
+              (PodGang), defaulting, validation, naming.
+  topology/   Topology tree -> dense level/domain encodings for the solver.
+  solver/     The TPU placement engine (cost tensors, Sinkhorn, repair,
+              feasibility) + the serial baseline scorer.
+  cluster/    In-memory simulated cluster: object store with watches,
+              kwok-style node inventory.
+  controller/ Reconcilers (PCS/PCLQ/PCSG), podgang component, scheduler
+              loop, gang termination, rolling updates.
+  parallel/   Device-mesh sharding for the solver (dp over gangs, tp over
+              nodes) via jax.sharding.
+  ops/        Low-level JAX/Pallas kernels used by the solver.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,69 @@
+"""Workload + scheduler-contract API surface of grove_tpu."""
+
+from . import constants, naming
+from .defaulting import default_podcliqueset
+from .meta import (
+    Condition,
+    NamespacedName,
+    ObjectMeta,
+    OwnerReference,
+    get_condition,
+    new_uid,
+    set_condition,
+)
+from .podgang import (
+    PodGang,
+    PodGangConditionType,
+    PodGangPhase,
+    PodGangSpec,
+    PodGangStatus,
+    PodGroup,
+    TopologyConstraint,
+    TopologyConstraintGroupConfig,
+    TopologyPackConstraint,
+)
+from .types import (
+    CLUSTER_TOPOLOGY_NAME,
+    MAX_TOPOLOGY_LEVELS,
+    TOPOLOGY_DOMAIN_ORDER,
+    AutoScalingConfig,
+    CliqueStartupType,
+    ClusterTopology,
+    ClusterTopologySpec,
+    Container,
+    HeadlessServiceConfig,
+    LastError,
+    LastOperation,
+    Node,
+    PCSGRollingUpdateProgress,
+    PCSRollingUpdateProgress,
+    Pod,
+    PodClique,
+    PodCliqueRollingUpdateProgress,
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupConfig,
+    PodCliqueScalingGroupSpec,
+    PodCliqueScalingGroupStatus,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetStatus,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueStatus,
+    PodCliqueTemplateSpec,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    TopologyConstraintSpec,
+    TopologyLevel,
+    TopologyPackConstraintSpec,
+    sort_topology_levels,
+)
+from .validation import (
+    ValidationError,
+    find_cycles,
+    validate_podcliqueset,
+    validate_podcliqueset_update,
+)
+
+__all__ = [name for name in dir() if not name.startswith("_")]

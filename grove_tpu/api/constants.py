@@ -1,0 +1,75 @@
+"""Labels, annotations, env vars, condition types, defaults.
+
+Parity with /root/reference/operator/api/common/{labels.go,constants/constants.go}.
+Values keep the grove.io/* names so workloads written against the reference
+read identically here.
+"""
+
+# --- Labels (labels.go:20-46) ---
+LABEL_APP_NAME = "app.kubernetes.io/name"
+LABEL_MANAGED_BY = "app.kubernetes.io/managed-by"
+LABEL_PART_OF = "app.kubernetes.io/part-of"
+LABEL_COMPONENT = "app.kubernetes.io/component"
+LABEL_MANAGED_BY_VALUE = "grove-operator"
+
+LABEL_PODCLIQUE = "grove.io/podclique"
+LABEL_PODGANG = "grove.io/podgang"
+LABEL_BASE_PODGANG = "grove.io/base-podgang"
+LABEL_PCS_REPLICA_INDEX = "grove.io/podcliqueset-replica-index"
+LABEL_PCSG = "grove.io/podcliquescalinggroup"
+LABEL_PCSG_REPLICA_INDEX = "grove.io/podcliquescalinggroup-replica-index"
+LABEL_POD_TEMPLATE_HASH = "grove.io/pod-template-hash"
+LABEL_POD_INDEX = "grove.io/pod-index"
+
+# Component values for LABEL_COMPONENT.
+COMPONENT_HEADLESS_SERVICE = "pcs-headless-service"
+COMPONENT_PCSG = "pcs-podcliquescalinggroup"
+COMPONENT_HPA = "pcs-hpa"
+COMPONENT_PODGANG = "podgang"
+COMPONENT_PCS_PODCLIQUE = "pcs-podclique"
+COMPONENT_PCSG_PODCLIQUE = "pcsg-podclique"
+
+# --- Annotations (constants.go:42-48) ---
+ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION = (
+    "grove.io/disable-managed-resource-protection"
+)
+ANNOTATION_TOPOLOGY_NAME = "grove.io/topology-name"
+
+# --- Scheduling gate (components/pod/pod.go:68) ---
+PODGANG_PENDING_CREATION_GATE = "grove.io/podgang-pending-creation"
+
+# --- Env vars injected into workload pods (constants.go:50-68) ---
+ENV_PCS_NAME = "GROVE_PCS_NAME"
+ENV_PCS_INDEX = "GROVE_PCS_INDEX"
+ENV_PCLQ_NAME = "GROVE_PCLQ_NAME"
+ENV_HEADLESS_SERVICE = "GROVE_HEADLESS_SERVICE"
+ENV_PCLQ_POD_INDEX = "GROVE_PCLQ_POD_INDEX"
+ENV_PCSG_NAME = "GROVE_PCSG_NAME"
+ENV_PCSG_INDEX = "GROVE_PCSG_INDEX"
+ENV_PCSG_TEMPLATE_NUM_PODS = "GROVE_PCSG_TEMPLATE_NUM_PODS"
+
+# --- Condition types (constants.go:86-95) ---
+CONDITION_MIN_AVAILABLE_BREACHED = "MinAvailableBreached"
+CONDITION_PODCLIQUE_SCHEDULED = "PodCliqueScheduled"
+CONDITION_TOPOLOGY_LEVELS_UNAVAILABLE = "TopologyLevelsUnavailable"
+
+# --- Condition reasons ---
+REASON_INSUFFICIENT_READY_PODS = "InsufficientReadyPods"
+REASON_SUFFICIENT_READY_PODS = "SufficientReadyPods"
+REASON_INSUFFICIENT_SCHEDULED_PODS = "InsufficientScheduledPods"
+REASON_SUFFICIENT_SCHEDULED_PODS = "SufficientScheduledPods"
+
+# --- Finalizers ---
+FINALIZER_PCS = "grove.io/podcliqueset-protection"
+FINALIZER_PCLQ = "grove.io/podclique-protection"
+FINALIZER_PCSG = "grove.io/podcliquescalinggroup-protection"
+
+# --- Defaults (webhook/admission/pcs/defaulting/podcliqueset.go:30-117) ---
+DEFAULT_TERMINATION_DELAY_SECONDS = 4 * 60 * 60  # 4h
+DEFAULT_REPLICAS = 1
+
+# --- Reconcile tuning (internal/constants/constants.go:31) ---
+COMPONENT_SYNC_RETRY_INTERVAL_SECONDS = 5.0
+
+# --- Validation budgets (validation/podcliqueset.go:37) ---
+MAX_COMBINED_NAME_LENGTH = 45

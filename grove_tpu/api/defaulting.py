@@ -1,0 +1,55 @@
+"""Defaulting for PodCliqueSet (admission-webhook parity).
+
+Mirror of /root/reference/operator/internal/webhook/admission/pcs/defaulting/
+podcliqueset.go:30-117: replicas->1, MinAvailable->Replicas,
+TerminationDelay->4h, headless publishNotReadyAddresses->true, PCSG
+replicas/minAvailable->1, HPA minReplicas->1, startupType->AnyOrder.
+"""
+
+from __future__ import annotations
+
+from . import constants
+from .types import (
+    CliqueStartupType,
+    HeadlessServiceConfig,
+    PodCliqueSet,
+)
+
+
+def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
+    """Apply defaults in place and return pcs."""
+    if pcs.metadata.namespace == "":
+        pcs.metadata.namespace = "default"
+    if pcs.spec.replicas is None or pcs.spec.replicas == 0:
+        pcs.spec.replicas = constants.DEFAULT_REPLICAS
+
+    tmpl = pcs.spec.template
+    if tmpl.startup_type is None:
+        tmpl.startup_type = CliqueStartupType.ANY_ORDER
+    if tmpl.termination_delay is None:
+        tmpl.termination_delay = float(constants.DEFAULT_TERMINATION_DELAY_SECONDS)
+    if tmpl.head_less_service_config is None:
+        tmpl.head_less_service_config = HeadlessServiceConfig(
+            publish_not_ready_addresses=True
+        )
+
+    for clique in tmpl.cliques:
+        cspec = clique.spec
+        if cspec.role_name == "":
+            cspec.role_name = clique.name
+        if cspec.replicas is None or cspec.replicas == 0:
+            cspec.replicas = 1
+        if cspec.min_available is None:
+            cspec.min_available = cspec.replicas
+        if cspec.scale_config is not None and cspec.scale_config.min_replicas < 1:
+            cspec.scale_config.min_replicas = 1
+
+    for sg in tmpl.pod_clique_scaling_group_configs:
+        if sg.replicas is None:
+            sg.replicas = 1
+        if sg.min_available is None:
+            sg.min_available = 1
+        if sg.scale_config is not None and sg.scale_config.min_replicas < 1:
+            sg.scale_config.min_replicas = 1
+
+    return pcs

@@ -1,0 +1,221 @@
+"""API layer tests: naming, defaulting, validation (webhook parity).
+
+Scenario model: /root/reference/operator/internal/webhook/admission/pcs/
+{defaulting,validation}/*_test.go (table-driven).
+"""
+
+import pytest
+
+from grove_tpu import api
+from grove_tpu.api import naming
+
+
+def make_pcs(name="simple1", cliques=None, sgs=None, startup=None, replicas=1):
+    cliques = cliques if cliques is not None else [
+        api.PodCliqueTemplateSpec(
+            name="frontend",
+            spec=api.PodCliqueSpec(
+                replicas=2,
+                pod_spec=api.PodSpec(
+                    containers=[api.Container(name="c", resources={"cpu": 1})]
+                ),
+            ),
+        )
+    ]
+    pcs = api.PodCliqueSet(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.PodCliqueSetSpec(
+            replicas=replicas,
+            template=api.PodCliqueSetTemplateSpec(
+                cliques=cliques,
+                pod_clique_scaling_group_configs=sgs or [],
+                startup_type=startup,
+            ),
+        ),
+    )
+    return pcs
+
+
+class TestNaming:
+    def test_grammar(self):
+        assert naming.podclique_name("pcs", 0, "decode") == "pcs-0-decode"
+        assert naming.pcsg_name("pcs", 1, "sga") == "pcs-1-sga"
+        assert naming.base_podgang_name("pcs", 2) == "pcs-2"
+        assert naming.scaled_podgang_name("pcs-0-sga", 0) == "pcs-0-sga-0"
+        assert naming.pod_name("pcs-0-decode", 3) == "pcs-0-decode-3"
+
+    def test_pcsg_replica_gang_routing(self):
+        # Replicas below minAvailable belong to the base gang; beyond get
+        # 0-based scaled gangs (namegen.go:100-115).
+        assert (
+            naming.podgang_name_for_pcsg_replica("pcs", 0, "pcs-0-sga", 1, 2)
+            == "pcs-0"
+        )
+        assert (
+            naming.podgang_name_for_pcsg_replica("pcs", 0, "pcs-0-sga", 2, 2)
+            == "pcs-0-sga-0"
+        )
+        assert (
+            naming.podgang_name_for_pcsg_replica("pcs", 0, "pcs-0-sga", 4, 2)
+            == "pcs-0-sga-2"
+        )
+
+
+class TestDefaulting:
+    def test_defaults_applied(self):
+        pcs = make_pcs()
+        pcs.spec.template.cliques[0].spec.min_available = None
+        api.default_podcliqueset(pcs)
+        tmpl = pcs.spec.template
+        assert tmpl.startup_type == api.CliqueStartupType.ANY_ORDER
+        assert tmpl.termination_delay == 4 * 3600
+        assert tmpl.head_less_service_config.publish_not_ready_addresses
+        assert tmpl.cliques[0].spec.min_available == 2  # defaults to replicas
+
+    def test_pcsg_defaults(self):
+        sgs = [api.PodCliqueScalingGroupConfig(name="sga", clique_names=["frontend"])]
+        pcs = make_pcs(sgs=sgs)
+        api.default_podcliqueset(pcs)
+        sg = pcs.spec.template.pod_clique_scaling_group_configs[0]
+        assert sg.replicas == 1 and sg.min_available == 1
+
+
+class TestValidation:
+    def _validate(self, pcs):
+        api.default_podcliqueset(pcs)
+        api.validate_podcliqueset(pcs)
+
+    def test_valid_passes(self):
+        self._validate(make_pcs())
+
+    def test_bad_name(self):
+        with pytest.raises(api.ValidationError, match="DNS-1123"):
+            self._validate(make_pcs(name="Bad_Name"))
+
+    def test_duplicate_clique_names(self):
+        cl = [
+            api.PodCliqueTemplateSpec(name="a", spec=api.PodCliqueSpec()),
+            api.PodCliqueTemplateSpec(name="a", spec=api.PodCliqueSpec(role_name="b")),
+        ]
+        with pytest.raises(api.ValidationError, match="duplicate clique name"):
+            self._validate(make_pcs(cliques=cl))
+
+    def test_min_available_bounds(self):
+        pcs = make_pcs()
+        pcs.spec.template.cliques[0].spec.min_available = 5  # > replicas=2
+        with pytest.raises(api.ValidationError, match="minAvailable"):
+            self._validate(pcs)
+
+    def test_starts_after_requires_explicit(self):
+        cl = [
+            api.PodCliqueTemplateSpec(name="a", spec=api.PodCliqueSpec()),
+            api.PodCliqueTemplateSpec(
+                name="b", spec=api.PodCliqueSpec(role_name="rb", starts_after=["a"])
+            ),
+        ]
+        with pytest.raises(api.ValidationError, match="Explicit"):
+            self._validate(make_pcs(cliques=cl))
+
+    def test_starts_after_unknown_target(self):
+        cl = [
+            api.PodCliqueTemplateSpec(
+                name="a", spec=api.PodCliqueSpec(starts_after=["ghost"])
+            )
+        ]
+        with pytest.raises(api.ValidationError, match="unknown clique"):
+            self._validate(make_pcs(cliques=cl, startup=api.CliqueStartupType.EXPLICIT))
+
+    def test_cycle_detection(self):
+        # a -> b -> c -> a (validation/podcliqueset.go:278-300 SCC parity).
+        cl = [
+            api.PodCliqueTemplateSpec(
+                name="a", spec=api.PodCliqueSpec(starts_after=["c"])
+            ),
+            api.PodCliqueTemplateSpec(
+                name="b", spec=api.PodCliqueSpec(role_name="rb", starts_after=["a"])
+            ),
+            api.PodCliqueTemplateSpec(
+                name="c", spec=api.PodCliqueSpec(role_name="rc", starts_after=["b"])
+            ),
+        ]
+        with pytest.raises(api.ValidationError, match="cycle"):
+            self._validate(make_pcs(cliques=cl, startup=api.CliqueStartupType.EXPLICIT))
+
+    def test_diamond_dag_ok(self):
+        cl = [
+            api.PodCliqueTemplateSpec(name="a", spec=api.PodCliqueSpec()),
+            api.PodCliqueTemplateSpec(
+                name="b", spec=api.PodCliqueSpec(role_name="rb", starts_after=["a"])
+            ),
+            api.PodCliqueTemplateSpec(
+                name="c", spec=api.PodCliqueSpec(role_name="rc", starts_after=["a"])
+            ),
+            api.PodCliqueTemplateSpec(
+                name="d",
+                spec=api.PodCliqueSpec(role_name="rd", starts_after=["b", "c"]),
+            ),
+        ]
+        self._validate(make_pcs(cliques=cl, startup=api.CliqueStartupType.EXPLICIT))
+
+    def test_pcsg_unknown_clique(self):
+        sgs = [api.PodCliqueScalingGroupConfig(name="sga", clique_names=["ghost"])]
+        with pytest.raises(api.ValidationError, match="unknown clique"):
+            self._validate(make_pcs(sgs=sgs))
+
+    def test_pcsg_no_overlap(self):
+        cl = [
+            api.PodCliqueTemplateSpec(name="a", spec=api.PodCliqueSpec()),
+            api.PodCliqueTemplateSpec(name="b", spec=api.PodCliqueSpec(role_name="rb")),
+        ]
+        sgs = [
+            api.PodCliqueScalingGroupConfig(name="sg1", clique_names=["a"]),
+            api.PodCliqueScalingGroupConfig(name="sg2", clique_names=["a", "b"]),
+        ]
+        with pytest.raises(api.ValidationError, match="already claimed"):
+            self._validate(make_pcs(cliques=cl, sgs=sgs))
+
+    def test_no_clique_hpa_inside_pcsg(self):
+        cl = [
+            api.PodCliqueTemplateSpec(
+                name="a",
+                spec=api.PodCliqueSpec(
+                    scale_config=api.AutoScalingConfig(min_replicas=1, max_replicas=3)
+                ),
+            )
+        ]
+        sgs = [api.PodCliqueScalingGroupConfig(name="sga", clique_names=["a"])]
+        with pytest.raises(api.ValidationError, match="scale only via the group"):
+            self._validate(make_pcs(cliques=cl, sgs=sgs))
+
+    def test_topology_strictness(self):
+        # PCS requires rack-level pack; clique must not be broader (zone).
+        pcs = make_pcs()
+        pcs.spec.template.topology_constraint = api.TopologyConstraintSpec(
+            pack_constraint=api.TopologyPackConstraintSpec(required="rack")
+        )
+        pcs.spec.template.cliques[0].spec.topology_constraint = (
+            api.TopologyConstraintSpec(
+                pack_constraint=api.TopologyPackConstraintSpec(required="zone")
+            )
+        )
+        with pytest.raises(api.ValidationError, match="narrow"):
+            self._validate(pcs)
+
+    def test_update_immutability(self):
+        old = make_pcs()
+        new = make_pcs()
+        new.spec.template.cliques = [
+            api.PodCliqueTemplateSpec(name="other", spec=api.PodCliqueSpec())
+        ]
+        with pytest.raises(api.ValidationError, match="immutable"):
+            api.validate_podcliqueset_update(old, new)
+
+
+class TestConditions:
+    def test_set_condition_flip_detection(self):
+        conds = []
+        assert api.set_condition(conds, "MinAvailableBreached", "True", now=1.0)
+        assert not api.set_condition(conds, "MinAvailableBreached", "True", now=2.0)
+        assert conds[0].last_transition_time == 1.0
+        assert api.set_condition(conds, "MinAvailableBreached", "False", now=3.0)
+        assert conds[0].last_transition_time == 3.0
