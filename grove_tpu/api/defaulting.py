@@ -3,7 +3,9 @@
 Mirror of /root/reference/operator/internal/webhook/admission/pcs/defaulting/
 podcliqueset.go:30-117: replicas->1, MinAvailable->Replicas,
 TerminationDelay->4h, headless publishNotReadyAddresses->true, PCSG
-replicas/minAvailable->1, HPA minReplicas->1, startupType->AnyOrder.
+replicas/minAvailable->1, startupType->AnyOrder. Unlike the reference's HPA
+minReplicas coercion, an invalid scaleConfig.minReplicas < 1 is left for
+validation to reject (defaulting only fills unset fields).
 """
 
 from __future__ import annotations
@@ -41,15 +43,11 @@ def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
             cspec.replicas = 1
         if cspec.min_available is None:
             cspec.min_available = cspec.replicas
-        if cspec.scale_config is not None and cspec.scale_config.min_replicas < 1:
-            cspec.scale_config.min_replicas = 1
 
     for sg in tmpl.pod_clique_scaling_group_configs:
         if sg.replicas is None:
             sg.replicas = 1
         if sg.min_available is None:
             sg.min_available = 1
-        if sg.scale_config is not None and sg.scale_config.min_replicas < 1:
-            sg.scale_config.min_replicas = 1
 
     return pcs

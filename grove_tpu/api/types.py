@@ -394,8 +394,17 @@ class ClusterTopology:
 
 
 def sort_topology_levels(levels: list[TopologyLevel]) -> list[TopologyLevel]:
-    """Order levels broadest -> narrowest (clustertopology.go:134)."""
-    return sorted(levels, key=lambda lv: TOPOLOGY_DOMAIN_ORDER.get(lv.domain, 99))
+    """Order levels broadest -> narrowest (clustertopology.go:134).
+
+    Raises ValueError on a domain outside the fixed seven-domain hierarchy
+    (the reference enforces this via a CRD enum)."""
+    unknown = [lv.domain for lv in levels if lv.domain not in TOPOLOGY_DOMAIN_ORDER]
+    if unknown:
+        raise ValueError(
+            f"unknown topology domain(s) {unknown}; "
+            f"supported: {sorted(TOPOLOGY_DOMAIN_ORDER)}"
+        )
+    return sorted(levels, key=lambda lv: TOPOLOGY_DOMAIN_ORDER[lv.domain])
 
 
 # --------------------------------------------------------------------------

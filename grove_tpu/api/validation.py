@@ -136,6 +136,11 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
     seen_names: set[str] = set()
     seen_roles: set[str] = set()
     scheduler_names: set[str] = set()
+    pcsg_member_cliques = {
+        cn
+        for sg in tmpl.pod_clique_scaling_group_configs
+        for cn in sg.clique_names
+    }
     for i, clique in enumerate(tmpl.cliques):
         path = f"spec.template.cliques[{i}]"
         if not _is_dns_label(clique.name):
@@ -147,12 +152,19 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         if role in seen_roles:
             errs.append(f"{path}.spec.roleName: duplicate role {role!r}")
         seen_roles.add(role)
-        combined = len(pcs.metadata.name) + len(str(pcs.spec.replicas)) + len(clique.name) + 2
-        if combined > constants.MAX_COMBINED_NAME_LENGTH:
-            errs.append(
-                f"{path}: combined name '<pcs>-<replica>-{clique.name}' exceeds "
-                f"{constants.MAX_COMBINED_NAME_LENGTH} chars"
+        # Bare component-length budget, matching the reference's formula
+        # (validation/podcliqueset.go:548-562). PCSG-member cliques are
+        # budgeted against '<pcs><sg><clique>' in the scaling-group loop
+        # instead, never against the standalone form.
+        if clique.name not in pcsg_member_cliques:
+            combined = (
+                len(pcs.metadata.name) + len(str(pcs.spec.replicas)) + len(clique.name)
             )
+            if combined > constants.MAX_COMBINED_NAME_LENGTH:
+                errs.append(
+                    f"{path}: combined name '<pcs>-<replica>-{clique.name}' exceeds "
+                    f"{constants.MAX_COMBINED_NAME_LENGTH} chars"
+                )
         if clique.spec.replicas < 1:
             errs.append(f"{path}.spec.replicas must be >= 1")
         ma = clique.spec.min_available
@@ -186,10 +198,9 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         )
     for cname, deps in edges.items():
         for d in deps:
-            if d == cname:
-                errs.append(f"clique {cname!r} cannot start after itself")
-            elif d not in edges:
+            if d != cname and d not in edges:
                 errs.append(f"clique {cname!r} startsAfter unknown clique {d!r}")
+    # Self-loops surface as single-element cycles here.
     for cycle in find_cycles(edges):
         errs.append(f"startsAfter cycle detected among cliques {cycle}")
 
@@ -209,6 +220,7 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
                 )
     claimed: dict[str, str] = {}
     sg_names: set[str] = set()
+    by_name = {c.name: c for c in tmpl.cliques}
     for j, sg in enumerate(tmpl.pod_clique_scaling_group_configs):
         path = f"spec.template.podCliqueScalingGroupConfigs[{j}]"
         if not _is_dns_label(sg.name):
@@ -235,11 +247,23 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
             and not (1 <= sg.min_available <= sg.replicas)
         ):
             errs.append(f"{path}.minAvailable must be in [1, replicas]")
-        if sg.scale_config is not None and sg.replicas is not None:
-            if not (sg.scale_config.min_replicas <= sg.replicas <= sg.scale_config.max_replicas):
+        if sg.scale_config is not None:
+            if sg.scale_config.min_replicas < 1:
+                errs.append(f"{path}.scaleConfig.minReplicas must be >= 1")
+            if sg.replicas is not None and not (
+                sg.scale_config.min_replicas <= sg.replicas <= sg.scale_config.max_replicas
+            ):
                 errs.append(f"{path}: replicas must be within scaleConfig bounds")
+        # PCSG pod names are '<pcs>-<i>-<sg>-<j>-<clique>-<k>'; the reference
+        # budgets the three name components (validation/podcliqueset.go:548-562).
+        for cn in sg.clique_names:
+            combined = len(pcs.metadata.name) + len(sg.name) + len(cn)
+            if combined > constants.MAX_COMBINED_NAME_LENGTH:
+                errs.append(
+                    f"{path}: combined name '<pcs>-<i>-{sg.name}-<j>-{cn}' exceeds "
+                    f"{constants.MAX_COMBINED_NAME_LENGTH} chars"
+                )
         # No per-clique HPA inside a PCSG (the PCSG is the scale unit).
-        by_name = {c.name: c for c in tmpl.cliques}
         for cn in sg.clique_names:
             c = by_name.get(cn)
             if c is not None and c.spec.scale_config is not None:
@@ -275,16 +299,40 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
 
 
 def validate_podcliqueset_update(old: PodCliqueSet, new: PodCliqueSet) -> None:
-    """Immutable-field checks on update (validation update path)."""
+    """Immutable-field checks on update (validation/podcliqueset.go:520-562).
+
+    Per clique: roleName, minAvailable and startsAfter are immutable. The
+    clique name *set* is always immutable; clique *order* is additionally
+    frozen only when startup order matters (InOrder/Explicit)."""
     errs: list[str] = []
-    old_cliques = [c.name for c in old.spec.template.cliques]
-    new_cliques = [c.name for c in new.spec.template.cliques]
-    if old_cliques != new_cliques:
-        errs.append("spec.template.cliques: clique names/order are immutable")
-    if new.spec.template.startup_type != old.spec.template.startup_type:
+    old_tmpl, new_tmpl = old.spec.template, new.spec.template
+    old_names = [c.name for c in old_tmpl.cliques]
+    new_names = [c.name for c in new_tmpl.cliques]
+    if sorted(old_names) != sorted(new_names):
+        errs.append("spec.template.cliques: clique names are immutable")
+    elif (
+        old_tmpl.startup_type != CliqueStartupType.ANY_ORDER
+        and old_names != new_names
+    ):
+        errs.append(
+            "spec.template.cliques: clique order is immutable when startupType "
+            "is InOrder/Explicit"
+        )
+    else:
+        old_by_name = {c.name: c for c in old_tmpl.cliques}
+        for i, c in enumerate(new_tmpl.cliques):
+            o = old_by_name[c.name]
+            path = f"spec.template.cliques[{i}].spec"
+            if c.spec.role_name != o.spec.role_name:
+                errs.append(f"{path}.roleName is immutable")
+            if c.spec.min_available != o.spec.min_available:
+                errs.append(f"{path}.minAvailable is immutable")
+            if list(c.spec.starts_after) != list(o.spec.starts_after):
+                errs.append(f"{path}.startsAfter is immutable")
+    if new_tmpl.startup_type != old_tmpl.startup_type:
         errs.append("spec.template.startupType is immutable")
-    old_sgs = [(s.name, tuple(s.clique_names)) for s in old.spec.template.pod_clique_scaling_group_configs]
-    new_sgs = [(s.name, tuple(s.clique_names)) for s in new.spec.template.pod_clique_scaling_group_configs]
+    old_sgs = [(s.name, tuple(s.clique_names)) for s in old_tmpl.pod_clique_scaling_group_configs]
+    new_sgs = [(s.name, tuple(s.clique_names)) for s in new_tmpl.pod_clique_scaling_group_configs]
     if old_sgs != new_sgs:
         errs.append("spec.template.podCliqueScalingGroupConfigs names/members are immutable")
     if errs:

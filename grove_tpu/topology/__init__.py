@@ -1,0 +1,15 @@
+"""Topology encoding: ClusterTopology + node inventory -> dense solver inputs."""
+
+from .encoding import (
+    TopologySnapshot,
+    default_cluster_topology,
+    encode_topology,
+    HOST_LABEL_KEY,
+)
+
+__all__ = [
+    "TopologySnapshot",
+    "default_cluster_topology",
+    "encode_topology",
+    "HOST_LABEL_KEY",
+]

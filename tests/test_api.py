@@ -219,3 +219,76 @@ class TestConditions:
         assert conds[0].last_transition_time == 1.0
         assert api.set_condition(conds, "MinAvailableBreached", "False", now=3.0)
         assert conds[0].last_transition_time == 3.0
+
+
+def clique(name, replicas=2, min_available=None, starts_after=()):
+    return api.PodCliqueTemplateSpec(
+        name=name,
+        spec=api.PodCliqueSpec(
+            replicas=replicas,
+            min_available=min_available,
+            starts_after=list(starts_after),
+            pod_spec=api.PodSpec(
+                containers=[api.Container(name="c", resources={"cpu": 1})]
+            ),
+        ),
+    )
+
+
+def admit(pcs):
+    api.default_podcliqueset(pcs)
+    api.validate_podcliqueset(pcs)
+    return pcs
+
+
+class TestReviewFixes:
+    """Behaviors pinned after the round-1 code review."""
+
+    def test_pcsg_name_budget_includes_group_name(self):
+        sgs = [api.PodCliqueScalingGroupConfig(
+            name="prefill-workers-group", clique_names=["decode"])]
+        pcs = make_pcs(name="inference-serving-clu",
+                       cliques=[clique("decode")], sgs=sgs)
+        with pytest.raises(api.ValidationError, match="exceeds"):
+            admit(pcs)
+
+    def test_unknown_topology_domain_sort_raises(self):
+        with pytest.raises(ValueError, match="unknown topology domain"):
+            api.sort_topology_levels(
+                [api.TopologyLevel(domain="cube", key="topo/cube")])
+
+    def test_invalid_scale_config_min_replicas_rejected_not_coerced(self):
+        pcs = make_pcs(cliques=[clique("a")])
+        pcs.spec.template.cliques[0].spec.scale_config = api.AutoScalingConfig(
+            min_replicas=0, max_replicas=4)
+        with pytest.raises(api.ValidationError, match="minReplicas must be >= 1"):
+            admit(pcs)
+
+    def test_self_loop_reported_once(self):
+        pcs = make_pcs(cliques=[clique("a", starts_after=["a"])],
+                       startup=api.CliqueStartupType.EXPLICIT)
+        with pytest.raises(api.ValidationError) as ei:
+            admit(pcs)
+        assert len(ei.value.errors) == 1
+        assert "cycle" in ei.value.errors[0]
+
+    def test_update_minavailable_immutable_but_reorder_ok_anyorder(self):
+        from grove_tpu.api.validation import validate_podcliqueset_update
+
+        old = admit(make_pcs(cliques=[clique("a"), clique("b")]))
+        new = admit(make_pcs(cliques=[clique("b"), clique("a")]))
+        validate_podcliqueset_update(old, new)  # reorder OK under AnyOrder
+
+        new2 = admit(make_pcs(cliques=[clique("a", min_available=1), clique("b")]))
+        with pytest.raises(api.ValidationError, match="minAvailable is immutable"):
+            validate_podcliqueset_update(old, new2)
+
+    def test_update_reorder_rejected_when_explicit(self):
+        from grove_tpu.api.validation import validate_podcliqueset_update
+
+        old = admit(make_pcs(cliques=[clique("a"), clique("b")],
+                             startup=api.CliqueStartupType.EXPLICIT))
+        new = admit(make_pcs(cliques=[clique("b"), clique("a")],
+                             startup=api.CliqueStartupType.EXPLICIT))
+        with pytest.raises(api.ValidationError, match="order is immutable"):
+            validate_podcliqueset_update(old, new)

@@ -1,0 +1,151 @@
+"""Tests for topology encoding (grove_tpu.topology)."""
+
+import numpy as np
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Node, TopologyLevel
+from grove_tpu.topology import (
+    HOST_LABEL_KEY,
+    default_cluster_topology,
+    encode_topology,
+)
+
+
+def make_node(name, labels, cpu=8.0, mem=32e9, tpu=4.0, unschedulable=False):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        allocatable={"cpu": cpu, "memory": mem, "tpu": tpu},
+        unschedulable=unschedulable,
+    )
+
+
+def two_rack_nodes():
+    nodes = []
+    for b in range(2):
+        for r in range(2):
+            for h in range(2):
+                # rack label value repeats across blocks on purpose: the
+                # path-prefix encoding must still keep them distinct domains.
+                nodes.append(
+                    make_node(
+                        f"n-{b}-{r}-{h}",
+                        {"topo/block": f"block-{b}", "topo/rack": f"rack-{r}"},
+                    )
+                )
+    return nodes
+
+
+def topo():
+    return default_cluster_topology(
+        [
+            TopologyLevel(domain="block", key="topo/block"),
+            TopologyLevel(domain="rack", key="topo/rack"),
+        ]
+    )
+
+
+class TestDefaultClusterTopology:
+    def test_host_level_auto_added_and_sorted(self):
+        ct = default_cluster_topology(
+            [
+                TopologyLevel(domain="rack", key="topo/rack"),
+                TopologyLevel(domain="block", key="topo/block"),
+            ]
+        )
+        assert [lv.domain for lv in ct.spec.levels] == ["block", "rack", "host"]
+        assert ct.spec.levels[-1].key == HOST_LABEL_KEY
+        assert ct.metadata.name == "grove-topology"
+
+    def test_host_not_duplicated(self):
+        ct = default_cluster_topology(
+            [TopologyLevel(domain="host", key="custom/host")]
+        )
+        assert [lv.domain for lv in ct.spec.levels] == ["host"]
+
+
+class TestEncodeTopology:
+    def test_shapes_and_hierarchical_ids(self):
+        snap = encode_topology(topo(), two_rack_nodes())
+        assert snap.num_levels == 3  # block, rack, host
+        assert snap.num_nodes == 8
+        # 2 blocks, 4 racks (2 per block despite repeated label), 8 hosts
+        assert list(snap.num_domains) == [2, 4, 8]
+        # rack ids differ across blocks even though the label value repeats
+        rack_ids = snap.domain_ids[1]
+        assert rack_ids[0] == rack_ids[1]          # same block, same rack
+        assert rack_ids[0] != rack_ids[2]          # same block, other rack
+        assert rack_ids[0] != rack_ids[4]          # other block, same label
+
+    def test_membership_matrix(self):
+        snap = encode_topology(topo(), two_rack_nodes())
+        m = snap.membership(1)  # racks
+        assert m.shape == (8, 4)
+        np.testing.assert_allclose(m.sum(axis=1), np.ones(8))
+        np.testing.assert_allclose(m.sum(axis=0), np.full(4, 2.0))
+
+    def test_capacity_free_usage(self):
+        nodes = two_rack_nodes()
+        snap = encode_topology(
+            topo(), nodes, usage={"n-0-0-0": {"cpu": 3.0, "tpu": 2.0}}
+        )
+        ci = snap.resource_names.index("cpu")
+        ti = snap.resource_names.index("tpu")
+        ni = snap.node_index["n-0-0-0"]
+        assert snap.capacity[ni, ci] == 8.0
+        assert snap.free[ni, ci] == 5.0
+        assert snap.free[ni, ti] == 2.0
+        other = snap.node_index["n-1-1-1"]
+        assert snap.free[other, ci] == 8.0
+
+    def test_unschedulable_and_missing_labels(self):
+        nodes = two_rack_nodes()
+        nodes[3].unschedulable = True
+        nodes.append(make_node("n-orphan", {}))  # no topology labels at all
+        snap = encode_topology(topo(), nodes)
+        assert not snap.schedulable[3]
+        assert snap.schedulable[0]
+        # Orphan gets singleton domains — never packs with labelled nodes.
+        orphan = snap.node_index["n-orphan"]
+        for level in range(snap.num_levels):
+            same = (snap.domain_ids[level] == snap.domain_ids[level, orphan]).sum()
+            assert same == 1
+
+    def test_level_index_lookup(self):
+        snap = encode_topology(topo(), two_rack_nodes())
+        assert snap.level_index("topo/rack") == 1
+        assert snap.level_index(HOST_LABEL_KEY) == 2
+
+
+def test_host_level_inserted_above_numa():
+    """Auto-added host level must sort above numa (review finding r1-2)."""
+    from grove_tpu.api.types import TopologyLevel
+
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="rack", key="topo/rack"),
+            TopologyLevel(domain="numa", key="topo/numa"),
+        ]
+    )
+    # default path appends host before sorting
+    assert [lv.domain for lv in ct.spec.levels] == ["rack", "host", "numa"]
+
+    # encode path: two hosts in one rack, each with numa-0 — numa domains
+    # must stay distinct per host.
+    from grove_tpu.api.types import ClusterTopology, ClusterTopologySpec
+
+    raw = ClusterTopology(
+        spec=ClusterTopologySpec(
+            levels=[
+                TopologyLevel(domain="rack", key="topo/rack"),
+                TopologyLevel(domain="numa", key="topo/numa"),
+            ]
+        )
+    )
+    nodes = [
+        make_node("hostA", {"topo/rack": "r0", "topo/numa": "numa-0"}),
+        make_node("hostB", {"topo/rack": "r0", "topo/numa": "numa-0"}),
+    ]
+    snap = encode_topology(raw, nodes)
+    assert snap.level_keys == ["topo/rack", HOST_LABEL_KEY, "topo/numa"]
+    numa_level = snap.level_index("topo/numa")
+    assert snap.domain_ids[numa_level, 0] != snap.domain_ids[numa_level, 1]
