@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Benchmark: TPU placement engine vs serial baseline on the stress config.
+
+Stress config (BASELINE.json): a backlog of 8-pod gangs (default 1000) over a
+kwok-style simulated cluster (default 5000 nodes, 3-tier block/rack/host
+topology). The reference publishes no numbers (BASELINE.md), so the serial
+scorer implemented in grove_tpu/solver/serial.py IS the baseline; the north
+star is <1 s p99 full-backlog bind latency and >= 20x the serial scorer.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": "gangs/sec", "vs_baseline": N, ...}
+vs_baseline = serial_wall / engine_wall (speedup; >1 is better than baseline).
+
+Usage: bench.py [--small] [--nodes N] [--gangs G] [--iters K] [--serial-sample S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Node, TopologyLevel
+from grove_tpu.solver import PlacementEngine, SolverGang, solve_serial
+from grove_tpu.topology import default_cluster_topology, encode_topology
+
+
+def make_cluster(num_nodes: int):
+    """3-tier topology: ~16 racks/block, 16 hosts/rack."""
+    nodes = []
+    i = 0
+    while i < num_nodes:
+        b, rem = divmod(i, 256)
+        r = rem // 16
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(
+                    name=f"n{i}",
+                    labels={"t/block": f"b{b}", "t/rack": f"b{b}r{r}"},
+                ),
+                allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+            )
+        )
+        i += 1
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="block", key="t/block"),
+            TopologyLevel(domain="rack", key="t/rack"),
+        ]
+    )
+    return encode_topology(ct, nodes)
+
+
+def make_gangs(num_gangs: int) -> list[SolverGang]:
+    """Mixed backlog: plain 8-pod gangs (block-required, rack-preferred) and
+    leader/worker gangs whose two groups each pack a rack."""
+    gangs = []
+    for i in range(num_gangs):
+        if i % 4 == 3:
+            # leader/worker: 2 groups x 4 pods, each group rack-packed
+            demand = np.tile(np.array([4.0, 16.0, 1.0], np.float32), (8, 1))
+            gangs.append(
+                SolverGang(
+                    name=f"gang{i:05d}",
+                    namespace="bench",
+                    demand=demand,
+                    pod_names=[f"gang{i:05d}-p{j}" for j in range(8)],
+                    group_ids=np.repeat(np.arange(2, dtype=np.int32), 4),
+                    group_names=["leader", "worker"],
+                    group_required_level=np.array([1, 1], np.int32),
+                    group_preferred_level=np.array([-1, -1], np.int32),
+                    required_level=0,
+                )
+            )
+        else:
+            demand = np.tile(np.array([4.0, 16.0, 1.0], np.float32), (8, 1))
+            gangs.append(
+                SolverGang(
+                    name=f"gang{i:05d}",
+                    namespace="bench",
+                    demand=demand,
+                    pod_names=[f"gang{i:05d}-p{j}" for j in range(8)],
+                    group_ids=np.zeros(8, np.int32),
+                    group_names=["workers"],
+                    group_required_level=np.array([-1], np.int32),
+                    group_preferred_level=np.array([-1], np.int32),
+                    required_level=0,
+                    preferred_level=1,
+                )
+            )
+    return gangs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="CPU-friendly quick run (512 nodes, 64 gangs)")
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--gangs", type=int, default=1000)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--serial-sample", type=int, default=0,
+                    help="measure serial baseline on this many gangs and "
+                    "extrapolate (0 = run the full backlog serially)")
+    args = ap.parse_args()
+    if args.small:
+        args.nodes, args.gangs, args.iters = 512, 64, 3
+        if args.serial_sample == 0:
+            args.serial_sample = 32
+
+    snapshot = make_cluster(args.nodes)
+    gangs = make_gangs(args.gangs)
+
+    engine = PlacementEngine(snapshot)
+    engine.solve(gangs)  # warm-up: compile + caches
+
+    # Engine: p99-style latency over iterations of the FULL backlog solve
+    # (each iteration is one "bind the whole backlog" event).
+    times = []
+    placed = fallbacks = 0
+    score = 0.0
+    for _ in range(args.iters):
+        res = engine.solve(gangs)
+        times.append(res.wall_seconds)
+        placed = res.num_placed
+        score = res.mean_placement_score()
+        fallbacks = int(res.stats.get("fallbacks", 0))
+    engine_wall = float(np.percentile(times, 99))
+
+    # Serial baseline on the identical problem.
+    sample = args.serial_sample or len(gangs)
+    t0 = time.perf_counter()
+    sres = solve_serial(snapshot, gangs[:sample])
+    serial_sample_wall = time.perf_counter() - t0
+    serial_wall = serial_sample_wall * (len(gangs) / max(sample, 1))
+
+    gangs_per_sec = args.gangs / engine_wall
+    out = {
+        "metric": f"gang placements/sec ({args.gangs} x 8-pod gangs, "
+        f"{args.nodes} nodes, 3-tier topology)",
+        "value": round(gangs_per_sec, 1),
+        "unit": "gangs/sec",
+        "vs_baseline": round(serial_wall / engine_wall, 2),
+        "p99_backlog_bind_seconds": round(engine_wall, 4),
+        "serial_baseline_seconds": round(serial_wall, 2),
+        "serial_sampled_gangs": sample,
+        "placed": placed,
+        "serial_placed_sampled": sres.num_placed,
+        "mean_placement_score": round(score, 4),
+        "repair_fallbacks": fallbacks,
+        "backend": __import__("jax").default_backend(),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,7 @@ from .types import (
 from .validation import (
     ValidationError,
     find_cycles,
+    validate_cluster_topology,
     validate_podcliqueset,
     validate_podcliqueset_update,
 )

@@ -16,6 +16,7 @@ import re
 
 from . import constants
 from .types import (
+    MAX_TOPOLOGY_LEVELS,
     TOPOLOGY_DOMAIN_ORDER,
     CliqueStartupType,
     PodCliqueSet,
@@ -157,9 +158,7 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         # budgeted against '<pcs><sg><clique>' in the scaling-group loop
         # instead, never against the standalone form.
         if clique.name not in pcsg_member_cliques:
-            combined = (
-                len(pcs.metadata.name) + len(str(pcs.spec.replicas)) + len(clique.name)
-            )
+            combined = len(pcs.metadata.name) + len(clique.name)
             if combined > constants.MAX_COMBINED_NAME_LENGTH:
                 errs.append(
                     f"{path}: combined name '<pcs>-<replica>-{clique.name}' exceeds "
@@ -298,6 +297,35 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         raise ValidationError(errs)
 
 
+def validate_cluster_topology(ct) -> None:
+    """Admission-time validation for ClusterTopology (the reference enforces
+    the domain enum via CRD schema, clustertopology.go:72-87). Callers of
+    topology.encode_topology are guaranteed pre-validated input; unknown
+    domains are rejected here, not deep in the solve path."""
+    errs: list[str] = []
+    seen_domains: set[str] = set()
+    seen_keys: set[str] = set()
+    for i, lv in enumerate(ct.spec.levels):
+        path = f"spec.levels[{i}]"
+        if lv.domain not in TOPOLOGY_DOMAIN_ORDER:
+            errs.append(
+                f"{path}.domain: unknown topology domain {lv.domain!r} "
+                f"(supported: {sorted(TOPOLOGY_DOMAIN_ORDER)})"
+            )
+        if lv.domain in seen_domains:
+            errs.append(f"{path}.domain: duplicate domain {lv.domain!r}")
+        seen_domains.add(lv.domain)
+        if not lv.key:
+            errs.append(f"{path}.key: node label key must not be empty")
+        if lv.key in seen_keys:
+            errs.append(f"{path}.key: duplicate label key {lv.key!r}")
+        seen_keys.add(lv.key)
+    if len(ct.spec.levels) > MAX_TOPOLOGY_LEVELS:
+        errs.append(f"spec.levels: at most {MAX_TOPOLOGY_LEVELS} levels")
+    if errs:
+        raise ValidationError(errs)
+
+
 def validate_podcliqueset_update(old: PodCliqueSet, new: PodCliqueSet) -> None:
     """Immutable-field checks on update (validation/podcliqueset.go:520-562).
 
@@ -310,15 +338,17 @@ def validate_podcliqueset_update(old: PodCliqueSet, new: PodCliqueSet) -> None:
     new_names = [c.name for c in new_tmpl.cliques]
     if sorted(old_names) != sorted(new_names):
         errs.append("spec.template.cliques: clique names are immutable")
-    elif (
-        old_tmpl.startup_type != CliqueStartupType.ANY_ORDER
-        and old_names != new_names
-    ):
-        errs.append(
-            "spec.template.cliques: clique order is immutable when startupType "
-            "is InOrder/Explicit"
-        )
     else:
+        if (
+            old_tmpl.startup_type != CliqueStartupType.ANY_ORDER
+            and old_names != new_names
+        ):
+            errs.append(
+                "spec.template.cliques: clique order is immutable when "
+                "startupType is InOrder/Explicit"
+            )
+        # Per-clique immutability is reported alongside any order violation
+        # so the user learns every problem in one admission round.
         old_by_name = {c.name: c for c in old_tmpl.cliques}
         for i, c in enumerate(new_tmpl.cliques):
             o = old_by_name[c.name]

@@ -1,0 +1,39 @@
+"""The gang placement engine.
+
+This is the component the reference never implements in-repo: Grove hands
+every PodGang to the external KAI scheduler
+(/root/reference/operator/cmd/main.go:78-81). grove_tpu implements placement
+itself, twice:
+
+  serial.py   — the serial baseline scorer (pure-Python loops over gangs and
+                candidate domains with exact feasibility checks). This is the
+                stand-in for the reference's serial per-pod scorer and the
+                number `bench.py` reports speedups against.
+  engine.py   — the TPU path: all pending gangs are batched into dense
+                (gang x domain) value tensors built from MXU-friendly
+                one-hot segment sums, contended via a fixed-iteration
+                auction under jit, then committed exactly on host by the
+                shared repair/fit primitives.
+
+Both paths share problem.py (dense gang encoding) and fit.py (exact
+best-fit-decreasing placement + placement-score computation), so they solve
+the identical problem with identical hard-feasibility semantics; only the
+search strategy differs.
+"""
+
+from .fit import place_gang_in_domain, placement_score_for_nodes
+from .problem import SolverGang, encode_podgangs
+from .result import GangPlacement, SolveResult
+from .serial import solve_serial
+from .engine import PlacementEngine
+
+__all__ = [
+    "GangPlacement",
+    "PlacementEngine",
+    "SolveResult",
+    "SolverGang",
+    "encode_podgangs",
+    "place_gang_in_domain",
+    "placement_score_for_nodes",
+    "solve_serial",
+]

@@ -1,0 +1,149 @@
+"""Solver-side problem encoding: PodGangs -> dense gang structs.
+
+The operator hands the solver PodGang CRs (the scheduler contract,
+scheduler/api/core/v1alpha1/podgang.go in the reference). This module
+flattens them into numpy structs: per-pod demand matrices, group ids, and
+topology constraint *level indices* resolved against the TopologySnapshot
+(constraints arrive as node-label keys, podgang.go:102-118).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..api.podgang import PodGang, TopologyConstraint
+from ..topology.encoding import TopologySnapshot
+
+
+@dataclass
+class SolverGang:
+    """One gang, dense. P pods, R resources (R matches the snapshot)."""
+
+    name: str
+    namespace: str
+    demand: np.ndarray                 # float32 [P, R]
+    pod_names: list[str]               # len P (pod metadata names)
+    group_ids: np.ndarray              # int32 [P] — index into groups
+    group_names: list[str]
+    # Per-group pack levels, resolved to snapshot level indices; -1 = none.
+    group_required_level: np.ndarray   # int32 [num_groups]
+    group_preferred_level: np.ndarray  # int32 [num_groups]
+    # Gang-level pack constraint (PodGangSpec.TopologyConstraint).
+    required_level: int = -1
+    preferred_level: int = -1
+    priority: float = 0.0
+    # Constraint groups spanning subsets of groups (PCSG co-location inside a
+    # base gang, podgang.go:121-132): (member group indices, required_level,
+    # preferred_level).
+    constraint_groups: list[tuple[list[int], int, int]] = field(default_factory=list)
+
+    @property
+    def num_pods(self) -> int:
+        return int(self.demand.shape[0])
+
+    def total_demand(self) -> np.ndarray:
+        return self.demand.sum(axis=0)
+
+    def max_pod_demand(self) -> np.ndarray:
+        return self.demand.max(axis=0) if self.num_pods else self.demand.sum(axis=0)
+
+
+def _resolve_level(
+    tc: Optional[TopologyConstraint], snapshot: TopologySnapshot
+) -> tuple[int, int]:
+    """TopologyConstraint (label keys) -> (required_level, preferred_level).
+
+    Unknown keys resolve to -1 (unconstrained) rather than erroring: the
+    solver must keep scheduling other gangs even if one gang references a
+    level the current ClusterTopology no longer carries (the reference
+    surfaces this as the TopologyLevelsUnavailable condition instead of
+    failing the scheduler).
+    """
+    req = pref = -1
+    if tc is not None and tc.pack_constraint is not None:
+        pc = tc.pack_constraint
+        if pc.required is not None:
+            try:
+                req = snapshot.level_index(pc.required)
+            except KeyError:
+                req = -1
+        if pc.preferred is not None:
+            try:
+                pref = snapshot.level_index(pc.preferred)
+            except KeyError:
+                pref = -1
+    return req, pref
+
+
+def encode_podgangs(
+    podgangs: list[PodGang],
+    snapshot: TopologySnapshot,
+    pod_demand: Callable[[str, str], Optional[np.ndarray]],
+    priority_of: Callable[[PodGang], float] = lambda pg: 0.0,
+) -> list[SolverGang]:
+    """Flatten PodGang CRs into SolverGangs.
+
+    pod_demand(namespace, name) returns the pod's resource-request vector
+    aligned with snapshot.resource_names, or None if the pod doesn't exist
+    yet (the gang is then skipped — the operator only creates PodGangs once
+    all member pods exist, reference podgang/syncflow.go:435-502, so a
+    missing pod means a stale gang).
+
+    Only the first min_replicas pod references of each PodGroup are encoded:
+    those form the all-or-nothing gang; pods beyond the threshold are
+    scheduled best-effort by later solve rounds once the gang is placed.
+    """
+    gangs: list[SolverGang] = []
+    for pg in podgangs:
+        demands: list[np.ndarray] = []
+        pod_names: list[str] = []
+        group_ids: list[int] = []
+        group_names: list[str] = []
+        group_req: list[int] = []
+        group_pref: list[int] = []
+        stale = False
+        for gi, group in enumerate(pg.spec.pod_groups):
+            group_names.append(group.name)
+            req, pref = _resolve_level(group.topology_constraint, snapshot)
+            group_req.append(req)
+            group_pref.append(pref)
+            for ref in group.pod_references[: group.min_replicas]:
+                d = pod_demand(ref.namespace, ref.name)
+                if d is None:
+                    stale = True
+                    break
+                demands.append(np.asarray(d, dtype=np.float32))
+                pod_names.append(ref.name)
+                group_ids.append(gi)
+            if stale:
+                break
+        if stale or not demands:
+            continue
+        req, pref = _resolve_level(pg.spec.topology_constraint, snapshot)
+        name_to_idx = {n: i for i, n in enumerate(group_names)}
+        cgroups: list[tuple[list[int], int, int]] = []
+        for cg in pg.spec.topology_constraint_group_configs:
+            members = [name_to_idx[n] for n in cg.pod_group_names if n in name_to_idx]
+            cg_req, cg_pref = _resolve_level(cg.topology_constraint, snapshot)
+            if members and (cg_req >= 0 or cg_pref >= 0):
+                cgroups.append((members, cg_req, cg_pref))
+        gangs.append(
+            SolverGang(
+                name=pg.metadata.name,
+                namespace=pg.metadata.namespace,
+                demand=np.stack(demands).astype(np.float32),
+                pod_names=pod_names,
+                group_ids=np.asarray(group_ids, dtype=np.int32),
+                group_names=group_names,
+                group_required_level=np.asarray(group_req, dtype=np.int32),
+                group_preferred_level=np.asarray(group_pref, dtype=np.int32),
+                required_level=req,
+                preferred_level=pref,
+                priority=priority_of(pg),
+                constraint_groups=cgroups,
+            )
+        )
+    return gangs

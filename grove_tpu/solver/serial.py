@@ -1,0 +1,107 @@
+"""The serial baseline scorer.
+
+One gang at a time, one candidate domain at a time, exact feasibility per
+try — the shape of the per-pod/per-node serial scoring loop that a
+CPU-bound scheduler (the reference's external KAI scorer, or
+kube-scheduler's Score plugins) runs. This is the baseline number in
+BASELINE.md that the TPU engine must beat by >= 20x; it shares the exact
+placement primitives (fit.py) with the TPU path so both produce the same
+hard-feasibility decisions.
+
+Search order per gang: levels narrowest -> broadest down to the gang's
+required level (so the first success is also the best achievable
+single-domain packing = max placement score), domains within a level
+tightest-fit first.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..topology.encoding import TopologySnapshot
+from .fit import place_gang_in_domain, placement_score_for_nodes
+from .problem import SolverGang
+from .result import GangPlacement, SolveResult
+
+
+def gang_sort_key(g: SolverGang):
+    """Deterministic scheduling order: priority desc, then name."""
+    return (-g.priority, g.name)
+
+
+def solve_serial(
+    snapshot: TopologySnapshot,
+    gangs: list[SolverGang],
+    free: np.ndarray | None = None,
+) -> SolveResult:
+    """Place gangs serially against (a copy of) the snapshot's free capacity.
+
+    Passing `free` lets callers thread committed state across calls; it is
+    mutated in place as gangs commit.
+    """
+    t0 = time.perf_counter()
+    if free is None:
+        free = snapshot.free.copy()
+    sched_nodes = np.flatnonzero(snapshot.schedulable)
+    result = SolveResult()
+    for gang in sorted(gangs, key=gang_sort_key):
+        placed = _place_one(gang, snapshot, free, sched_nodes)
+        if placed is None:
+            result.unplaced[gang.name] = "no feasible domain"
+        else:
+            result.placed[gang.name] = placed
+    result.wall_seconds = time.perf_counter() - t0
+    return result
+
+
+def _place_one(
+    gang: SolverGang,
+    snapshot: TopologySnapshot,
+    free: np.ndarray,
+    sched_nodes: np.ndarray,
+) -> GangPlacement | None:
+    stop_level = gang.required_level if gang.required_level >= 0 else -1
+    # Narrowest level first: the first domain that fits yields the highest
+    # placement score achievable for a single-domain packing. Level -1 is
+    # the virtual cluster root (only reached when unconstrained).
+    for level in range(snapshot.num_levels - 1, stop_level - 1, -1):
+        if level == -1:
+            candidates = [sched_nodes]
+        else:
+            ids = snapshot.domain_ids[level, sched_nodes]
+            candidates = [sched_nodes[ids == d] for d in np.unique(ids)]
+        candidates = _tightest_first(candidates, gang, free, snapshot)
+        for dom in candidates:
+            assign = place_gang_in_domain(gang, snapshot, free, dom, level)
+            if assign is not None:
+                return GangPlacement(
+                    gang=gang,
+                    pod_to_node={
+                        gang.pod_names[i]: snapshot.node_names[assign[i]]
+                        for i in range(gang.num_pods)
+                    },
+                    node_indices=assign,
+                    placement_score=placement_score_for_nodes(snapshot, assign),
+                )
+    return None
+
+
+def _tightest_first(
+    candidates: list[np.ndarray],
+    gang: SolverGang,
+    free: np.ndarray,
+    snapshot: TopologySnapshot,
+) -> list[np.ndarray]:
+    total = gang.total_demand()
+    cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
+    keyed = []
+    for i, dom in enumerate(candidates):
+        dom_free = free[dom].sum(axis=0)
+        if np.any(dom_free + 1e-9 < total):
+            continue  # aggregate can't fit — skip before the exact try
+        slack = float(((dom_free - total) / cap_scale).max())
+        keyed.append((slack, i, dom))
+    keyed.sort(key=lambda t: (t[0], t[1]))
+    return [dom for _, _, dom in keyed]

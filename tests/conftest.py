@@ -1,16 +1,32 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX loads.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 All solver/parallel tests run on CPU with 8 virtual devices so multi-chip
 sharding (Mesh/pjit/shard_map) is exercised without TPU hardware, mirroring
 how the driver dry-runs the multichip path.
+
+The environment registers the axon TPU backend from sitecustomize at
+interpreter startup and programmatically sets jax_platforms="axon,cpu", so
+setting JAX_PLATFORMS in the environment is NOT enough — jax is already
+imported and configured before this file runs. Override the live jax config
+instead (backends initialize lazily, so this takes effect as long as no
+array op ran yet) and set the XLA host-device-count flag before the CPU
+client is created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()} "
+    f"on backend {jax.default_backend()!r}"
+)

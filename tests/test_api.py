@@ -292,3 +292,44 @@ class TestReviewFixes:
                              startup=api.CliqueStartupType.EXPLICIT))
         with pytest.raises(api.ValidationError, match="order is immutable"):
             validate_podcliqueset_update(old, new)
+
+    def test_cluster_topology_validation(self):
+        from grove_tpu.api.types import (
+            ClusterTopology, ClusterTopologySpec, TopologyLevel,
+        )
+
+        bad = ClusterTopology(spec=ClusterTopologySpec(levels=[
+            TopologyLevel(domain="cube", key="t/cube"),
+            TopologyLevel(domain="rack", key="t/rack"),
+            TopologyLevel(domain="rack", key="t/rack"),
+            TopologyLevel(domain="zone", key=""),
+        ]))
+        with pytest.raises(api.ValidationError) as ei:
+            api.validate_cluster_topology(bad)
+        msgs = " ".join(ei.value.errors)
+        assert "unknown topology domain" in msgs
+        assert "duplicate domain" in msgs
+        assert "must not be empty" in msgs
+        ok = ClusterTopology(spec=ClusterTopologySpec(levels=[
+            TopologyLevel(domain="rack", key="t/rack")]))
+        api.validate_cluster_topology(ok)
+
+    def test_update_order_and_field_violations_reported_together(self):
+        from grove_tpu.api.validation import validate_podcliqueset_update
+
+        old = admit(make_pcs(cliques=[clique("a"), clique("b")],
+                             startup=api.CliqueStartupType.EXPLICIT))
+        new = admit(make_pcs(cliques=[clique("b"), clique("a", min_available=1)],
+                             startup=api.CliqueStartupType.EXPLICIT))
+        with pytest.raises(api.ValidationError) as ei:
+            validate_podcliqueset_update(old, new)
+        msgs = " ".join(ei.value.errors)
+        assert "order is immutable" in msgs and "minAvailable is immutable" in msgs
+
+    def test_standalone_name_budget_matches_reference_formula(self):
+        # 20-char pcs + 25-char clique = 45 exactly -> accepted
+        pcs = make_pcs(name="a" * 20, cliques=[clique("b" * 25)])
+        admit(pcs)
+        pcs2 = make_pcs(name="a" * 20, cliques=[clique("b" * 26)])
+        with pytest.raises(api.ValidationError, match="exceeds"):
+            admit(pcs2)

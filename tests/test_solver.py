@@ -1,0 +1,217 @@
+"""Solver tests: exact fit primitives, serial baseline, TPU engine parity."""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Node, TopologyLevel
+from grove_tpu.solver import (
+    PlacementEngine,
+    SolverGang,
+    place_gang_in_domain,
+    placement_score_for_nodes,
+    solve_serial,
+)
+from grove_tpu.topology import default_cluster_topology, encode_topology
+
+
+def make_node(name, labels, cpu=8.0, mem=32.0, tpu=4.0):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=dict(labels)),
+        allocatable={"cpu": cpu, "memory": mem, "tpu": tpu},
+    )
+
+
+def cluster(blocks=2, racks=2, hosts=2, cpu=8.0, tpu=4.0):
+    """blocks x racks x hosts nodes with block/rack topology."""
+    nodes = []
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                nodes.append(
+                    make_node(
+                        f"n{b}{r}{h}",
+                        {"t/block": f"b{b}", "t/rack": f"r{r}"},
+                        cpu=cpu,
+                        tpu=tpu,
+                    )
+                )
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="block", key="t/block"),
+            TopologyLevel(domain="rack", key="t/rack"),
+        ]
+    )
+    return encode_topology(ct, nodes)
+
+
+def gang(name, pods, cpu=1.0, tpu=0.0, required=-1, preferred=-1,
+         group_levels=None, priority=0.0, snap=None):
+    """Uniform-pod gang; group_levels: list of (pod_count, req, pref)."""
+    if group_levels is None:
+        group_levels = [(pods, -1, -1)]
+    demand, gids, greq, gpref, names = [], [], [], [], []
+    for gi, (cnt, req, pref) in enumerate(group_levels):
+        for _ in range(cnt):
+            demand.append([cpu, 1.0, tpu])
+            gids.append(gi)
+        greq.append(req)
+        gpref.append(pref)
+        names.append(f"g{gi}")
+    return SolverGang(
+        name=name,
+        namespace="default",
+        demand=np.asarray(demand, dtype=np.float32),
+        pod_names=[f"{name}-p{i}" for i in range(len(demand))],
+        group_ids=np.asarray(gids, dtype=np.int32),
+        group_names=names,
+        group_required_level=np.asarray(greq, dtype=np.int32),
+        group_preferred_level=np.asarray(gpref, dtype=np.int32),
+        required_level=required,
+        preferred_level=preferred,
+        priority=priority,
+    )
+
+
+class TestFitPrimitives:
+    def test_simple_placement_packs_one_host(self):
+        snap = cluster()
+        free = snap.free.copy()
+        g = gang("g", pods=2, cpu=2.0)
+        nodes = np.arange(snap.num_nodes)
+        assign = place_gang_in_domain(g, snap, free, nodes)
+        assert assign is not None
+        # both pods fit one host and BFD packs tightest -> same node
+        assert assign[0] == assign[1]
+        ci = snap.resource_names.index("cpu")
+        assert free[assign[0], ci] == pytest.approx(4.0)
+
+    def test_infeasible_returns_none_and_rolls_back(self):
+        snap = cluster(blocks=1, racks=1, hosts=1)
+        free = snap.free.copy()
+        before = free.copy()
+        g = gang("g", pods=3, cpu=4.0)  # 12 cpu > 8 on the only host
+        assign = place_gang_in_domain(g, snap, free, np.arange(1))
+        assert assign is None
+        np.testing.assert_allclose(free, before)  # no partial commit
+
+    def test_group_required_level_within_gang_domain(self):
+        snap = cluster()  # levels: block=0, rack=1, host=2
+        free = snap.free.copy()
+        # two groups of 2 pods; each group must pack in ONE rack
+        g = gang("g", pods=4, cpu=6.0,
+                 group_levels=[(2, 1, -1), (2, 1, -1)], required=0)
+        assign = place_gang_in_domain(g, snap, free, np.arange(snap.num_nodes), -1)
+        assert assign is not None
+        rack_ids = snap.domain_ids[1, assign]
+        assert rack_ids[0] == rack_ids[1]
+        assert rack_ids[2] == rack_ids[3]
+        block_ids = snap.domain_ids[0, assign]
+        assert len(set(block_ids.tolist())) == 1  # gang required block
+
+    def test_placement_score(self):
+        snap = cluster()
+        # one host => 1.0 (4 levels incl host: L=3 -> (2+2)/(3+1)=1)
+        assert placement_score_for_nodes(snap, np.array([0, 0])) == 1.0
+        # same rack, different host
+        s_rack = placement_score_for_nodes(snap, np.array([0, 1]))
+        # same block, different rack
+        s_block = placement_score_for_nodes(snap, np.array([0, 2]))
+        # different blocks
+        s_root = placement_score_for_nodes(snap, np.array([0, 4]))
+        assert 0 < s_root < s_block < s_rack < 1.0
+
+
+class TestSerial:
+    def test_packs_narrowest_and_scores(self):
+        snap = cluster()
+        res = solve_serial(snap, [gang("a", pods=2, cpu=2.0)])
+        assert res.num_placed == 1
+        assert res.placed["a"].placement_score == 1.0  # fits one host
+
+    def test_all_or_nothing_capacity(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        # gang of 3 x 6cpu: only 2 hosts of 8 => infeasible as a gang
+        res = solve_serial(snap, [gang("a", pods=3, cpu=6.0)])
+        assert res.num_placed == 0
+        assert "a" in res.unplaced
+
+    def test_required_level_unsatisfiable(self):
+        snap = cluster(hosts=2, cpu=8.0)
+        # 4 pods x 6 cpu can't fit one rack (2 hosts x 8 cpu)
+        res = solve_serial(snap, [gang("a", pods=4, cpu=6.0, required=1)])
+        assert res.num_placed == 0
+        # relax to block level: 4 hosts available
+        res2 = solve_serial(snap, [gang("a", pods=4, cpu=6.0, required=0)])
+        assert res2.num_placed == 1
+        blocks = snap.domain_ids[0, res2.placed["a"].node_indices]
+        assert len(set(blocks.tolist())) == 1
+
+    def test_priority_order_under_contention(self):
+        snap = cluster(blocks=1, racks=1, hosts=1, cpu=8.0)
+        low = gang("low", pods=1, cpu=6.0, priority=0.0)
+        high = gang("high", pods=1, cpu=6.0, priority=10.0)
+        res = solve_serial(snap, [low, high])
+        assert "high" in res.placed
+        assert "low" in res.unplaced
+
+    def test_contention_spills_to_other_racks(self):
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=8.0)
+        gangs = [gang(f"g{i}", pods=2, cpu=8.0, required=1) for i in range(2)]
+        res = solve_serial(snap, gangs)
+        assert res.num_placed == 2
+        racks = {
+            name: set(snap.domain_ids[1, p.node_indices].tolist())
+            for name, p in res.placed.items()
+        }
+        assert racks["g0"].isdisjoint(racks["g1"])
+
+
+class TestEngineParity:
+    """The TPU path must match serial hard-feasibility outcomes."""
+
+    def test_engine_places_like_serial(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            gang("a", pods=2, cpu=2.0),
+            gang("b", pods=4, cpu=6.0, required=1),
+            gang("c", pods=3, cpu=3.0, preferred=2),
+        ]
+        serial = solve_serial(snap, gangs)
+        eng = PlacementEngine(snap).solve(gangs)
+        assert set(eng.placed) == set(serial.placed)
+        for name in eng.placed:
+            assert eng.placed[name].placement_score == pytest.approx(
+                serial.placed[name].placement_score
+            )
+
+    def test_engine_respects_capacity_all_or_nothing(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        res = PlacementEngine(snap).solve([gang("a", pods=3, cpu=6.0)])
+        assert res.num_placed == 0
+
+    def test_engine_contention_many_gangs(self):
+        snap = cluster(blocks=2, racks=4, hosts=2, cpu=8.0, tpu=4.0)
+        gangs = [
+            gang(f"g{i}", pods=2, cpu=4.0, tpu=2.0, required=1)
+            for i in range(8)
+        ]  # 8 gangs x 2 pods, each rack fits exactly one gang's 2 pods...
+        res = PlacementEngine(snap).solve(gangs)
+        serial = solve_serial(snap, gangs)
+        assert res.num_placed == serial.num_placed
+        # capacity never violated
+        used = np.zeros_like(snap.free)
+        for p in res.placed.values():
+            for pod_i, n in enumerate(p.node_indices):
+                used[n] += p.gang.demand[pod_i]
+        assert (used <= snap.free + 1e-6).all()
+
+    def test_engine_group_constraints(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        g = gang("g", pods=4, cpu=6.0,
+                 group_levels=[(2, 1, -1), (2, 1, -1)], required=0)
+        res = PlacementEngine(snap).solve([g])
+        assert res.num_placed == 1
+        rack_ids = snap.domain_ids[1, res.placed["g"].node_indices]
+        assert rack_ids[0] == rack_ids[1]
+        assert rack_ids[2] == rack_ids[3]
