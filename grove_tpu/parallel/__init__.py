@@ -1,0 +1,5 @@
+"""Multi-chip sharding for the placement engine."""
+
+from .sharded import ShardedPlacementEngine, make_solver_mesh, sharded_score_fn
+
+__all__ = ["ShardedPlacementEngine", "make_solver_mesh", "sharded_score_fn"]

@@ -103,47 +103,35 @@ class DomainSpace:
         return sched_nodes[ids == local], level
 
 
-@partial(
-    jax.jit,
-    static_argnames=("num_domains", "top_k"),
-)
-def _device_score(
-    free,            # f32 [N, R] (unschedulable nodes zeroed)
-    gdom,            # i32 [L+1, N]
+def membership_matrix(gdom, num_domains: int):
+    """One-hot membership [N, D] built by scatter-add per level (no [L,N,D]
+    temporary); each node carries one 1 per level + the root. Pure jnp so
+    the sharded path (grove_tpu.parallel) can call it on node shards."""
+    nlevels_p1, n = gdom.shape
+    m = jnp.zeros((n, num_domains), dtype=jnp.float32)
+    for l in range(nlevels_p1):  # static tiny loop, unrolled at trace time
+        m = m.at[jnp.arange(n), gdom[l]].add(1.0)
+    return m
+
+
+def value_from_aggregates(
+    dom_free,        # f32 [D, R] aggregate free per domain (full)
+    cnt_fit,         # f32 [G, D] #nodes per domain fitting the max pod
     dom_level,       # i32 [D]
-    anc_ids,         # i32 [D, L+1] ancestor chains (padded with D)
     total_demand,    # f32 [G, R]
-    max_pod,         # f32 [G, R]
     required_level,  # i32 [G]
     preferred_level, # i32 [G]
     valid,           # bool [G]
     cap_scale,       # f32 [R]
-    *,
-    num_domains: int,
-    top_k: int,
+    nlevels_p1: int,
 ):
-    nlevels_p1, n = gdom.shape
-    d = num_domains
-    # One-hot membership [N, D] built by scatter-add per level (no [L,N,D]
-    # temporary); each node carries one 1 per level + the root.
-    m = jnp.zeros((n, d), dtype=jnp.float32)
-    for l in range(nlevels_p1):  # static tiny loop, unrolled at trace time
-        m = m.at[jnp.arange(n), gdom[l]].add(1.0)
-
-    dom_free = m.T @ free                                   # [D, R]
-    # Node-granularity proxy: #nodes able to host the gang's largest pod.
-    node_fits = jnp.all(
-        free[None, :, :] + 1e-6 >= max_pod[:, None, :], axis=-1
-    ).astype(jnp.float32)                                   # [G, N]
-    cnt_fit = node_fits @ m                                 # [G, D] (MXU)
-
+    """value[G, D]: pack narrowness dominates (it IS the placement score),
+    then a bonus for satisfying the preferred level, minus normalized slack
+    so tight domains win ties (best-fit at domain granularity). Rows/pairs
+    that are statically infeasible or hierarchy-violating get _NEG."""
     # Hierarchy mask: gangs may only use domains at least as narrow as their
     # required level; the root (-1) only when unconstrained.
     allowed = dom_level[None, :] >= required_level[:, None]
-
-    # Value: pack narrowness dominates (it IS the placement score), then a
-    # bonus for satisfying the preferred level, minus normalized slack so
-    # tight domains win ties (best-fit at domain granularity).
     level_score = (dom_level.astype(jnp.float32) + 2.0) / jnp.float32(nlevels_p1 + 1)
     pref_bonus = (dom_level[None, :] >= preferred_level[:, None]).astype(jnp.float32)
     slack = jnp.max(
@@ -152,20 +140,21 @@ def _device_score(
         axis=-1,
     )
     slack = slack / (1.0 + jnp.abs(slack))  # squash: ordering, not magnitude
-    value = (
-        4.0 * level_score[None, :]
-        + 1.0 * pref_bonus
-        - 0.5 * slack
-    )
+    value = 4.0 * level_score[None, :] + 1.0 * pref_bonus - 0.5 * slack
     static_mask = (cnt_fit >= 1.0) & allowed & valid[:, None]
-    value = jnp.where(static_mask, value, _NEG)
+    return jnp.where(static_mask, value, _NEG)
 
-    # Contention pass: sequential virtual commit in priority order. resid
-    # carries residual aggregate capacity per domain (+1 absorbing dummy
-    # row for ancestor-chain padding); each gang takes its best residually
-    # feasible domain and the chain is decremented before the next gang.
+
+def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int):
+    """Contention pass: sequential virtual commit in priority order (= row
+    order). resid carries residual aggregate capacity per domain (+1
+    absorbing dummy row for ancestor-chain padding); each gang takes its
+    best residually feasible domain, records its top-k residual-feasible
+    alternates, and the chosen domain's whole ancestor chain is decremented
+    before the next gang chooses."""
+    d = dom_free.shape[0]
     resid0 = jnp.concatenate(
-        [dom_free, jnp.zeros((1, free.shape[1]), jnp.float32)], axis=0
+        [dom_free, jnp.zeros((1, dom_free.shape[1]), jnp.float32)], axis=0
     )
 
     def step(resid, g):
@@ -186,6 +175,40 @@ def _device_score(
         step, resid0, jnp.arange(total_demand.shape[0])
     )
     return top_val, top_dom
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_domains", "top_k"),
+)
+def _device_score(
+    free,            # f32 [N, R] (unschedulable nodes zeroed)
+    gdom,            # i32 [L+1, N]
+    dom_level,       # i32 [D]
+    anc_ids,         # i32 [D, L+1] ancestor chains (padded with D)
+    total_demand,    # f32 [G, R]
+    max_pod,         # f32 [G, R]
+    required_level,  # i32 [G]
+    preferred_level, # i32 [G]
+    valid,           # bool [G]
+    cap_scale,       # f32 [R]
+    *,
+    num_domains: int,
+    top_k: int,
+):
+    nlevels_p1, _ = gdom.shape
+    m = membership_matrix(gdom, num_domains)
+    dom_free = m.T @ free                                   # [D, R]
+    # Node-granularity proxy: #nodes able to host the gang's largest pod.
+    node_fits = jnp.all(
+        free[None, :, :] + 1e-6 >= max_pod[:, None, :], axis=-1
+    ).astype(jnp.float32)                                   # [G, N]
+    cnt_fit = node_fits @ m                                 # [G, D] (MXU)
+    value = value_from_aggregates(
+        dom_free, cnt_fit, dom_level, total_demand, required_level,
+        preferred_level, valid, cap_scale, nlevels_p1,
+    )
+    return commit_scan(value, dom_free, anc_ids, total_demand, top_k)
 
 
 class PlacementEngine:
@@ -230,23 +253,13 @@ class PlacementEngine:
         cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9).astype(
             np.float32
         )
-        top_val, top_dom = _device_score(
-            jnp.asarray(dev_free),
-            jnp.asarray(self.space.gdom),
-            jnp.asarray(self.space.dom_level),
-            jnp.asarray(self.space.anc_ids),
-            jnp.asarray(total_demand),
-            jnp.asarray(max_pod),
-            jnp.asarray(required_level),
-            jnp.asarray(preferred_level),
-            jnp.asarray(valid),
-            jnp.asarray(cap_scale),
-            num_domains=self.space.num_domains,
-            top_k=min(self.top_k, self.space.num_domains),
+        result.stats["encode_seconds"] = time.perf_counter() - t0
+        t_dev = time.perf_counter()
+        top_val, top_dom = self._device_phase(
+            dev_free, total_demand, max_pod, required_level,
+            preferred_level, valid, cap_scale,
         )
-        top_val = np.asarray(top_val)
-        top_dom = np.asarray(top_dom)
-        result.stats["device_seconds"] = time.perf_counter() - t0
+        result.stats["device_seconds"] = time.perf_counter() - t_dev
 
         fallbacks = 0
         for i, gang in enumerate(order):
@@ -272,6 +285,26 @@ class PlacementEngine:
         result.stats["fallbacks"] = float(fallbacks)
         result.wall_seconds = time.perf_counter() - t0
         return result
+
+    def _device_phase(self, dev_free, total_demand, max_pod, required_level,
+                      preferred_level, valid, cap_scale):
+        """Single-device scoring; ShardedPlacementEngine overrides this with
+        the mesh-SPMD version (grove_tpu/parallel/sharded.py)."""
+        top_val, top_dom = _device_score(
+            jnp.asarray(dev_free),
+            jnp.asarray(self.space.gdom),
+            jnp.asarray(self.space.dom_level),
+            jnp.asarray(self.space.anc_ids),
+            jnp.asarray(total_demand),
+            jnp.asarray(max_pod),
+            jnp.asarray(required_level),
+            jnp.asarray(preferred_level),
+            jnp.asarray(valid),
+            jnp.asarray(cap_scale),
+            num_domains=self.space.num_domains,
+            top_k=min(self.top_k, self.space.num_domains),
+        )
+        return np.asarray(top_val), np.asarray(top_dom)
 
     def _mk_placement(self, gang: SolverGang, assign: np.ndarray) -> GangPlacement:
         return GangPlacement(

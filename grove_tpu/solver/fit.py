@@ -91,7 +91,7 @@ def _best_fit_decreasing(
 ) -> bool:
     """Place pods (largest-first) on the tightest node that fits; mutates
     free and assign in place. Returns False (partial mutation possible —
-    callers try on copies) when any pod doesn't fit."""
+    callers restore the affected rows) when any pod doesn't fit."""
     if len(pod_idx) == 0:
         return True
     order = np.argsort(-_dominant_share(demand[pod_idx], cap_scale), kind="stable")
@@ -145,21 +145,25 @@ def _place_unit(
     assign: np.ndarray,
     domain_level: int,
 ) -> bool:
-    """Place a unit's children + direct pods within node_idx (mutates
-    free/assign on success; callers pass copies when they may retry)."""
+    """Place a unit's children + direct pods within node_idx. Mutates
+    free/assign in place; on failure the caller restores the node_idx rows
+    of free and this unit's assign entries (row-scoped backtracking)."""
     # Soft preference: first try the whole unit inside one preferred-level
     # subdomain (only meaningful when pref is narrower than where we are).
     if unit.pref_level > domain_level:
-        total = gang.demand[unit.all_pods()].sum(axis=0)
+        pods_all = unit.all_pods()
+        total = gang.demand[pods_all].sum(axis=0)
         doms = _subdomains_within(snapshot, unit.pref_level, node_idx)
         stripped = _Unit(req_level=unit.req_level, pref_level=-1,
                          pods=unit.pods, children=unit.children)
         for d in _order_domains_tightest(doms, total, free, cap_scale):
-            f2, a2 = free.copy(), assign.copy()
-            if _place_unit(stripped, d, gang, snapshot, f2, cap_scale, a2,
-                           unit.pref_level):
-                free[:], assign[:] = f2, a2
+            # Row-scoped backtracking: a failed try can only have mutated
+            # free rows inside d and assign entries of this unit's pods.
+            save_free, save_assign = free[d].copy(), assign[pods_all].copy()
+            if _place_unit(stripped, d, gang, snapshot, free, cap_scale,
+                           assign, unit.pref_level):
                 return True
+            free[d], assign[pods_all] = save_free, save_assign
         # fall through: preference unsatisfiable, place unrestricted
     # Children first, largest demand first (harder to place).
     children = sorted(
@@ -192,14 +196,15 @@ def _place_child(
         # place within the parent domain, honoring any preference.
         return _place_unit(child, node_idx, gang, snapshot, free, cap_scale,
                            assign, domain_level)
-    total = gang.demand[child.all_pods()].sum(axis=0)
+    pods_all = child.all_pods()
+    total = gang.demand[pods_all].sum(axis=0)
     doms = _subdomains_within(snapshot, child.req_level, node_idx)
     for d in _order_domains_tightest(doms, total, free, cap_scale):
-        f2, a2 = free.copy(), assign.copy()
-        if _place_unit(child, d, gang, snapshot, f2, cap_scale, a2,
+        save_free, save_assign = free[d].copy(), assign[pods_all].copy()
+        if _place_unit(child, d, gang, snapshot, free, cap_scale, assign,
                        child.req_level):
-            free[:], assign[:] = f2, a2
             return True
+        free[d], assign[pods_all] = save_free, save_assign
     return False
 
 
@@ -219,13 +224,13 @@ def place_gang_in_domain(
         return None
     cap_scale = np.maximum(snapshot.capacity.max(axis=0), _EPS)
     assign = np.full(gang.num_pods, -1, dtype=np.int64)
-    f2 = free.copy()
+    save_free = free[node_idx].copy()  # only these rows can be mutated
     root = _build_unit_tree(gang)
     root.req_level = -1  # domain already chosen by the caller
-    if not _place_unit(root, node_idx, gang, snapshot, f2, cap_scale, assign,
-                       domain_level):
+    if not _place_unit(root, node_idx, gang, snapshot, free, cap_scale,
+                       assign, domain_level):
+        free[node_idx] = save_free
         return None
-    free[:] = f2
     return assign
 
 

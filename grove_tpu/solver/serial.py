@@ -21,7 +21,11 @@ import time
 import numpy as np
 
 from ..topology.encoding import TopologySnapshot
-from .fit import place_gang_in_domain, placement_score_for_nodes
+from .fit import (
+    _order_domains_tightest,
+    place_gang_in_domain,
+    placement_score_for_nodes,
+)
 from .problem import SolverGang
 from .result import GangPlacement, SolveResult
 
@@ -72,7 +76,10 @@ def _place_one(
         else:
             ids = snapshot.domain_ids[level, sched_nodes]
             candidates = [sched_nodes[ids == d] for d in np.unique(ids)]
-        candidates = _tightest_first(candidates, gang, free, snapshot)
+        cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
+        candidates = _order_domains_tightest(
+            candidates, gang.total_demand(), free, cap_scale
+        )
         for dom in candidates:
             assign = place_gang_in_domain(gang, snapshot, free, dom, level)
             if assign is not None:
@@ -86,22 +93,3 @@ def _place_one(
                     placement_score=placement_score_for_nodes(snapshot, assign),
                 )
     return None
-
-
-def _tightest_first(
-    candidates: list[np.ndarray],
-    gang: SolverGang,
-    free: np.ndarray,
-    snapshot: TopologySnapshot,
-) -> list[np.ndarray]:
-    total = gang.total_demand()
-    cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9)
-    keyed = []
-    for i, dom in enumerate(candidates):
-        dom_free = free[dom].sum(axis=0)
-        if np.any(dom_free + 1e-9 < total):
-            continue  # aggregate can't fit — skip before the exact try
-        slack = float(((dom_free - total) / cap_scale).max())
-        keyed.append((slack, i, dom))
-    keyed.sort(key=lambda t: (t[0], t[1]))
-    return [dom for _, _, dom in keyed]

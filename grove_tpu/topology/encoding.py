@@ -30,6 +30,7 @@ from ..api.types import (
     sort_topology_levels,
 )
 from ..api.meta import ObjectMeta
+from ..api.validation import validate_cluster_topology
 
 #: Label key for the auto-added narrowest level, mirroring the reference's
 #: auto-added `host` level -> kubernetes.io/hostname
@@ -121,7 +122,12 @@ def encode_topology(
     usage: node name -> {resource: amount consumed by bound pods}. Nodes
     missing a level label are placed in a per-node singleton domain at that
     level (conservative: they never pack with anything).
+
+    The topology is validated on entry (unknown/duplicate domains or keys
+    raise ValidationError) so every snapshot downstream of here — and
+    therefore every solve — works on a well-formed hierarchy.
     """
+    validate_cluster_topology(topology)
     levels = list(topology.spec.levels)
     if not any(lv.key == HOST_LABEL_KEY or lv.domain == "host" for lv in levels):
         # Append before sorting so host lands in hierarchy order (above numa),
