@@ -34,6 +34,11 @@ ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION = (
     "grove.io/disable-managed-resource-protection"
 )
 ANNOTATION_TOPOLOGY_NAME = "grove.io/topology-name"
+# Startup-order barrier spec, '<pclqFQN>:<minAvailable>,...' — carries the
+# same dependency list the reference passes to the grove-initc init
+# container as --podcliques args (pod/initcontainer.go:155); consumed by the
+# simulated kubelet instead of an in-pod binary.
+ANNOTATION_WAIT_FOR = "grove.io/wait-for"
 
 # --- Scheduling gate (components/pod/pod.go:68) ---
 PODGANG_PENDING_CREATION_GATE = "grove.io/podgang-pending-creation"

@@ -1,0 +1,17 @@
+"""Simulated cluster: object store (apiserver), clock, nodes, kubelet."""
+
+from .clock import SimClock
+from .store import Event, ObjectStore, StoreError
+from .inventory import make_nodes
+from .kubelet import SimKubelet
+from .cluster import Cluster
+
+__all__ = [
+    "Cluster",
+    "Event",
+    "ObjectStore",
+    "SimClock",
+    "SimKubelet",
+    "StoreError",
+    "make_nodes",
+]

@@ -1,0 +1,115 @@
+"""Cluster facade: store + clock + nodes + kubelet + topology snapshots.
+
+The one-stop test/user entry point: register admission for the Grove kinds,
+load node inventory, apply workloads, and produce solver-ready
+TopologySnapshots with live usage accounting (what the scheduler loop feeds
+the placement engine).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import (
+    default_podcliqueset,
+    validate_cluster_topology,
+    validate_podcliqueset,
+    validate_podcliqueset_update,
+)
+from ..api.types import ClusterTopology, Node, Pod, PodPhase
+from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
+from .clock import SimClock
+from .kubelet import SimKubelet
+from .store import Admission, ObjectStore
+
+
+class Cluster:
+    def __init__(self, nodes: list[Node] | None = None,
+                 topology: ClusterTopology | None = None):
+        self.clock = SimClock()
+        self.store = ObjectStore(self.clock)
+        self.kubelet = SimKubelet(self.store)
+        self.store.register_admission(
+            "PodCliqueSet",
+            Admission(
+                default=default_podcliqueset,
+                validate=validate_podcliqueset,
+                validate_update=validate_podcliqueset_update,
+            ),
+        )
+        self.store.register_admission(
+            "ClusterTopology", Admission(validate=validate_cluster_topology)
+        )
+        # Topology sync at startup (clustertopology.go:41): ensure the
+        # singleton ClusterTopology exists before any controller runs.
+        self.topology = topology or default_cluster_topology(
+            []
+            if nodes is None
+            else _infer_levels(nodes)
+        )
+        self.store.create(self.topology)
+        for node in nodes or []:
+            self.store.create(node)
+
+    # -- node ops ----------------------------------------------------------
+    def cordon(self, name: str) -> None:
+        node = self.store.get(Node.KIND, "default", name)
+        node.unschedulable = True
+        self.store.update(node)
+
+    def uncordon(self, name: str) -> None:
+        node = self.store.get(Node.KIND, "default", name)
+        node.unschedulable = False
+        self.store.update(node)
+
+    # -- solver input ------------------------------------------------------
+    def usage(self) -> dict[str, dict[str, float]]:
+        """Per-node resource usage from bound, non-terminal pods (terminal
+        Succeeded/Failed pods release their requests, as in kube-scheduler's
+        accounting)."""
+        out: dict[str, dict[str, float]] = {}
+        terminal = (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        for pod in self.store.list(Pod.KIND):
+            if not pod.node_name or pod.status.phase in terminal:
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            per_node = out.setdefault(pod.node_name, {})
+            for res, amount in pod.spec.total_requests().items():
+                per_node[res] = per_node.get(res, 0.0) + amount
+        return out
+
+    def topology_snapshot(self) -> TopologySnapshot:
+        return encode_topology(
+            self.topology, self.store.list(Node.KIND), usage=self.usage()
+        )
+
+    def pod_demand_fn(self, resource_names: list[str]):
+        """pod_demand callable for solver.problem.encode_podgangs."""
+
+        def fn(namespace: str, name: str):
+            pod = self.store.get(Pod.KIND, namespace, name)
+            if pod is None:
+                return None
+            req = pod.spec.total_requests()
+            return np.asarray(
+                [req.get(r, 0.0) for r in resource_names], dtype=np.float32
+            )
+
+        return fn
+
+
+def _infer_levels(nodes: list[Node]):
+    """Derive topology levels from the label keys the inventory carries."""
+    from ..api.types import TopologyLevel
+    from .inventory import BLOCK_KEY, RACK_KEY
+
+    keys = set()
+    for n in nodes:
+        keys.update(n.metadata.labels)
+    levels = []
+    if BLOCK_KEY in keys:
+        levels.append(TopologyLevel(domain="block", key=BLOCK_KEY))
+    if RACK_KEY in keys:
+        levels.append(TopologyLevel(domain="rack", key=RACK_KEY))
+    return levels

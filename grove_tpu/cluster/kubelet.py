@@ -1,0 +1,100 @@
+"""Simulated kubelet + in-pod startup barrier.
+
+Drives bound pods through Pending -> Running -> Ready, honoring the
+startup-order barrier that the reference implements as the grove-initc init
+container (operator/initc/): a dependent pod's main containers only start
+once every parent clique has >= minAvailable ready pods
+(initc/internal/wait.go:111-275). Here the barrier is an annotation on the
+pod (constants.ANNOTATION_WAIT_FOR, written by the pod component exactly
+where the reference injects the init container) that the kubelet checks on
+every tick — same observable semantics, no container runtime.
+
+Fault injection for the E2E suites: fail_pod() (container crash; pod goes
+NotReady/Failed) mirrors the reference E2E's node-cordon + pod-kill fault
+model.
+"""
+
+from __future__ import annotations
+
+from ..api import constants
+from ..api.types import Pod, PodPhase
+from .store import ObjectStore
+
+
+def parse_wait_for(value: str) -> list[tuple[str, int]]:
+    """'pclq-a:2,pclq-b:1' -> [(pclq-a, 2), (pclq-b, 1)] — the same
+    dependency grammar the reference passes to grove-initc as
+    --podcliques=<fqn>:<minAvailable> (pod/initcontainer.go:155)."""
+    out = []
+    for part in value.split(","):
+        if not part:
+            continue
+        fqn, _, min_s = part.rpartition(":")
+        out.append((fqn, int(min_s)))
+    return out
+
+
+class SimKubelet:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self._failed: set[tuple[str, str]] = set()
+
+    def fail_pod(self, namespace: str, name: str) -> None:
+        """Crash the pod's containers: NotReady + Failed phase until the
+        controller replaces it."""
+        pod = self.store.get(Pod.KIND, namespace, name)
+        if pod is None:
+            return
+        self._failed.add((namespace, name))
+        pod.status.phase = PodPhase.FAILED
+        pod.status.ready = False
+        pod.status.restart_count += 1
+        self.store.update_status(pod)
+
+    def tick(self) -> int:
+        """Advance every bound pod one lifecycle step; returns number of
+        status changes (0 = kubelet quiescent)."""
+        changes = 0
+        for pod in self.store.list(Pod.KIND):
+            key = (pod.metadata.namespace, pod.metadata.name)
+            if key in self._failed and pod.status.phase == PodPhase.FAILED:
+                continue
+            if not pod.node_name or pod.spec.scheduling_gates:
+                continue
+            if pod.metadata.deletion_timestamp is not None:
+                continue
+            if pod.status.phase == PodPhase.PENDING:
+                pod.status.phase = PodPhase.RUNNING
+                pod.status.started_at = self.store.clock.now()
+                self.store.update_status(pod)
+                changes += 1
+                continue
+            if pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
+                if self._barrier_open(pod):
+                    pod.status.ready = True
+                    pod.status.ever_started = True
+                    self.store.update_status(pod)
+                    changes += 1
+        return changes
+
+    def run_to_quiesce(self, max_ticks: int = 64) -> None:
+        for _ in range(max_ticks):
+            if self.tick() == 0:
+                return
+
+    def _barrier_open(self, pod) -> bool:
+        """initc equivalent: all parent cliques have >= min ready pods."""
+        spec = pod.metadata.annotations.get(constants.ANNOTATION_WAIT_FOR, "")
+        for pclq_fqn, min_available in parse_wait_for(spec):
+            ready = sum(
+                1
+                for p in self.store.list(
+                    Pod.KIND,
+                    namespace=pod.metadata.namespace,
+                    labels={constants.LABEL_PODCLIQUE: pclq_fqn},
+                )
+                if p.status.ready
+            )
+            if ready < min_available:
+                return False
+        return True

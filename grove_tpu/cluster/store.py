@@ -1,0 +1,279 @@
+"""In-memory versioned object store — the kube-apiserver of the simulation.
+
+Plays the role the real API server plays for the reference operator:
+admission hooks on create/update (the webhook chain,
+operator/internal/webhook/register.go:34-63), resourceVersion on every
+write, generation bump on spec changes (what the reference's
+generation-change predicates key on), finalizer-gated deletion, owner
+references, and an append-only event log that the controller runtime drains
+(the informer/watch bus).
+
+Deliberately single-threaded: the reconcile loop is driven to quiescence by
+the controller manager, which makes every test deterministic — the
+reference needs its expectations store (internal/expect/) precisely because
+informer caches are stale; the simulation keeps that machinery (the
+controllers still read through a snapshot they took at reconcile start) but
+the store itself is always consistent.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..api.meta import ObjectMeta
+from .clock import SimClock
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+@dataclass
+class Event:
+    """Watch event. seq is a global total order (the 'resource version' of
+    the event stream)."""
+
+    seq: int
+    type: str          # "Added" | "Modified" | "Deleted"
+    kind: str
+    namespace: str
+    name: str
+    obj: Any           # post-write snapshot (pre-delete snapshot for Deleted)
+    old: Any = None    # pre-write snapshot for Modified
+
+
+@dataclass
+class Admission:
+    """Per-kind admission chain (defaulting then validation webhooks)."""
+
+    default: Optional[Callable[[Any], Any]] = None
+    validate: Optional[Callable[[Any], None]] = None
+    validate_update: Optional[Callable[[Any, Any], None]] = None
+
+
+def _key(namespace: str, name: str) -> tuple[str, str]:
+    return (namespace, name)
+
+
+def _spec_dict(obj: Any) -> dict:
+    """The generation-relevant content: .spec when present, otherwise every
+    field except metadata/status (e.g. Node.allocatable/unschedulable)."""
+    spec = getattr(obj, "spec", None)
+    if spec is not None:
+        return dataclasses.asdict(spec)
+    full = dataclasses.asdict(obj)
+    full.pop("metadata", None)
+    full.pop("status", None)
+    return full
+
+
+class ObjectStore:
+    def __init__(self, clock: SimClock | None = None):
+        self.clock = clock or SimClock()
+        self._objs: dict[str, dict[tuple[str, str], Any]] = {}
+        self._admission: dict[str, Admission] = {}
+        self._events: list[Event] = []
+        self._seq = itertools.count(1)
+        self._uid = itertools.count(1)
+
+    # -- admission ---------------------------------------------------------
+    def register_admission(self, kind: str, admission: Admission) -> None:
+        self._admission[kind] = admission
+
+    # -- event log ---------------------------------------------------------
+    def events_since(self, seq: int) -> list[Event]:
+        """All events with Event.seq > seq (the watch 'resume' contract)."""
+        return [e for e in self._events if e.seq > seq]
+
+    @property
+    def last_seq(self) -> int:
+        return self._events[-1].seq if self._events else 0
+
+    def _emit(self, type_: str, obj: Any, old: Any = None) -> None:
+        self._events.append(
+            Event(
+                seq=next(self._seq),
+                type=type_,
+                kind=obj.KIND,
+                namespace=obj.metadata.namespace,
+                name=obj.metadata.name,
+                obj=copy.deepcopy(obj),
+                old=old,
+            )
+        )
+
+    # -- reads -------------------------------------------------------------
+    def get(self, kind: str, namespace: str, name: str) -> Any | None:
+        obj = self._objs.get(kind, {}).get(_key(namespace, name))
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        labels: dict[str, str] | None = None,
+        predicate: Callable[[Any], bool] | None = None,
+    ) -> list[Any]:
+        out = []
+        for obj in self._objs.get(kind, {}).values():
+            if namespace is not None and obj.metadata.namespace != namespace:
+                continue
+            if labels is not None and any(
+                obj.metadata.labels.get(k) != v for k, v in labels.items()
+            ):
+                continue
+            if predicate is not None and not predicate(obj):
+                continue
+            out.append(copy.deepcopy(obj))
+        out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return out
+
+    def list_owned(self, kind: str, owner_uid: str) -> list[Any]:
+        return self.list(
+            kind,
+            predicate=lambda o: any(
+                ref.uid == owner_uid for ref in o.metadata.owner_references
+            ),
+        )
+
+    # -- writes ------------------------------------------------------------
+    def create(self, obj: Any) -> Any:
+        kind = obj.KIND
+        adm = self._admission.get(kind)
+        obj = copy.deepcopy(obj)
+        if adm and adm.default:
+            adm.default(obj)
+        if adm and adm.validate:
+            adm.validate(obj)
+        key = _key(obj.metadata.namespace, obj.metadata.name)
+        bucket = self._objs.setdefault(kind, {})
+        if key in bucket:
+            raise AlreadyExists(f"{kind} {key} already exists")
+        meta = obj.metadata
+        meta.uid = f"uid-{next(self._uid)}"
+        meta.generation = 1
+        meta.resource_version = next(self._seq)
+        meta.creation_timestamp = self.clock.now()
+        bucket[key] = obj
+        self._emit("Added", obj)
+        return copy.deepcopy(obj)
+
+    def update(self, obj: Any) -> Any:
+        """Spec/metadata update: bumps generation when the spec changed,
+        runs the update-validation webhook against the stored object."""
+        return self._write(obj, is_status=False)
+
+    def update_status(self, obj: Any) -> Any:
+        """Status subresource update: never bumps generation, skips
+        admission (mirrors k8s status subresource semantics the reference's
+        fake client is configured with, test/utils/setup.go:34-47)."""
+        return self._write(obj, is_status=True)
+
+    def _write(self, obj: Any, is_status: bool) -> Any:
+        kind = obj.KIND
+        key = _key(obj.metadata.namespace, obj.metadata.name)
+        bucket = self._objs.setdefault(kind, {})
+        current = bucket.get(key)
+        if current is None:
+            raise NotFound(f"{kind} {key} not found")
+        obj = copy.deepcopy(obj)
+        old = copy.deepcopy(current)
+        if is_status:
+            # only the status (+ nothing else) moves
+            current.status = obj.status
+        else:
+            adm = self._admission.get(kind)
+            if adm and adm.validate_update:
+                adm.validate_update(current, obj)
+            spec_changed = _spec_dict(current) != _spec_dict(obj)
+            # uid/creation are immutable; carry them over
+            obj.metadata.uid = current.metadata.uid
+            obj.metadata.creation_timestamp = current.metadata.creation_timestamp
+            obj.metadata.generation = current.metadata.generation + (
+                1 if spec_changed else 0
+            )
+            if hasattr(current, "status"):
+                obj.status = current.status  # spec writes don't touch status
+            bucket[key] = current = obj
+        current.metadata.resource_version = next(self._seq)
+        self._emit("Modified", current, old=old)
+        return copy.deepcopy(current)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Finalizer-aware delete: with finalizers present only stamps
+        deletionTimestamp (Modified event); the object is removed once its
+        finalizer list is emptied via update()."""
+        key = _key(namespace, name)
+        bucket = self._objs.get(kind, {})
+        current = bucket.get(key)
+        if current is None:
+            raise NotFound(f"{kind} {key} not found")
+        if current.metadata.finalizers:
+            if current.metadata.deletion_timestamp is None:
+                old = copy.deepcopy(current)
+                current.metadata.deletion_timestamp = self.clock.now()
+                current.metadata.resource_version = next(self._seq)
+                self._emit("Modified", current, old=old)
+            return
+        del bucket[key]
+        self._emit("Deleted", current)
+
+    def remove_finalizer(self, kind: str, namespace: str, name: str,
+                         finalizer: str) -> None:
+        """Drop a finalizer; completes deletion if one is pending."""
+        key = _key(namespace, name)
+        current = self._objs.get(kind, {}).get(key)
+        if current is None:
+            return
+        if finalizer in current.metadata.finalizers:
+            old = copy.deepcopy(current)
+            current.metadata.finalizers.remove(finalizer)
+            current.metadata.resource_version = next(self._seq)
+            self._emit("Modified", current, old=old)
+        if (
+            current.metadata.deletion_timestamp is not None
+            and not current.metadata.finalizers
+        ):
+            del self._objs[kind][key]
+            self._emit("Deleted", current)
+
+    def add_finalizer(self, kind: str, namespace: str, name: str,
+                      finalizer: str) -> None:
+        current = self._objs.get(kind, {}).get(_key(namespace, name))
+        if current is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        if finalizer not in current.metadata.finalizers:
+            old = copy.deepcopy(current)
+            current.metadata.finalizers.append(finalizer)
+            current.metadata.resource_version = next(self._seq)
+            self._emit("Modified", current, old=old)
+
+    # -- garbage collection ------------------------------------------------
+    def collect_orphans(self) -> int:
+        """Kubernetes GC equivalent: delete objects whose controller owner
+        no longer exists. Returns number of deletions triggered."""
+        deleted = 0
+        live_uids = {
+            o.metadata.uid
+            for bucket in self._objs.values()
+            for o in bucket.values()
+        }
+        for kind, bucket in list(self._objs.items()):
+            for obj in list(bucket.values()):
+                refs = obj.metadata.owner_references
+                if refs and all(r.uid not in live_uids for r in refs):
+                    self.delete(kind, obj.metadata.namespace, obj.metadata.name)
+                    deleted += 1
+        return deleted

@@ -120,7 +120,12 @@ class ShardedPlacementEngine(PlacementEngine):
     def __init__(self, snapshot: TopologySnapshot, mesh: Mesh, top_k: int = 8):
         super().__init__(snapshot, top_k=top_k)
         self.mesh = mesh
-        self._fns: dict = {}
+        self._fn = sharded_score_fn(
+            mesh,
+            self.space.num_domains,
+            self.space.gdom.shape[0],
+            min(self.top_k, self.space.num_domains),
+        )  # jit caches per input shape; one wrapper serves all of them
 
     def _pad_nodes(self, arr: np.ndarray, axis: int, mult: int) -> np.ndarray:
         n = arr.shape[axis]
@@ -140,19 +145,10 @@ class ShardedPlacementEngine(PlacementEngine):
         def pad_g(a):
             return self._pad_nodes(a, 0, gangs_axis)
 
-        free_p = self._pad_nodes(dev_free, 0, nodes_axis)
-        gdom_p = self._pad_nodes(self.space.gdom, 1, nodes_axis)
-        top_k = min(self.top_k, self.space.num_domains)
-        key = (free_p.shape, pad_g(total_demand).shape, top_k)
-        if key not in self._fns:
-            self._fns[key] = sharded_score_fn(
-                self.mesh, self.space.num_domains,
-                self.space.gdom.shape[0], top_k,
-            )
         g = total_demand.shape[0]
-        top_val, top_dom = self._fns[key](
-            jnp.asarray(free_p),
-            jnp.asarray(gdom_p),
+        top_val, top_dom = self._fn(
+            jnp.asarray(self._pad_nodes(dev_free, 0, nodes_axis)),
+            jnp.asarray(self._pad_nodes(self.space.gdom, 1, nodes_axis)),
             jnp.asarray(self.space.dom_level),
             jnp.asarray(self.space.anc_ids),
             jnp.asarray(pad_g(total_demand)),

@@ -1,0 +1,197 @@
+"""Store/kubelet/cluster tests (the apiserver-equivalent machinery)."""
+
+import pytest
+
+from grove_tpu.api import constants
+from grove_tpu.api.meta import ObjectMeta, OwnerReference
+from grove_tpu.api.types import (
+    Container,
+    Pod,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueTemplateSpec,
+    PodCliqueSpec,
+    PodPhase,
+    PodSpec,
+)
+from grove_tpu.cluster import Cluster, make_nodes
+from grove_tpu.cluster.store import AlreadyExists, NotFound
+
+
+def simple_pcs(name="web", replicas=1):
+    return PodCliqueSet(
+        metadata=ObjectMeta(name=name),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplateSpec(
+                cliques=[
+                    PodCliqueTemplateSpec(
+                        name="fe",
+                        spec=PodCliqueSpec(
+                            replicas=2,
+                            pod_spec=PodSpec(
+                                containers=[
+                                    Container(name="c", resources={"cpu": 1.0})
+                                ]
+                            ),
+                        ),
+                    )
+                ]
+            ),
+        ),
+    )
+
+
+def make_pod(name, node="", gates=(), wait_for="", pclq=""):
+    labels = {constants.LABEL_PODCLIQUE: pclq} if pclq else {}
+    ann = {constants.ANNOTATION_WAIT_FOR: wait_for} if wait_for else {}
+    pod = Pod(
+        metadata=ObjectMeta(name=name, labels=labels, annotations=ann),
+        spec=PodSpec(
+            containers=[Container(name="c", resources={"cpu": 1.0})],
+            scheduling_gates=list(gates),
+        ),
+    )
+    pod.node_name = node
+    return pod
+
+
+class TestStore:
+    def test_create_get_versioning(self):
+        c = Cluster(nodes=make_nodes(4))
+        pcs = c.store.create(simple_pcs())
+        assert pcs.metadata.uid and pcs.metadata.generation == 1
+        # admission ran: defaults applied
+        assert pcs.spec.template.termination_delay == 4 * 3600
+        with pytest.raises(AlreadyExists):
+            c.store.create(simple_pcs())
+
+    def test_admission_rejects_invalid(self):
+        from grove_tpu.api import ValidationError
+
+        c = Cluster()
+        bad = simple_pcs()
+        bad.spec.template.cliques = []
+        with pytest.raises(ValidationError):
+            c.store.create(bad)
+        assert c.store.get("PodCliqueSet", "default", "web") is None
+
+    def test_generation_bumps_only_on_spec_change(self):
+        c = Cluster()
+        pcs = c.store.create(simple_pcs())
+        pcs.metadata.labels["x"] = "y"
+        pcs = c.store.update(pcs)
+        assert pcs.metadata.generation == 1
+        pcs.spec.replicas = 3
+        pcs = c.store.update(pcs)
+        assert pcs.metadata.generation == 2
+        # status write never bumps generation
+        pcs.status.replicas = 3
+        pcs = c.store.update_status(pcs)
+        assert pcs.metadata.generation == 2
+
+    def test_finalizer_gated_delete(self):
+        c = Cluster()
+        c.store.create(simple_pcs())
+        c.store.add_finalizer("PodCliqueSet", "default", "web",
+                              constants.FINALIZER_PCS)
+        c.store.delete("PodCliqueSet", "default", "web")
+        obj = c.store.get("PodCliqueSet", "default", "web")
+        assert obj is not None and obj.metadata.deletion_timestamp is not None
+        c.store.remove_finalizer("PodCliqueSet", "default", "web",
+                                 constants.FINALIZER_PCS)
+        assert c.store.get("PodCliqueSet", "default", "web") is None
+        types = [e.type for e in c.store.events_since(0)
+                 if e.kind == "PodCliqueSet"]
+        assert types[-1] == "Deleted"
+
+    def test_orphan_collection(self):
+        c = Cluster()
+        owner = c.store.create(simple_pcs())
+        pod = make_pod("p1")
+        pod.metadata.owner_references = [
+            OwnerReference(kind="PodCliqueSet", name="web",
+                           uid=owner.metadata.uid)
+        ]
+        c.store.create(pod)
+        assert c.store.collect_orphans() == 0
+        c.store.delete("PodCliqueSet", "default", "web")
+        assert c.store.collect_orphans() == 1
+        assert c.store.get(Pod.KIND, "default", "p1") is None
+
+    def test_events_since(self):
+        c = Cluster()
+        seq0 = c.store.last_seq
+        c.store.create(simple_pcs())
+        evs = c.store.events_since(seq0)
+        assert [e.type for e in evs] == ["Added"]
+        assert c.store.events_since(c.store.last_seq) == []
+
+    def test_not_found(self):
+        c = Cluster()
+        with pytest.raises(NotFound):
+            c.store.delete("Pod", "default", "nope")
+
+
+class TestKubelet:
+    def test_gated_pod_stays_pending(self):
+        c = Cluster(nodes=make_nodes(2))
+        c.store.create(make_pod("p", node="node-0",
+                                gates=[constants.PODGANG_PENDING_CREATION_GATE]))
+        c.kubelet.run_to_quiesce()
+        assert c.store.get(Pod.KIND, "default", "p").status.phase == PodPhase.PENDING
+
+    def test_bound_pod_runs_and_readies(self):
+        c = Cluster(nodes=make_nodes(2))
+        c.store.create(make_pod("p", node="node-0"))
+        c.kubelet.run_to_quiesce()
+        pod = c.store.get(Pod.KIND, "default", "p")
+        assert pod.status.phase == PodPhase.RUNNING
+        assert pod.status.ready and pod.status.ever_started
+
+    def test_startup_barrier(self):
+        c = Cluster(nodes=make_nodes(2))
+        c.store.create(make_pod("leader-0", node="node-0", pclq="leader"))
+        c.store.create(make_pod("worker-0", node="node-1", pclq="worker",
+                                wait_for="leader:1"))
+        # worker cannot ready before leader
+        c.kubelet.tick()
+        worker = c.store.get(Pod.KIND, "default", "worker-0")
+        assert not worker.status.ready
+        c.kubelet.run_to_quiesce()
+        leader = c.store.get(Pod.KIND, "default", "leader-0")
+        worker = c.store.get(Pod.KIND, "default", "worker-0")
+        assert leader.status.ready and worker.status.ready
+
+    def test_fail_pod(self):
+        c = Cluster(nodes=make_nodes(1))
+        c.store.create(make_pod("p", node="node-0"))
+        c.kubelet.run_to_quiesce()
+        c.kubelet.fail_pod("default", "p")
+        pod = c.store.get(Pod.KIND, "default", "p")
+        assert pod.status.phase == PodPhase.FAILED and not pod.status.ready
+        c.kubelet.run_to_quiesce()
+        assert c.store.get(Pod.KIND, "default", "p").status.phase == PodPhase.FAILED
+
+
+class TestClusterFacade:
+    def test_snapshot_with_usage_and_cordon(self):
+        c = Cluster(nodes=make_nodes(8, racks_per_block=2, hosts_per_rack=2))
+        c.store.create(make_pod("p", node="node-0"))
+        c.kubelet.run_to_quiesce()
+        c.cordon("node-1")
+        snap = c.topology_snapshot()
+        assert snap.num_nodes == 8
+        ci = snap.resource_names.index("cpu")
+        assert snap.free[0, ci] == snap.capacity[0, ci] - 1.0
+        assert not snap.schedulable[1]
+        # levels inferred from inventory labels: block, rack, host
+        assert snap.num_levels == 3
+
+    def test_pod_demand_fn(self):
+        c = Cluster(nodes=make_nodes(1))
+        c.store.create(make_pod("p"))
+        fn = c.pod_demand_fn(["cpu", "memory", "tpu"])
+        assert list(fn("default", "p")) == [1.0, 0.0, 0.0]
+        assert fn("default", "missing") is None
