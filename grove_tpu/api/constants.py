@@ -20,6 +20,10 @@ LABEL_PCSG = "grove.io/podcliquescalinggroup"
 LABEL_PCSG_REPLICA_INDEX = "grove.io/podcliquescalinggroup-replica-index"
 LABEL_POD_TEMPLATE_HASH = "grove.io/pod-template-hash"
 LABEL_POD_INDEX = "grove.io/pod-index"
+# Which PCS clique template a PodClique instantiates. Needed because clique
+# names may themselves contain hyphens, so the template name cannot be
+# recovered from the PodClique FQN by splitting.
+LABEL_CLIQUE_TEMPLATE = "grove.io/clique-template-name"
 
 # Component values for LABEL_COMPONENT.
 COMPONENT_HEADLESS_SERVICE = "pcs-headless-service"

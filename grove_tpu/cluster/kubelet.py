@@ -9,9 +9,16 @@ pod (constants.ANNOTATION_WAIT_FOR, written by the pod component exactly
 where the reference injects the init container) that the kubelet checks on
 every tick — same observable semantics, no container runtime.
 
-Fault injection for the E2E suites: fail_pod() (container crash; pod goes
-NotReady/Failed) mirrors the reference E2E's node-cordon + pod-kill fault
-model.
+Fault injection for the E2E suites (the reference E2E uses node cordons +
+pod kills as its fault model):
+  crash_pod  — container crash: pod stays bound and Running but NotReady
+               with restart_count++ (CrashLoopBackOff shape). This is what
+               the reference's "started but never crashed" healthiness test
+               (podclique/reconcilestatus.go:176-225) keys on, and what
+               drives MinAvailableBreached -> gang termination.
+  evict_pod  — pod-level failure (node eviction/OOM): phase Failed, capacity
+               released; the pod component replaces the pod.
+  recover_pod— crashed containers come back; pod turns Ready again.
 """
 
 from __future__ import annotations
@@ -37,27 +44,54 @@ def parse_wait_for(value: str) -> list[tuple[str, int]]:
 class SimKubelet:
     def __init__(self, store: ObjectStore):
         self.store = store
-        self._failed: set[tuple[str, str]] = set()
+        # keyed by pod UID: a replacement pod reusing a hole-filled NAME
+        # must start clean, exactly like a fresh pod in a real cluster
+        self._crashed: set[str] = set()
 
-    def fail_pod(self, namespace: str, name: str) -> None:
-        """Crash the pod's containers: NotReady + Failed phase until the
-        controller replaces it."""
+    def crash_pod(self, namespace: str, name: str) -> None:
+        """Container crash: pod stays bound/Running but NotReady until
+        recover_pod(); restart_count marks it unhealthy for MinAvailable."""
         pod = self.store.get(Pod.KIND, namespace, name)
         if pod is None:
             return
-        self._failed.add((namespace, name))
-        pod.status.phase = PodPhase.FAILED
+        self._crashed.add(pod.metadata.uid)
         pod.status.ready = False
         pod.status.restart_count += 1
         self.store.update_status(pod)
 
+    def recover_pod(self, namespace: str, name: str) -> None:
+        pod = self.store.get(Pod.KIND, namespace, name)
+        if pod is not None:
+            self._crashed.discard(pod.metadata.uid)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Pod-level failure: Failed phase, capacity released; the pod
+        component replaces it."""
+        pod = self.store.get(Pod.KIND, namespace, name)
+        if pod is None:
+            return
+        pod.status.phase = PodPhase.FAILED
+        pod.status.ready = False
+        self.store.update_status(pod)
+
     def tick(self) -> int:
         """Advance every bound pod one lifecycle step; returns number of
-        status changes (0 = kubelet quiescent)."""
+        status changes (0 = kubelet quiescent).
+
+        Barrier checks read the readiness snapshot taken at tick start, so
+        readiness propagates one dependency hop per tick — without this, a
+        whole startsAfter chain would cascade to ready within one tick,
+        which no real cluster does (informer propagation delay)."""
         changes = 0
+        ready_at_tick_start = {
+            (p.metadata.namespace, p.metadata.name)
+            for p in self.store.list(Pod.KIND)
+            if p.status.ready
+        }
         for pod in self.store.list(Pod.KIND):
-            key = (pod.metadata.namespace, pod.metadata.name)
-            if key in self._failed and pod.status.phase == PodPhase.FAILED:
+            if pod.metadata.uid in self._crashed:
+                continue  # stays NotReady until recover_pod
+            if pod.status.phase == PodPhase.FAILED:
                 continue
             if not pod.node_name or pod.spec.scheduling_gates:
                 continue
@@ -70,7 +104,7 @@ class SimKubelet:
                 changes += 1
                 continue
             if pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
-                if self._barrier_open(pod):
+                if self._barrier_open(pod, ready_at_tick_start):
                     pod.status.ready = True
                     pod.status.ever_started = True
                     self.store.update_status(pod)
@@ -82,8 +116,9 @@ class SimKubelet:
             if self.tick() == 0:
                 return
 
-    def _barrier_open(self, pod) -> bool:
-        """initc equivalent: all parent cliques have >= min ready pods."""
+    def _barrier_open(self, pod, ready_set: set[tuple[str, str]]) -> bool:
+        """initc equivalent: all parent cliques have >= min ready pods (as
+        of tick start)."""
         spec = pod.metadata.annotations.get(constants.ANNOTATION_WAIT_FOR, "")
         for pclq_fqn, min_available in parse_wait_for(spec):
             ready = sum(
@@ -93,7 +128,7 @@ class SimKubelet:
                     namespace=pod.metadata.namespace,
                     labels={constants.LABEL_PODCLIQUE: pclq_fqn},
                 )
-                if p.status.ready
+                if (p.metadata.namespace, p.metadata.name) in ready_set
             )
             if ready < min_available:
                 return False

@@ -1,0 +1,6 @@
+"""Reconcilers + controller runtime (the operator's control plane)."""
+
+from .runtime import ControllerManager, Reconciler, Request, Result
+from .harness import Harness
+
+__all__ = ["ControllerManager", "Harness", "Reconciler", "Request", "Result"]

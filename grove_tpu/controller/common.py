@@ -1,0 +1,84 @@
+"""Shared controller helpers: hashes, labels, owner refs, pod categorization.
+
+Parity targets: ComputeHash over PodTemplateSpecs (reference
+internal/utils kubernetes helpers), the grove.io label sets each component
+stamps (api/common/labels.go), and pod categorization for status flows
+(internal/utils/kubernetes/pod.go:183).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import asdict
+from typing import Any
+
+from ..api import constants
+from ..api.meta import ObjectMeta, OwnerReference
+from ..api.types import Pod, PodCliqueSet, PodPhase
+
+
+def stable_hash(obj: Any) -> str:
+    """Deterministic short hash of a dataclass/dict tree (FNV-of-SpecHash
+    equivalent of the reference's ComputeHash)."""
+    data = asdict(obj) if hasattr(obj, "__dataclass_fields__") else obj
+    payload = json.dumps(data, sort_keys=True, default=str)
+    return hashlib.sha1(payload.encode()).hexdigest()[:10]
+
+
+def pcs_generation_hash(pcs: PodCliqueSet) -> str:
+    """Hash of all clique pod templates — a change starts a rolling update
+    (reference reconcilespec.go:109-122)."""
+    return stable_hash(
+        {c.name: asdict(c.spec.pod_spec) for c in pcs.spec.template.cliques}
+    )
+
+
+def owner_ref(obj: Any) -> OwnerReference:
+    return OwnerReference(
+        kind=obj.KIND, name=obj.metadata.name, uid=obj.metadata.uid
+    )
+
+
+def base_labels(pcs_name: str) -> dict[str, str]:
+    return {
+        constants.LABEL_MANAGED_BY: constants.LABEL_MANAGED_BY_VALUE,
+        constants.LABEL_PART_OF: pcs_name,
+    }
+
+
+def is_pod_active(pod: Pod) -> bool:
+    return (
+        pod.metadata.deletion_timestamp is None
+        and pod.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+    )
+
+
+def is_pod_healthy(pod: Pod) -> bool:
+    """Counts toward MinAvailable: ready, or started and never crashed
+    (reference podclique/reconcilestatus.go:176-225)."""
+    if not is_pod_active(pod):
+        return False
+    if pod.status.ready:
+        return True
+    return (
+        pod.status.phase == PodPhase.RUNNING
+        and pod.status.ever_started
+        and pod.status.restart_count == 0
+    )
+
+
+def new_meta(
+    name: str,
+    namespace: str,
+    owner: Any,
+    labels: dict[str, str],
+    annotations: dict[str, str] | None = None,
+) -> ObjectMeta:
+    return ObjectMeta(
+        name=name,
+        namespace=namespace,
+        labels=dict(labels),
+        annotations=dict(annotations or {}),
+        owner_references=[owner_ref(owner)],
+    )

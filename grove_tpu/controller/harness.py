@@ -1,0 +1,55 @@
+"""Harness: cluster + all controllers + kubelet wired into one loop.
+
+The user/test entry point equivalent to running the operator binary against
+a cluster (operator/cmd/main.go): register the three reconcilers + the gang
+scheduler on the manager, then settle() drives controllers and kubelet to a
+fixpoint. advance() moves the virtual clock (firing requeues like the gang
+termination timer) and re-settles.
+"""
+
+from __future__ import annotations
+
+from ..api.types import Node, PodCliqueSet
+from ..cluster.cluster import Cluster
+from .podclique import PodCliqueReconciler
+from .podcliqueset import PodCliqueSetReconciler
+from .podcliquescalinggroup import PCSGReconciler
+from .runtime import ControllerManager
+from .scheduler import GangScheduler
+
+
+class Harness:
+    def __init__(self, nodes: list[Node] | None = None,
+                 cluster: Cluster | None = None, engine_cls=None):
+        self.cluster = cluster or Cluster(nodes=nodes)
+        self.store = self.cluster.store
+        self.clock = self.cluster.clock
+        self.kubelet = self.cluster.kubelet
+        self.manager = ControllerManager(self.store)
+        self.manager.register(PodCliqueSetReconciler(self.store))
+        self.manager.register(PCSGReconciler(self.store))
+        self.manager.register(PodCliqueReconciler(self.store))
+        kwargs = {"engine_cls": engine_cls} if engine_cls else {}
+        self.scheduler = GangScheduler(self.cluster, **kwargs)
+        self.manager.register(self.scheduler)
+
+    def apply(self, pcs: PodCliqueSet):
+        return self.store.create(pcs)
+
+    def settle(self, max_rounds: int = 64) -> None:
+        """Controllers + kubelet to fixpoint: reconcile until quiescent,
+        tick the kubelet, repeat until neither produces changes."""
+        for _ in range(max_rounds):
+            self.manager.settle()
+            if self.kubelet.tick() == 0:
+                # one more manager pass in case final kubelet writes queued
+                self.manager.settle()
+                if self.kubelet.tick() == 0:
+                    return
+        raise RuntimeError("harness did not settle")
+
+    def advance(self, seconds: float) -> None:
+        """Advance the virtual clock past timers (gang termination,
+        scheduler retries) and settle."""
+        self.clock.advance(seconds)
+        self.settle()

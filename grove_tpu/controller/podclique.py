@@ -1,0 +1,372 @@
+"""PodClique reconciler: owns Pods.
+
+Mirrors operator/internal/controller/podclique/ + components/pod/: the pod
+component computes an expectations-corrected diff, creates pods SCHEDULING
+GATED (grove.io/podgang-pending-creation, pod.go:68,162) with hole-filling
+hostname indices (index/tracker.go), Grove env vars (pod.go:227-254),
+hostname/subdomain for per-replica DNS (pod.go:257-264) and the
+startup-order dependency annotation (the init-container injection point,
+initcontainer.go:51-158). Gate removal (syncflow.go:242-394): a pod's gate
+drops only once the pod is referenced in its PodGang; pods of SCALED gangs
+additionally wait until the BASE PodGang reports scheduled.
+
+Status flow (reconcilestatus.go): replica counts incl. scheduled/gated,
+PodCliqueScheduled, and MinAvailableBreached — where a pod that started
+and never crashed still counts as healthy (:176-225).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..api import constants, naming
+from ..api.meta import get_condition, set_condition
+from ..api.podgang import PodGang
+from ..api.types import (
+    CliqueStartupType,
+    Pod,
+    PodClique,
+    PodCliqueSet,
+    PodPhase,
+)
+from ..cluster.store import Event, ObjectStore
+from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
+from .runtime import Request, Result
+
+KIND = PodClique.KIND
+
+
+class PodCliqueReconciler:
+    name = "podclique"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def map_event(self, event: Event) -> list[Request]:
+        if event.kind == KIND:
+            return [Request(event.namespace, event.name)]
+        if event.kind == Pod.KIND:
+            pclq = event.obj.metadata.labels.get(constants.LABEL_PODCLIQUE)
+            if pclq:
+                return [Request(event.namespace, pclq)]
+        if event.kind == PodGang.KIND:
+            # gang creation/scheduling unblocks gate removal for every
+            # clique of the same PodCliqueSet (register.go:49-120)
+            owner = event.obj.metadata.labels.get(constants.LABEL_PART_OF)
+            if owner:
+                return [
+                    Request(event.namespace, p.metadata.name)
+                    for p in self.store.list(
+                        KIND,
+                        namespace=event.namespace,
+                        labels={constants.LABEL_PART_OF: owner},
+                    )
+                ]
+        return []
+
+    def reconcile(self, request: Request) -> Result:
+        pclq = self.store.get(KIND, request.namespace, request.name)
+        if pclq is None:
+            return Result()
+        if pclq.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pclq)
+        self.store.add_finalizer(
+            KIND, request.namespace, request.name, constants.FINALIZER_PCLQ
+        )
+        self._sync_pods(pclq)
+        self._reconcile_status(pclq)
+        return Result()
+
+    def _reconcile_delete(self, pclq: PodClique) -> Result:
+        ns = pclq.metadata.namespace
+        for pod in self._owned_pods(pclq):
+            if pod.metadata.deletion_timestamp is None:
+                self.store.delete(Pod.KIND, ns, pod.metadata.name)
+        self.store.remove_finalizer(
+            KIND, ns, pclq.metadata.name, constants.FINALIZER_PCLQ
+        )
+        return Result()
+
+    def _owned_pods(self, pclq: PodClique) -> list[Pod]:
+        return self.store.list(
+            Pod.KIND,
+            namespace=pclq.metadata.namespace,
+            labels={constants.LABEL_PODCLIQUE: pclq.metadata.name},
+        )
+
+    # -- pod component (components/pod/) -----------------------------------
+    def _sync_pods(self, pclq: PodClique) -> None:
+        ns = pclq.metadata.namespace
+        pods = self._owned_pods(pclq)
+        # replace evicted/failed pods (categorization pod.go:183)
+        active: list[Pod] = []
+        for pod in pods:
+            if pod.status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                self.store.delete(Pod.KIND, ns, pod.metadata.name)
+            elif pod.metadata.deletion_timestamp is None:
+                active.append(pod)
+        want = pclq.spec.replicas
+        if len(active) < want:
+            self._create_pods(pclq, active, want - len(active))
+        elif len(active) > want:
+            self._delete_excess(pclq, active, len(active) - want)
+        self._remove_gates(pclq)
+
+    def _create_pods(self, pclq: PodClique, active: list[Pod], count: int) -> None:
+        """Hole-filling indices (index/tracker.go:37-60) + gated creation."""
+        used = {
+            int(p.metadata.labels.get(constants.LABEL_POD_INDEX, -1)) for p in active
+        }
+        free_indices = [i for i in range(pclq.spec.replicas + len(active) + count)
+                        if i not in used][:count]
+        pcs = self._owner_pcs(pclq)
+        for idx in free_indices:
+            pod = self._build_pod(pclq, pcs, idx)
+            self.store.create(pod)
+
+    def _build_pod(self, pclq: PodClique, pcs: PodCliqueSet | None, idx: int) -> Pod:
+        ns = pclq.metadata.namespace
+        pod_name = naming.pod_name(pclq.metadata.name, idx)
+        pcs_name = pclq.metadata.labels.get(constants.LABEL_PART_OF, "")
+        replica = pclq.metadata.labels.get(constants.LABEL_PCS_REPLICA_INDEX, "0")
+        gang = pclq.metadata.labels.get(constants.LABEL_PODGANG, "")
+        labels = {
+            k: v
+            for k, v in pclq.metadata.labels.items()
+            if k.startswith("grove.io/") or k.startswith("app.kubernetes.io/")
+        }
+        labels[constants.LABEL_PODCLIQUE] = pclq.metadata.name
+        labels[constants.LABEL_POD_INDEX] = str(idx)
+        labels[constants.LABEL_POD_TEMPLATE_HASH] = stable_hash(pclq.spec.pod_spec)
+        annotations = {}
+        deps = self._startup_deps(pclq, pcs)
+        if deps:
+            annotations[constants.ANNOTATION_WAIT_FOR] = ",".join(
+                f"{fqn}:{minav}" for fqn, minav in deps
+            )
+        spec = copy.deepcopy(pclq.spec.pod_spec)
+        spec.scheduling_gates = [constants.PODGANG_PENDING_CREATION_GATE]
+        spec.hostname = pod_name
+        spec.subdomain = naming.headless_service_name(pcs_name, int(replica))
+        env = {
+            constants.ENV_PCS_NAME: pcs_name,
+            constants.ENV_PCS_INDEX: replica,
+            constants.ENV_PCLQ_NAME: pclq.metadata.name,
+            constants.ENV_PCLQ_POD_INDEX: str(idx),
+            constants.ENV_HEADLESS_SERVICE: naming.headless_service_address(
+                pcs_name, int(replica), ns
+            ),
+        }
+        pcsg = pclq.metadata.labels.get(constants.LABEL_PCSG)
+        if pcsg:
+            env[constants.ENV_PCSG_NAME] = pcsg
+            env[constants.ENV_PCSG_INDEX] = pclq.metadata.labels.get(
+                constants.LABEL_PCSG_REPLICA_INDEX, "0"
+            )
+        for container in spec.containers:
+            container.env.update(env)
+        return Pod(
+            metadata=new_meta(pod_name, ns, pclq, labels, annotations),
+            spec=spec,
+        )
+
+    def _startup_deps(
+        self, pclq: PodClique, pcs: PodCliqueSet | None
+    ) -> list[tuple[str, int]]:
+        """Parent-clique dependencies -> (pclq FQN, minAvailable) pairs —
+        what the reference turns into grove-initc args
+        (initcontainer.go:144-160). FQN resolution follows
+        GenerateDependencyNamesForBasePodGang (component/utils/
+        podcliquescalinggroup.go:70-83): a parent inside a PCSG resolves to
+        that group's base replicas [0, minAvailable); a standalone parent to
+        '<pcs>-<i>-<parent>'. Pods of SCALED PCSG replicas only order within
+        their own replica and skip cross-group parents
+        (pcsg podclique.go:391-408)."""
+        if pcs is None:
+            return []
+        tmpl = pcs.spec.template
+        my_template = self._template_name(pclq)
+        by_name = {c.name: c for c in tmpl.cliques}
+        order = [c.name for c in tmpl.cliques]
+        if my_template not in by_name:
+            return []
+        if tmpl.startup_type == CliqueStartupType.IN_ORDER:
+            pos = order.index(my_template)
+            parents = [order[pos - 1]] if pos > 0 else []
+        elif tmpl.startup_type == CliqueStartupType.EXPLICIT:
+            parents = list(by_name[my_template].spec.starts_after)
+        else:
+            return []
+        if not parents:
+            return []
+        pcs_name = pcs.metadata.name
+        pcs_replica = int(
+            pclq.metadata.labels.get(constants.LABEL_PCS_REPLICA_INDEX, 0)
+        )
+        sg_of = {
+            cn: sg
+            for sg in tmpl.pod_clique_scaling_group_configs
+            for cn in sg.clique_names
+        }
+        my_sg = sg_of.get(my_template)
+        my_sg_replica = int(
+            pclq.metadata.labels.get(constants.LABEL_PCSG_REPLICA_INDEX, -1)
+        )
+        scaled = (
+            my_sg is not None
+            and my_sg_replica >= (my_sg.min_available or 1)
+        )
+        deps: list[tuple[str, int]] = []
+        for parent in parents:
+            min_avail = by_name[parent].spec.min_available or 1
+            if scaled:
+                # scaled replica: order only within its own gang instance
+                if my_sg is not None and parent in my_sg.clique_names:
+                    pcsg_fqn = naming.pcsg_name(pcs_name, pcs_replica, my_sg.name)
+                    deps.append(
+                        (
+                            naming.podclique_name(pcsg_fqn, my_sg_replica, parent),
+                            min_avail,
+                        )
+                    )
+                continue
+            parent_sg = sg_of.get(parent)
+            if parent_sg is not None:
+                pcsg_fqn = naming.pcsg_name(pcs_name, pcs_replica, parent_sg.name)
+                for j in range(parent_sg.min_available or 1):
+                    deps.append(
+                        (naming.podclique_name(pcsg_fqn, j, parent), min_avail)
+                    )
+            else:
+                deps.append(
+                    (
+                        naming.podclique_name(pcs_name, pcs_replica, parent),
+                        min_avail,
+                    )
+                )
+        return deps
+
+    def _template_name(self, pclq: PodClique) -> str:
+        """Clique template name from its label (names may contain hyphens,
+        so the FQN cannot be split reliably)."""
+        return pclq.metadata.labels.get(constants.LABEL_CLIQUE_TEMPLATE, "")
+
+    def _owner_prefix(self, pclq: PodClique) -> str:
+        """'<owner>-<replica>' prefix: strip '-<template>' off the FQN."""
+        template = self._template_name(pclq)
+        name = pclq.metadata.name
+        if template and name.endswith(f"-{template}"):
+            return name[: -(len(template) + 1)]
+        return name.rsplit("-", 1)[0]
+
+    def _owner_pcs(self, pclq: PodClique) -> PodCliqueSet | None:
+        pcs_name = pclq.metadata.labels.get(constants.LABEL_PART_OF)
+        if not pcs_name:
+            return None
+        return self.store.get(
+            PodCliqueSet.KIND, pclq.metadata.namespace, pcs_name
+        )
+
+    def _delete_excess(self, pclq: PodClique, active: list[Pod], count: int) -> None:
+        """DeletionSorter: prefer gated, then not-ready, then highest index
+        (components/pod syncflow.go:206-228)."""
+
+        def sort_key(p: Pod):
+            return (
+                0 if p.spec.scheduling_gates else 1,
+                0 if not p.status.ready else 1,
+                -int(p.metadata.labels.get(constants.LABEL_POD_INDEX, 0)),
+            )
+
+        for pod in sorted(active, key=sort_key)[:count]:
+            self.store.delete(Pod.KIND, pclq.metadata.namespace, pod.metadata.name)
+
+    def _remove_gates(self, pclq: PodClique) -> None:
+        """syncflow.go:242-394. Base-gang pods ungate once referenced in
+        their PodGang; scaled-gang pods additionally require the base gang
+        to be scheduled."""
+        ns = pclq.metadata.namespace
+        for pod in self._owned_pods(pclq):
+            if not pod.spec.scheduling_gates:
+                continue
+            gang_name = pod.metadata.labels.get(constants.LABEL_PODGANG)
+            if not gang_name:
+                continue
+            gang = self.store.get(PodGang.KIND, ns, gang_name)
+            if gang is None:
+                continue
+            refs = {
+                ref.name
+                for group in gang.spec.pod_groups
+                for ref in group.pod_references
+            }
+            if pod.metadata.name not in refs:
+                continue  # not yet referenced -> keep gated (:261)
+            base_name = pod.metadata.labels.get(constants.LABEL_BASE_PODGANG)
+            if base_name:
+                base = self.store.get(PodGang.KIND, ns, base_name)
+                if base is None or not _is_scheduled(base):
+                    continue  # scaled gang waits for base (:306-345)
+            pod.spec.scheduling_gates = []
+            self.store.update(pod)
+
+    # -- status flow (reconcilestatus.go) ----------------------------------
+    def _reconcile_status(self, pclq: PodClique) -> None:
+        from dataclasses import asdict
+
+        fresh = self.store.get(KIND, pclq.metadata.namespace, pclq.metadata.name)
+        if fresh is None:
+            return
+        status = fresh.status
+        before = asdict(status)
+        pods = [p for p in self._owned_pods(fresh) if is_pod_active(p)]
+        status.replicas = len(pods)
+        status.ready_replicas = sum(1 for p in pods if p.status.ready)
+        status.scheduled_replicas = sum(1 for p in pods if p.node_name)
+        status.schedule_gated_replicas = sum(
+            1 for p in pods if p.spec.scheduling_gates
+        )
+        status.observed_generation = fresh.metadata.generation
+        status.selector = f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
+        status.current_pod_template_hash = stable_hash(fresh.spec.pod_spec)
+        min_avail = fresh.spec.min_available or fresh.spec.replicas
+        now = self.store.clock.now()
+        scheduled_enough = status.scheduled_replicas >= min_avail
+        set_condition(
+            status.conditions,
+            constants.CONDITION_PODCLIQUE_SCHEDULED,
+            "True" if scheduled_enough else "False",
+            reason=(
+                constants.REASON_SUFFICIENT_SCHEDULED_PODS
+                if scheduled_enough
+                else constants.REASON_INSUFFICIENT_SCHEDULED_PODS
+            ),
+            now=now,
+        )
+        # Breach only counts once the gang actually scheduled — an
+        # unschedulable fresh workload must not tick toward termination
+        # (gangterminate guards on PodCliqueScheduled in the reference).
+        healthy = sum(1 for p in pods if is_pod_healthy(p))
+        breached = scheduled_enough and healthy < min_avail
+        set_condition(
+            status.conditions,
+            constants.CONDITION_MIN_AVAILABLE_BREACHED,
+            "True" if breached else "False",
+            reason=(
+                constants.REASON_INSUFFICIENT_READY_PODS
+                if breached
+                else constants.REASON_SUFFICIENT_READY_PODS
+            ),
+            now=now,
+        )
+        if asdict(status) != before:
+            self.store.update_status(fresh)
+
+
+def _is_scheduled(gang: PodGang) -> bool:
+    from ..api.podgang import PodGangConditionType
+
+    cond = get_condition(
+        gang.status.conditions, PodGangConditionType.SCHEDULED.value
+    )
+    return cond is not None and cond.status == "True"

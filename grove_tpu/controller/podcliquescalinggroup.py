@@ -1,0 +1,187 @@
+"""PodCliqueScalingGroup reconciler.
+
+Mirrors operator/internal/controller/podcliquescalinggroup/: per PCSG
+replica j it creates one PodClique per member clique, named
+'<pcsgFQN>-<j>-<clique>' with labels carrying the PCSG replica index and —
+for replicas beyond minAvailable — the grove.io/base-podgang label that
+makes the pod component hold scaled-gang pods until the base gang is
+scheduled (components/podclique/podclique.go:287,422-443). Scale-in
+deletes the highest replica indices first. Status aggregates per-replica
+scheduled/available and raises MinAvailableBreached when fewer than
+minAvailable replicas are healthy (reconcilestatus.go:83-207).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import asdict
+
+from ..api import constants, naming
+from ..api.meta import get_condition, set_condition
+from ..api.types import (
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+)
+from ..cluster.store import Event, ObjectStore
+from .common import base_labels, new_meta
+from .runtime import Request, Result
+
+KIND = PodCliqueScalingGroup.KIND
+
+
+class PCSGReconciler:
+    name = "podcliquescalinggroup"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def map_event(self, event: Event) -> list[Request]:
+        if event.kind == KIND:
+            return [Request(event.namespace, event.name)]
+        if event.kind == PodClique.KIND:
+            pcsg = event.obj.metadata.labels.get(constants.LABEL_PCSG)
+            if pcsg:
+                return [Request(event.namespace, pcsg)]
+        return []
+
+    def reconcile(self, request: Request) -> Result:
+        pcsg = self.store.get(KIND, request.namespace, request.name)
+        if pcsg is None:
+            return Result()
+        if pcsg.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pcsg)
+        self.store.add_finalizer(
+            KIND, request.namespace, request.name, constants.FINALIZER_PCSG
+        )
+        self._sync_podcliques(pcsg)
+        self._reconcile_status(pcsg)
+        return Result()
+
+    def _reconcile_delete(self, pcsg: PodCliqueScalingGroup) -> Result:
+        ns = pcsg.metadata.namespace
+        for pclq in self._owned_pclqs(pcsg):
+            if pclq.metadata.deletion_timestamp is None:
+                self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
+        self.store.remove_finalizer(
+            KIND, ns, pcsg.metadata.name, constants.FINALIZER_PCSG
+        )
+        return Result()
+
+    def _owned_pclqs(self, pcsg: PodCliqueScalingGroup) -> list[PodClique]:
+        return self.store.list(
+            PodClique.KIND,
+            namespace=pcsg.metadata.namespace,
+            labels={constants.LABEL_PCSG: pcsg.metadata.name},
+        )
+
+    def _owner_pcs(self, pcsg: PodCliqueScalingGroup) -> PodCliqueSet | None:
+        name = pcsg.metadata.labels.get(constants.LABEL_PART_OF)
+        if not name:
+            return None
+        return self.store.get(PodCliqueSet.KIND, pcsg.metadata.namespace, name)
+
+    def _sync_podcliques(self, pcsg: PodCliqueScalingGroup) -> None:
+        pcs = self._owner_pcs(pcsg)
+        if pcs is None:
+            return
+        ns = pcsg.metadata.namespace
+        fqn = pcsg.metadata.name
+        pcs_name = pcs.metadata.name
+        pcs_replica = pcsg.metadata.labels.get(constants.LABEL_PCS_REPLICA_INDEX, "0")
+        templates = {c.name: c for c in pcs.spec.template.cliques}
+        min_avail = pcsg.spec.min_available
+        expected: dict[str, tuple[int, str]] = {}
+        for j in range(pcsg.spec.replicas):
+            for clique_name in pcsg.spec.clique_names:
+                expected[naming.podclique_name(fqn, j, clique_name)] = (j, clique_name)
+        comp_labels = dict(
+            base_labels(pcs_name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_PCSG_PODCLIQUE},
+        )
+        for pclq_name, (j, clique_name) in expected.items():
+            if self.store.get(PodClique.KIND, ns, pclq_name) is not None:
+                continue
+            template = templates.get(clique_name)
+            if template is None:
+                continue
+            gang = naming.podgang_name_for_pcsg_replica(
+                pcs_name, int(pcs_replica), fqn, j, min_avail
+            )
+            labels = dict(
+                comp_labels,
+                **{
+                    constants.LABEL_PCS_REPLICA_INDEX: pcs_replica,
+                    constants.LABEL_PCSG: fqn,
+                    constants.LABEL_PCSG_REPLICA_INDEX: str(j),
+                    constants.LABEL_PODGANG: gang,
+                    constants.LABEL_CLIQUE_TEMPLATE: clique_name,
+                },
+            )
+            if j >= min_avail:  # scaled replica -> gate on base gang
+                labels[constants.LABEL_BASE_PODGANG] = naming.base_podgang_name(
+                    pcs_name, int(pcs_replica)
+                )
+            self.store.create(
+                PodClique(
+                    metadata=new_meta(pclq_name, ns, pcsg, labels),
+                    spec=copy.deepcopy(template.spec),
+                )
+            )
+        # scale-in: drop highest replica indices (components/podclique/
+        # podclique.go scale-in path)
+        for pclq in self._owned_pclqs(pcsg):
+            if pclq.metadata.name not in expected:
+                self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
+
+    def _reconcile_status(self, pcsg: PodCliqueScalingGroup) -> None:
+        fresh = self.store.get(KIND, pcsg.metadata.namespace, pcsg.metadata.name)
+        if fresh is None:
+            return
+        status = fresh.status
+        before = asdict(status)
+        pclqs = self._owned_pclqs(fresh)
+        by_replica: dict[int, list[PodClique]] = {}
+        for pclq in pclqs:
+            j = int(pclq.metadata.labels.get(constants.LABEL_PCSG_REPLICA_INDEX, 0))
+            by_replica.setdefault(j, []).append(pclq)
+        scheduled = available = 0
+        for j, group in by_replica.items():
+            if len(group) < len(fresh.spec.clique_names):
+                continue
+            if all(
+                _cond_true(p, constants.CONDITION_PODCLIQUE_SCHEDULED) for p in group
+            ):
+                scheduled += 1
+                if not any(
+                    _cond_true(p, constants.CONDITION_MIN_AVAILABLE_BREACHED)
+                    for p in group
+                ):
+                    available += 1
+        status.replicas = fresh.spec.replicas
+        status.scheduled_replicas = scheduled
+        status.available_replicas = available
+        status.observed_generation = fresh.metadata.generation
+        status.selector = f"{constants.LABEL_PCSG}={fresh.metadata.name}"
+        now = self.store.clock.now()
+        breached = scheduled >= fresh.spec.min_available and (
+            available < fresh.spec.min_available
+        )
+        set_condition(
+            status.conditions,
+            constants.CONDITION_MIN_AVAILABLE_BREACHED,
+            "True" if breached else "False",
+            reason=(
+                constants.REASON_INSUFFICIENT_READY_PODS
+                if breached
+                else constants.REASON_SUFFICIENT_READY_PODS
+            ),
+            now=now,
+        )
+        if asdict(status) != before:
+            self.store.update_status(fresh)
+
+
+def _cond_true(obj, cond_type: str) -> bool:
+    cond = get_condition(obj.status.conditions, cond_type)
+    return cond is not None and cond.status == "True"

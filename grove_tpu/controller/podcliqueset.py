@@ -1,0 +1,626 @@
+"""PodCliqueSet reconciler: the root controller.
+
+Mirrors the reference's PCS reconciler structure
+(operator/internal/controller/podcliqueset/): spec flow = finalizer ->
+generation-hash bookkeeping -> ordered component sync (rbac -> headless
+services -> HPAs -> replica gang-termination -> standalone PodCliques ->
+PodCliqueScalingGroups -> PodGangs), then the status flow computing
+available/updated replicas and the TopologyLevelsUnavailable condition.
+
+The podgang component is the heart of gang semantics
+(components/podgang/syncflow.go): one BASE PodGang per PCS replica holding
+the standalone cliques plus PCSG replicas [0, minAvailable), one SCALED
+PodGang per PCSG replica beyond minAvailable, 3-level topology constraints
+(PCS->gang, PCSG->constraint group, PCLQ->pod group), and creation DEFERRED
+until every expected pod exists and carries the gang label
+(syncflow.go:435-502).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Optional
+
+from ..api import constants, naming
+from ..api.auxiliary import (
+    HorizontalPodAutoscaler,
+    HPASpec,
+    Role,
+    RoleBinding,
+    Secret,
+    Service,
+    ServiceAccount,
+)
+from ..api.meta import NamespacedName, get_condition, set_condition
+from ..api.podgang import (
+    PodGang,
+    PodGangSpec,
+    PodGroup,
+    TopologyConstraint,
+    TopologyConstraintGroupConfig,
+    TopologyPackConstraint,
+)
+from ..api.types import (
+    ClusterTopology,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupSpec,
+    PodCliqueSet,
+    PodCliqueSpec,
+    TopologyConstraintSpec,
+)
+from ..cluster.store import Event, ObjectStore
+from .common import base_labels, is_pod_active, new_meta, pcs_generation_hash
+from .runtime import Request, Result
+
+KIND = PodCliqueSet.KIND
+
+
+class PodCliqueSetReconciler:
+    name = "podcliqueset"
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    # -- watches (register.go:53-121) --------------------------------------
+    def map_event(self, event: Event) -> list[Request]:
+        if event.kind == KIND:
+            return [Request(event.namespace, event.name)]
+        if event.kind in ("PodClique", "PodCliqueScalingGroup", "Pod", "PodGang"):
+            owner = event.obj.metadata.labels.get(constants.LABEL_PART_OF)
+            if owner:
+                return [Request(event.namespace, owner)]
+        return []
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile(self, request: Request) -> Result:
+        pcs = self.store.get(KIND, request.namespace, request.name)
+        if pcs is None:
+            return Result()
+        if pcs.metadata.deletion_timestamp is not None:
+            return self._reconcile_delete(pcs)
+        self.store.add_finalizer(
+            KIND, request.namespace, request.name, constants.FINALIZER_PCS
+        )
+        requeue = self._reconcile_spec(pcs)
+        self._reconcile_status(pcs)
+        return Result(requeue_after=requeue)
+
+    # -- delete flow (reconciledelete.go) ----------------------------------
+    def _reconcile_delete(self, pcs: PodCliqueSet) -> Result:
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        labels = {constants.LABEL_PART_OF: name}
+        for kind in (
+            PodGang.KIND,
+            PodClique.KIND,
+            PodCliqueScalingGroup.KIND,
+            Pod.KIND,
+            Service.KIND,
+            HorizontalPodAutoscaler.KIND,
+            Secret.KIND,
+            RoleBinding.KIND,
+            Role.KIND,
+            ServiceAccount.KIND,
+        ):
+            for child in self.store.list(kind, namespace=ns, labels=labels):
+                if child.metadata.deletion_timestamp is None:
+                    self.store.delete(kind, ns, child.metadata.name)
+                for fin in list(child.metadata.finalizers):
+                    self.store.remove_finalizer(kind, ns, child.metadata.name, fin)
+        self.store.remove_finalizer(KIND, ns, name, constants.FINALIZER_PCS)
+        return Result()
+
+    # -- spec flow (reconcilespec.go:41-57) --------------------------------
+    def _reconcile_spec(self, pcs: PodCliqueSet) -> Optional[float]:
+        self._process_generation_hash(pcs)
+        self._sync_rbac(pcs)
+        self._sync_services(pcs)
+        self._sync_hpas(pcs)
+        requeue = self._sync_replicas(pcs)
+        self._sync_podcliques(pcs)
+        self._sync_pcsgs(pcs)
+        self._sync_podgangs(pcs)
+        return requeue
+
+    def _process_generation_hash(self, pcs: PodCliqueSet) -> None:
+        """Template-hash change detection; a change initiates a rolling
+        update (reconcilespec.go:72-122). The update orchestration itself
+        lives in updates.py."""
+        new_hash = pcs_generation_hash(pcs)
+        status = pcs.status
+        if status.current_generation_hash == "":
+            status.current_generation_hash = new_hash
+            status.observed_generation = pcs.metadata.generation
+            self.store.update_status(pcs)
+        elif status.observed_generation != pcs.metadata.generation:
+            status.observed_generation = pcs.metadata.generation
+            self.store.update_status(pcs)
+
+    # -- components --------------------------------------------------------
+    def _sync_rbac(self, pcs: PodCliqueSet) -> None:
+        """SA + Role + RoleBinding + token Secret per PCS (the identity the
+        startup-barrier watcher uses; components/{serviceaccount,role,
+        rolebinding,satokensecret}/)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        labels = base_labels(name)
+        sa_name = f"{name}-sa"
+        if self.store.get(ServiceAccount.KIND, ns, sa_name) is None:
+            self.store.create(
+                ServiceAccount(metadata=new_meta(sa_name, ns, pcs, labels))
+            )
+        role_name = f"{name}-pod-reader"
+        if self.store.get(Role.KIND, ns, role_name) is None:
+            self.store.create(Role(metadata=new_meta(role_name, ns, pcs, labels)))
+        rb_name = f"{name}-pod-reader"
+        if self.store.get(RoleBinding.KIND, ns, rb_name) is None:
+            self.store.create(
+                RoleBinding(
+                    metadata=new_meta(rb_name, ns, pcs, labels),
+                    role_name=role_name,
+                    service_account_name=sa_name,
+                )
+            )
+        secret_name = f"{name}-sa-token"
+        if self.store.get(Secret.KIND, ns, secret_name) is None:
+            self.store.create(
+                Secret(
+                    metadata=new_meta(secret_name, ns, pcs, labels),
+                    service_account_name=sa_name,
+                )
+            )
+
+    def _sync_services(self, pcs: PodCliqueSet) -> None:
+        """Headless Service per PCS replica (service.go:119-204)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        cfg = pcs.spec.template.head_less_service_config
+        expected = {
+            naming.headless_service_name(name, i): i
+            for i in range(pcs.spec.replicas)
+        }
+        labels = dict(
+            base_labels(name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_HEADLESS_SERVICE},
+        )
+        for svc_name, i in expected.items():
+            if self.store.get(Service.KIND, ns, svc_name) is None:
+                self.store.create(
+                    Service(
+                        metadata=new_meta(svc_name, ns, pcs, labels),
+                        selector={
+                            constants.LABEL_PART_OF: name,
+                            constants.LABEL_PCS_REPLICA_INDEX: str(i),
+                        },
+                        publish_not_ready_addresses=(
+                            cfg.publish_not_ready_addresses if cfg else True
+                        ),
+                    )
+                )
+        for svc in self.store.list(Service.KIND, namespace=ns, labels=labels):
+            if svc.metadata.name not in expected:
+                self.store.delete(Service.KIND, ns, svc.metadata.name)
+
+    def _sync_hpas(self, pcs: PodCliqueSet) -> None:
+        """HPA per scaled PCLQ and per scaled PCSG (hpa.go)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        labels = dict(
+            base_labels(name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_HPA},
+        )
+        expected: dict[str, HPASpec] = {}
+        for i in range(pcs.spec.replicas):
+            for clique in pcs.spec.template.cliques:
+                sc = clique.spec.scale_config
+                if sc is None:
+                    continue
+                target = naming.podclique_name(name, i, clique.name)
+                expected[naming.hpa_name(target)] = HPASpec(
+                    target_kind=PodClique.KIND,
+                    target_name=target,
+                    min_replicas=sc.min_replicas,
+                    max_replicas=sc.max_replicas,
+                    target_resource=sc.target_resource,
+                    target_utilization=sc.target_utilization,
+                )
+            for sg in pcs.spec.template.pod_clique_scaling_group_configs:
+                if sg.scale_config is None:
+                    continue
+                target = naming.pcsg_name(name, i, sg.name)
+                expected[naming.hpa_name(target)] = HPASpec(
+                    target_kind=PodCliqueScalingGroup.KIND,
+                    target_name=target,
+                    min_replicas=sg.scale_config.min_replicas,
+                    max_replicas=sg.scale_config.max_replicas,
+                    target_resource=sg.scale_config.target_resource,
+                    target_utilization=sg.scale_config.target_utilization,
+                )
+        for hpa_name, spec in expected.items():
+            if self.store.get(HorizontalPodAutoscaler.KIND, ns, hpa_name) is None:
+                self.store.create(
+                    HorizontalPodAutoscaler(
+                        metadata=new_meta(hpa_name, ns, pcs, labels), spec=spec
+                    )
+                )
+        for hpa in self.store.list(
+            HorizontalPodAutoscaler.KIND, namespace=ns, labels=labels
+        ):
+            if hpa.metadata.name not in expected:
+                self.store.delete(HorizontalPodAutoscaler.KIND, ns, hpa.metadata.name)
+
+    def _sync_replicas(self, pcs: PodCliqueSet) -> Optional[float]:
+        """Gang termination (podcliquesetreplica/gangterminate.go:68-213):
+        a PCS replica whose constituents breach MinAvailable for longer
+        than TerminationDelay has ALL its PodCliques deleted; the spec flow
+        then recreates them fresh (gang restart). Returns a requeue delay
+        when a breach is ticking but not yet expired."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        delay = pcs.spec.template.termination_delay or float(
+            constants.DEFAULT_TERMINATION_DELAY_SECONDS
+        )
+        now = self.store.clock.now()
+        min_wait: Optional[float] = None
+        for i in range(pcs.spec.replicas):
+            breach_since: Optional[float] = None
+            for obj in self._replica_constituents(ns, name, i):
+                cond = get_condition(
+                    obj.status.conditions, constants.CONDITION_MIN_AVAILABLE_BREACHED
+                )
+                if cond is not None and cond.status == "True":
+                    t = cond.last_transition_time
+                    breach_since = t if breach_since is None else min(breach_since, t)
+            if breach_since is None:
+                continue
+            if now - breach_since >= delay:
+                self._terminate_replica(pcs, i)
+            else:
+                remaining = delay - (now - breach_since)
+                min_wait = remaining if min_wait is None else min(min_wait, remaining)
+        return min_wait
+
+    def _replica_constituents(self, ns: str, name: str, replica: int):
+        sel = {
+            constants.LABEL_PART_OF: name,
+            constants.LABEL_PCS_REPLICA_INDEX: str(replica),
+        }
+        return self.store.list(
+            PodClique.KIND, namespace=ns, labels=sel
+        ) + self.store.list(PodCliqueScalingGroup.KIND, namespace=ns, labels=sel)
+
+    def _terminate_replica(self, pcs: PodCliqueSet, replica: int) -> None:
+        """Delete every PodClique of the replica (PCSG-owned included) and
+        its PodGangs; reconcile recreates them (gang restart)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        sel = {
+            constants.LABEL_PART_OF: name,
+            constants.LABEL_PCS_REPLICA_INDEX: str(replica),
+        }
+        for pclq in self.store.list(PodClique.KIND, namespace=ns, labels=sel):
+            if pclq.metadata.deletion_timestamp is None:
+                self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
+        for gang in self.store.list(PodGang.KIND, namespace=ns, labels=sel):
+            self.store.delete(PodGang.KIND, ns, gang.metadata.name)
+
+    def _sync_podcliques(self, pcs: PodCliqueSet) -> None:
+        """Standalone PCLQ CRs per replica (components/podclique/)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        in_pcsg = {
+            cn
+            for sg in pcs.spec.template.pod_clique_scaling_group_configs
+            for cn in sg.clique_names
+        }
+        expected: dict[str, tuple[int, str, PodCliqueSpec]] = {}
+        for i in range(pcs.spec.replicas):
+            for clique in pcs.spec.template.cliques:
+                if clique.name in in_pcsg:
+                    continue
+                fqn = naming.podclique_name(name, i, clique.name)
+                expected[fqn] = (i, clique.name, clique.spec)
+        comp_labels = dict(
+            base_labels(name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_PCS_PODCLIQUE},
+        )
+        for fqn, (i, clique_name, spec) in expected.items():
+            if self.store.get(PodClique.KIND, ns, fqn) is not None:
+                continue
+            labels = dict(
+                comp_labels,
+                **{
+                    constants.LABEL_PCS_REPLICA_INDEX: str(i),
+                    constants.LABEL_PODGANG: naming.base_podgang_name(name, i),
+                    constants.LABEL_CLIQUE_TEMPLATE: clique_name,
+                },
+            )
+            self.store.create(
+                PodClique(
+                    metadata=new_meta(fqn, ns, pcs, labels),
+                    spec=_copy_spec(spec),
+                )
+            )
+        for pclq in self.store.list(PodClique.KIND, namespace=ns, labels=comp_labels):
+            if pclq.metadata.name not in expected:
+                self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
+
+    def _sync_pcsgs(self, pcs: PodCliqueSet) -> None:
+        """PCSG CRs per replica; replicas are read from a live (HPA-mutated)
+        PCSG when present (components/podcliquescalinggroup/)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        comp_labels = dict(
+            base_labels(name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_PCSG},
+        )
+        expected = set()
+        for i in range(pcs.spec.replicas):
+            for sg in pcs.spec.template.pod_clique_scaling_group_configs:
+                fqn = naming.pcsg_name(name, i, sg.name)
+                expected.add(fqn)
+                if self.store.get(PodCliqueScalingGroup.KIND, ns, fqn) is not None:
+                    continue
+                labels = dict(
+                    comp_labels,
+                    **{constants.LABEL_PCS_REPLICA_INDEX: str(i)},
+                )
+                self.store.create(
+                    PodCliqueScalingGroup(
+                        metadata=new_meta(fqn, ns, pcs, labels),
+                        spec=PodCliqueScalingGroupSpec(
+                            replicas=sg.replicas or 1,
+                            min_available=sg.min_available or 1,
+                            clique_names=list(sg.clique_names),
+                            topology_constraint=sg.topology_constraint,
+                        ),
+                    )
+                )
+        for pcsg in self.store.list(
+            PodCliqueScalingGroup.KIND, namespace=ns, labels=comp_labels
+        ):
+            if pcsg.metadata.name not in expected:
+                self.store.delete(PodCliqueScalingGroup.KIND, ns, pcsg.metadata.name)
+
+    # -- podgang component (components/podgang/syncflow.go) ----------------
+    def _sync_podgangs(self, pcs: PodCliqueSet) -> None:
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        levels = self._topology_levels()
+        expected = self._compute_expected_podgangs(pcs, levels)
+        comp_labels = dict(
+            base_labels(name),
+            **{constants.LABEL_COMPONENT: constants.COMPONENT_PODGANG},
+        )
+        for gang_name, (replica, spec, extra_labels) in expected.items():
+            pods_by_group = {}
+            complete = True
+            for group in spec.pod_groups:
+                pods = [
+                    p
+                    for p in self.store.list(
+                        Pod.KIND,
+                        namespace=ns,
+                        labels={
+                            constants.LABEL_PODCLIQUE: group.name,
+                            constants.LABEL_PODGANG: gang_name,
+                        },
+                    )
+                    if is_pod_active(p)
+                ]
+                pclq = self.store.get(PodClique.KIND, ns, group.name)
+                want = pclq.spec.replicas if pclq else 0
+                if pclq is None or len(pods) < want:
+                    complete = False  # defer until the pod inventory is full
+                    break
+                pods.sort(key=lambda p: p.metadata.name)
+                pods_by_group[group.name] = [
+                    NamespacedName(namespace=ns, name=p.metadata.name) for p in pods
+                ]
+            existing = self.store.get(PodGang.KIND, ns, gang_name)
+            if not complete:
+                continue  # syncflow.go:443-447: creation deferred
+            for group in spec.pod_groups:
+                group.pod_references = pods_by_group[group.name]
+            if existing is None:
+                labels = dict(
+                    comp_labels,
+                    **{constants.LABEL_PCS_REPLICA_INDEX: str(replica)},
+                    **extra_labels,
+                )
+                self.store.create(
+                    PodGang(metadata=new_meta(gang_name, ns, pcs, labels), spec=spec)
+                )
+            elif asdict(existing.spec) != asdict(spec):
+                existing.spec = spec
+                self.store.update(existing)
+        for gang in self.store.list(PodGang.KIND, namespace=ns, labels=comp_labels):
+            if gang.metadata.name not in expected:
+                self.store.delete(PodGang.KIND, ns, gang.metadata.name)
+
+    def _compute_expected_podgangs(self, pcs: PodCliqueSet, levels: dict[str, str]):
+        """name -> (pcs_replica, PodGangSpec, extra labels). Base gangs per
+        PCS replica + scaled gangs per PCSG replica beyond minAvailable
+        (syncflow.go:140-259)."""
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        tmpl = pcs.spec.template
+        cliques_by_name = {c.name: c for c in tmpl.cliques}
+        in_pcsg = {cn for sg in tmpl.pod_clique_scaling_group_configs
+                   for cn in sg.clique_names}
+        out: dict[str, tuple[int, PodGangSpec, dict]] = {}
+        for i in range(pcs.spec.replicas):
+            base_name = naming.base_podgang_name(name, i)
+            groups: list[PodGroup] = []
+            cgroups: list[TopologyConstraintGroupConfig] = []
+            for clique in tmpl.cliques:
+                if clique.name in in_pcsg:
+                    continue
+                groups.append(
+                    PodGroup(
+                        name=naming.podclique_name(name, i, clique.name),
+                        min_replicas=clique.spec.min_available or 1,
+                        topology_constraint=_translate(
+                            clique.spec.topology_constraint, levels
+                        ),
+                    )
+                )
+            for sg in tmpl.pod_clique_scaling_group_configs:
+                pcsg_fqn = naming.pcsg_name(name, i, sg.name)
+                live = self.store.get(PodCliqueScalingGroup.KIND, ns, pcsg_fqn)
+                replicas = live.spec.replicas if live else (sg.replicas or 1)
+                min_avail = live.spec.min_available if live else (sg.min_available or 1)
+                base_group_names = []
+                for j in range(min(min_avail, replicas)):
+                    for cn in sg.clique_names:
+                        gname = naming.podclique_name(pcsg_fqn, j, cn)
+                        base_group_names.append(gname)
+                        groups.append(
+                            PodGroup(
+                                name=gname,
+                                min_replicas=(
+                                    cliques_by_name[cn].spec.min_available or 1
+                                ),
+                                topology_constraint=_translate(
+                                    cliques_by_name[cn].spec.topology_constraint,
+                                    levels,
+                                ),
+                            )
+                        )
+                if sg.topology_constraint is not None and base_group_names:
+                    cgroups.append(
+                        TopologyConstraintGroupConfig(
+                            name=pcsg_fqn,
+                            pod_group_names=base_group_names,
+                            topology_constraint=_translate(
+                                sg.topology_constraint, levels
+                            ),
+                        )
+                    )
+                # scaled gangs for replicas beyond minAvailable
+                for j in range(min_avail, replicas):
+                    scaled_name = naming.scaled_podgang_name(pcsg_fqn, j - min_avail)
+                    scaled_groups = [
+                        PodGroup(
+                            name=naming.podclique_name(pcsg_fqn, j, cn),
+                            min_replicas=(
+                                cliques_by_name[cn].spec.min_available or 1
+                            ),
+                            topology_constraint=_translate(
+                                cliques_by_name[cn].spec.topology_constraint,
+                                levels,
+                            ),
+                        )
+                        for cn in sg.clique_names
+                    ]
+                    out[scaled_name] = (
+                        i,
+                        PodGangSpec(
+                            pod_groups=scaled_groups,
+                            topology_constraint=_translate(
+                                sg.topology_constraint, levels
+                            ),
+                            priority_class_name=tmpl.priority_class_name,
+                        ),
+                        {constants.LABEL_BASE_PODGANG: base_name},
+                    )
+            out[base_name] = (
+                i,
+                PodGangSpec(
+                    pod_groups=groups,
+                    topology_constraint=_translate(tmpl.topology_constraint, levels),
+                    topology_constraint_group_configs=cgroups,
+                    priority_class_name=tmpl.priority_class_name,
+                ),
+                {},
+            )
+        return out
+
+    def _topology_levels(self) -> dict[str, str]:
+        """domain -> node-label key from the singleton ClusterTopology."""
+        ct = self.store.get(
+            ClusterTopology.KIND, "", "grove-topology"
+        ) or self.store.get(ClusterTopology.KIND, "default", "grove-topology")
+        if ct is None:
+            return {}
+        return {lv.domain: lv.key for lv in ct.spec.levels}
+
+    # -- status flow (reconcilestatus.go) ----------------------------------
+    def _reconcile_status(self, pcs: PodCliqueSet) -> None:
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        fresh = self.store.get(KIND, ns, name)
+        if fresh is None:
+            return
+        status = fresh.status
+        before = asdict(status)
+        status.replicas = fresh.spec.replicas
+        available = 0
+        for i in range(fresh.spec.replicas):
+            constituents = self._replica_constituents(ns, name, i)
+            if constituents and all(_constituent_available(o) for o in constituents):
+                available += 1
+        status.available_replicas = available
+        # TopologyLevelsUnavailable (reconcilestatus.go:174-246)
+        missing = self._missing_levels(fresh)
+        set_condition(
+            status.conditions,
+            constants.CONDITION_TOPOLOGY_LEVELS_UNAVAILABLE,
+            "True" if missing else "False",
+            reason="TopologyLevelsMissing" if missing else "TopologyLevelsPresent",
+            message=",".join(missing),
+            now=self.store.clock.now(),
+        )
+        status.selector = f"{constants.LABEL_PART_OF}={name}"
+        if asdict(status) != before:
+            self.store.update_status(fresh)
+
+    def _missing_levels(self, pcs: PodCliqueSet) -> list[str]:
+        levels = self._topology_levels()
+        tmpl = pcs.spec.template
+        wanted: set[str] = set()
+        for tc in (
+            [tmpl.topology_constraint]
+            + [c.spec.topology_constraint for c in tmpl.cliques]
+            + [sg.topology_constraint
+               for sg in tmpl.pod_clique_scaling_group_configs]
+        ):
+            if tc is not None and tc.pack_constraint is not None:
+                for dom in (tc.pack_constraint.required, tc.pack_constraint.preferred):
+                    if dom is not None:
+                        wanted.add(dom)
+        return sorted(d for d in wanted if d not in levels)
+
+
+def _constituent_available(obj) -> bool:
+    """A PCS-replica constituent counts toward availability only when it is
+    actually scheduled AND not breaching MinAvailable (reconcilestatus.go:
+    61-172) — a never-scheduled replica is NOT available."""
+    breach = get_condition(
+        obj.status.conditions, constants.CONDITION_MIN_AVAILABLE_BREACHED
+    )
+    if breach is not None and breach.status == "True":
+        return False
+    if isinstance(obj, PodCliqueScalingGroup):
+        return obj.status.available_replicas >= obj.spec.min_available
+    sched = get_condition(
+        obj.status.conditions, constants.CONDITION_PODCLIQUE_SCHEDULED
+    )
+    return sched is not None and sched.status == "True"
+
+
+def _translate(
+    tc: Optional[TopologyConstraintSpec], levels: dict[str, str]
+) -> Optional[TopologyConstraint]:
+    """Operator-side domain names -> scheduler-contract label keys
+    (the KAI Topology CR hand-off in the reference, clustertopology.go:
+    141-175; here a direct translation). Unknown domains are dropped — the
+    PCS status carries TopologyLevelsUnavailable instead."""
+    if tc is None or tc.pack_constraint is None:
+        return None
+    req = tc.pack_constraint.required
+    pref = tc.pack_constraint.preferred
+    out = TopologyPackConstraint(
+        required=levels.get(req) if req else None,
+        preferred=levels.get(pref) if pref else None,
+    )
+    if out.required is None and out.preferred is None:
+        return None
+    return TopologyConstraint(pack_constraint=out)
+
+
+def _copy_spec(spec: PodCliqueSpec) -> PodCliqueSpec:
+    import copy
+
+    return copy.deepcopy(spec)

@@ -1,0 +1,132 @@
+"""Controller runtime: the controller-runtime Manager equivalent.
+
+The reference hosts three reconcilers on controller-runtime with watch
+predicates and per-controller workqueues
+(operator/internal/controller/{manager,register}.go). Here the runtime is a
+deterministic single-threaded loop over the store's event log:
+
+  events -> per-controller map_event() (the watch predicate + handler
+  mapping) -> dedup'd work queue -> Reconcile(ns, name) -> store writes ->
+  more events ... until fixpoint.
+
+Requeue-after (the reference's ERR_REQUEUE_AFTER flow control,
+internal/errors/) is a time-heap against the virtual clock; tests advance
+the clock and re-settle. Determinism is the point: the reference's E2E
+suites fight eventual consistency with Eventually() polling; here a settled
+state is exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+from ..cluster.store import Event, ObjectStore
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+
+@dataclass
+class Result:
+    """Reconcile outcome. requeue_after: seconds (virtual) until the same
+    request should be retried even without new events."""
+
+    requeue_after: Optional[float] = None
+    error: Optional[str] = None
+
+
+class Reconciler(Protocol):
+    name: str
+
+    def map_event(self, event: Event) -> list[Request]:
+        """Watch predicate + event-to-primary mapping. Return the primary
+        requests this event should enqueue ([] to ignore)."""
+        ...
+
+    def reconcile(self, request: Request) -> Result: ...
+
+
+class ControllerManager:
+    def __init__(self, store: ObjectStore):
+        self.store = store
+        self.controllers: list[Reconciler] = []
+        self._cursor = 0  # event-log position
+        self._queue: list[tuple[str, Request]] = []
+        self._queued: set[tuple[str, Request]] = set()
+        self._requeues: list[tuple[float, int, str, Request]] = []
+        self._tiebreak = itertools.count()
+        self.errors: list[tuple[str, Request, str]] = []
+
+    def register(self, controller: Reconciler) -> None:
+        self.controllers.append(controller)
+
+    # -- queue plumbing ----------------------------------------------------
+    def _enqueue(self, controller_name: str, request: Request) -> None:
+        key = (controller_name, request)
+        if key not in self._queued:
+            self._queued.add(key)
+            self._queue.append(key)
+
+    def _drain_events(self) -> None:
+        events = self.store.events_since(self._cursor)
+        if events:
+            self._cursor = events[-1].seq
+        for event in events:
+            for controller in self.controllers:
+                for req in controller.map_event(event):
+                    self._enqueue(controller.name, req)
+
+    def _pop_due_requeues(self) -> None:
+        now = self.store.clock.now()
+        while self._requeues and self._requeues[0][0] <= now:
+            _, _, cname, req = heapq.heappop(self._requeues)
+            self._enqueue(cname, req)
+
+    def next_requeue_at(self) -> Optional[float]:
+        return self._requeues[0][0] if self._requeues else None
+
+    # -- the loop ----------------------------------------------------------
+    def run_once(self) -> int:
+        """Drain events + due requeues, run every queued reconcile once.
+        Returns the number of reconciles executed."""
+        self._drain_events()
+        self._pop_due_requeues()
+        batch, self._queue = self._queue, []
+        self._queued -= set(batch)
+        by_name = {c.name: c for c in self.controllers}
+        for cname, req in batch:
+            controller = by_name[cname]
+            result = controller.reconcile(req)
+            if result.error:
+                self.errors.append((cname, req, result.error))
+            if result.requeue_after is not None:
+                heapq.heappush(
+                    self._requeues,
+                    (
+                        self.store.clock.now() + result.requeue_after,
+                        next(self._tiebreak),
+                        cname,
+                        req,
+                    ),
+                )
+        return len(batch)
+
+    def settle(self, max_rounds: int = 256) -> None:
+        """Run until no events are pending and the queue is empty (due
+        requeues included; future requeues are left on the heap)."""
+        for _ in range(max_rounds):
+            if self.run_once() == 0:
+                self._drain_events()
+                self._pop_due_requeues()
+                if not self._queue:
+                    return
+        raise RuntimeError(
+            f"controllers did not settle in {max_rounds} rounds "
+            f"(errors: {self.errors[-3:]})"
+        )

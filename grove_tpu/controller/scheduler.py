@@ -1,0 +1,278 @@
+"""The gang scheduler loop: PodGangs + ungated pods -> placement engine ->
+bindings.
+
+This is the component the reference DELEGATES to the external KAI scheduler
+(operator/cmd/main.go:78-81; scheduler/ in the reference is API types only).
+grove_tpu implements it natively: every reconcile round batches the whole
+pending-gang backlog into one PlacementEngine solve (cost tensors + commit
+scan on the accelerator, exact repair on host — see solver/engine.py) and
+writes the results back as pod bindings + PodGang status:
+
+  Scheduled condition + phase Starting + PlacementScore on success
+  (podgang.go:147-181), Unschedulable on failure with a retry requeue,
+  phase Running once every member pod is ready, Unhealthy when a scheduled
+  gang has crashed/missing pods (podgang.go:156-169).
+
+All-or-nothing: only gangs whose min-replica pods all exist and are
+ungated enter the backlog; extra pods of already-scheduled gangs (beyond
+each group's MinReplicas) bind best-effort as singleton follow-ups in the
+same round.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..api import constants
+from ..api.meta import get_condition, set_condition
+from ..api.podgang import PodGang, PodGangConditionType, PodGangPhase
+from ..api.types import Node, Pod, PodPhase
+from ..cluster.cluster import Cluster
+from ..cluster.store import Event
+from ..solver import PlacementEngine, SolverGang, encode_podgangs
+from .runtime import Request, Result
+
+RETRY_SECONDS = constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS
+_SINGLETON_REQ = Request("", "schedule")
+
+
+class GangScheduler:
+    name = "scheduler"
+
+    def __init__(self, cluster: Cluster, engine_cls=PlacementEngine):
+        self.cluster = cluster
+        self.store = cluster.store
+        self.engine_cls = engine_cls
+
+    def map_event(self, event: Event) -> list[Request]:
+        if event.kind == PodGang.KIND or event.kind == Node.KIND:
+            return [_SINGLETON_REQ]
+        if event.kind == Pod.KIND:
+            # new/ungated/deleted pods change the backlog or free capacity
+            return [_SINGLETON_REQ]
+        return []
+
+    def reconcile(self, request: Request) -> Result:
+        backlog: list[PodGang] = []
+        scheduled_gangs: list[PodGang] = []
+        for gang in self.store.list(PodGang.KIND):
+            if gang.metadata.deletion_timestamp is not None:
+                continue
+            if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+                scheduled_gangs.append(gang)
+            elif self._gang_ready_to_schedule(gang):
+                backlog.append(gang)
+        # Cheap pre-scan before paying for snapshot + engine construction:
+        # most events (pod readiness flips etc.) leave nothing to place.
+        needs_solve = bool(backlog) or any(
+            self._has_unbound_referenced_pod(g) for g in scheduled_gangs
+        )
+        if not needs_solve:
+            for gang in self.store.list(PodGang.KIND):
+                self._update_phase(gang)
+            return Result()
+
+        snapshot = self.cluster.topology_snapshot()
+        engine = self.engine_cls(snapshot)
+        free = snapshot.free.copy()
+        demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
+
+        requeue: Optional[float] = None
+        if backlog:
+            solver_gangs = encode_podgangs(
+                backlog, snapshot, demand_fn, priority_of=self._priority_of
+            )
+            result = engine.solve(solver_gangs, free=free)
+            by_name = {g.metadata.name: g for g in backlog}
+            for name, placement in result.placed.items():
+                self._bind(by_name[name], placement)
+            for name, reason in result.unplaced.items():
+                from dataclasses import asdict
+
+                gang = by_name[name]
+                before = asdict(gang.status)
+                set_condition(
+                    gang.status.conditions,
+                    PodGangConditionType.SCHEDULED.value,
+                    "False",
+                    reason="Unschedulable",
+                    message=reason,
+                    now=self.store.clock.now(),
+                )
+                if asdict(gang.status) != before:
+                    self.store.update_status(gang)
+                requeue = RETRY_SECONDS
+
+        self._bind_best_effort(scheduled_gangs, snapshot, free, demand_fn, engine)
+        for gang in self.store.list(PodGang.KIND):
+            self._update_phase(gang)
+        return Result(requeue_after=requeue)
+
+    def _has_unbound_referenced_pod(self, gang: PodGang) -> bool:
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                pod = self.store.get(Pod.KIND, ref.namespace, ref.name)
+                if (
+                    pod is not None
+                    and not pod.node_name
+                    and not pod.spec.scheduling_gates
+                    and pod.metadata.deletion_timestamp is None
+                ):
+                    return True
+        return False
+
+    # -- backlog membership -------------------------------------------------
+    def _gang_ready_to_schedule(self, gang: PodGang) -> bool:
+        """Every min-replica pod exists and is ungated (the operator's gate
+        removal is the admission signal; scaled gangs stay gated until their
+        base gang schedules, so they naturally stay out of the backlog)."""
+        for group in gang.spec.pod_groups:
+            refs = group.pod_references[: group.min_replicas]
+            if len(refs) < group.min_replicas:
+                return False
+            for ref in refs:
+                pod = self.store.get(Pod.KIND, ref.namespace, ref.name)
+                if pod is None or pod.spec.scheduling_gates or pod.node_name:
+                    return False
+        return True
+
+    def _priority_of(self, gang: PodGang) -> float:
+        """PriorityClassName -> numeric priority. Unknown classes are 0;
+        'system-*' classes win (a minimal PriorityClass table)."""
+        pc = gang.spec.priority_class_name
+        if not pc:
+            return 0.0
+        if pc.startswith("system-"):
+            return 1000.0
+        if pc.endswith("-high"):
+            return 100.0
+        if pc.endswith("-low"):
+            return -100.0
+        return 10.0
+
+    # -- binding ------------------------------------------------------------
+    def _bind(self, gang: PodGang, placement) -> None:
+        ns = gang.metadata.namespace
+        for pod_name, node_name in placement.pod_to_node.items():
+            pod = self.store.get(Pod.KIND, ns, pod_name)
+            if pod is None or pod.node_name:
+                continue
+            pod.node_name = node_name
+            self.store.update(pod)
+        gang.status.placement_score = placement.placement_score
+        gang.status.phase = PodGangPhase.STARTING
+        set_condition(
+            gang.status.conditions,
+            PodGangConditionType.SCHEDULED.value,
+            "True",
+            reason="Placed",
+            now=self.store.clock.now(),
+        )
+        self.store.update_status(gang)
+
+    def _bind_best_effort(self, scheduled_gangs, snapshot, free, demand_fn, engine):
+        """Pods referenced beyond MinReplicas (or replacements for evicted
+        min-pods) of already-scheduled gangs bind as singletons against the
+        residual free capacity."""
+        singles: list[SolverGang] = []
+        for gang in scheduled_gangs:
+            for group in gang.spec.pod_groups:
+                for ref in group.pod_references:
+                    pod = self.store.get(Pod.KIND, ref.namespace, ref.name)
+                    if (
+                        pod is None
+                        or pod.node_name
+                        or pod.spec.scheduling_gates
+                        or pod.metadata.deletion_timestamp is not None
+                    ):
+                        continue
+                    demand = demand_fn(ref.namespace, ref.name)
+                    if demand is None:
+                        continue
+                    req, pref = _group_levels(group, snapshot)
+                    singles.append(
+                        SolverGang(
+                            name=f"single/{ref.name}",
+                            namespace=ref.namespace,
+                            demand=np.asarray([demand], dtype=np.float32),
+                            pod_names=[ref.name],
+                            group_ids=np.zeros(1, np.int32),
+                            group_names=[group.name],
+                            group_required_level=np.array([-1], np.int32),
+                            group_preferred_level=np.array([-1], np.int32),
+                            required_level=req,
+                            preferred_level=pref,
+                        )
+                    )
+        if not singles:
+            return
+        result = engine.solve(singles, free=free)
+        for placement in result.placed.values():
+            ns = placement.gang.namespace
+            for pod_name, node_name in placement.pod_to_node.items():
+                pod = self.store.get(Pod.KIND, ns, pod_name)
+                if pod is not None and not pod.node_name:
+                    pod.node_name = node_name
+                    self.store.update(pod)
+
+    # -- phase/health (podgang.go:147-169) ----------------------------------
+    def _update_phase(self, gang: PodGang) -> None:
+        from dataclasses import asdict
+
+        if not _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+            return
+        before = asdict(gang.status)
+        ns = gang.metadata.namespace
+        pods = []
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references[: group.min_replicas]:
+                pods.append(self.store.get(Pod.KIND, ref.namespace, ref.name))
+        missing_or_failed = any(
+            p is None or p.status.phase == PodPhase.FAILED
+            or (p.status.restart_count > 0 and not p.status.ready)
+            for p in pods
+        )
+        all_ready = pods and all(p is not None and p.status.ready for p in pods)
+        gang.status.phase = (
+            PodGangPhase.RUNNING if all_ready else PodGangPhase.STARTING
+        )
+        set_condition(
+            gang.status.conditions,
+            PodGangConditionType.UNHEALTHY.value,
+            "True" if missing_or_failed else "False",
+            reason="MemberPodsUnhealthy" if missing_or_failed else "MembersHealthy",
+            now=self.store.clock.now(),
+        )
+        set_condition(
+            gang.status.conditions,
+            PodGangConditionType.READY.value,
+            "True" if all_ready else "False",
+            reason="AllMinReplicasReady" if all_ready else "WaitingForMembers",
+            now=self.store.clock.now(),
+        )
+        if asdict(gang.status) != before:
+            self.store.update_status(gang)
+
+
+def _group_levels(group, snapshot) -> tuple[int, int]:
+    req = pref = -1
+    tc = group.topology_constraint
+    if tc is not None and tc.pack_constraint is not None:
+        if tc.pack_constraint.required:
+            try:
+                req = snapshot.level_index(tc.pack_constraint.required)
+            except KeyError:
+                pass
+        if tc.pack_constraint.preferred:
+            try:
+                pref = snapshot.level_index(tc.pack_constraint.preferred)
+            except KeyError:
+                pass
+    return req, pref
+
+
+def _cond_true(gang: PodGang, cond_type: str) -> bool:
+    cond = get_condition(gang.status.conditions, cond_type)
+    return cond is not None and cond.status == "True"

@@ -164,14 +164,20 @@ class TestKubelet:
         worker = c.store.get(Pod.KIND, "default", "worker-0")
         assert leader.status.ready and worker.status.ready
 
-    def test_fail_pod(self):
+    def test_crash_recover_and_evict(self):
         c = Cluster(nodes=make_nodes(1))
         c.store.create(make_pod("p", node="node-0"))
         c.kubelet.run_to_quiesce()
-        c.kubelet.fail_pod("default", "p")
+        c.kubelet.crash_pod("default", "p")
         pod = c.store.get(Pod.KIND, "default", "p")
-        assert pod.status.phase == PodPhase.FAILED and not pod.status.ready
+        assert pod.status.phase == PodPhase.RUNNING
+        assert not pod.status.ready and pod.status.restart_count == 1
+        c.kubelet.run_to_quiesce()  # stays crashed
+        assert not c.store.get(Pod.KIND, "default", "p").status.ready
+        c.kubelet.recover_pod("default", "p")
         c.kubelet.run_to_quiesce()
+        assert c.store.get(Pod.KIND, "default", "p").status.ready
+        c.kubelet.evict_pod("default", "p")
         assert c.store.get(Pod.KIND, "default", "p").status.phase == PodPhase.FAILED
 
 

@@ -1,0 +1,174 @@
+"""End-to-end slice: apply a PodCliqueSet -> reconcile -> gangs -> bound,
+ready pods (the samples/simple/simple1.yaml quickstart of the reference,
+driven against the simulated cluster)."""
+
+import pytest
+
+from grove_tpu.api import constants
+from grove_tpu.api.meta import ObjectMeta, get_condition
+from grove_tpu.api.podgang import PodGang, PodGangPhase
+from grove_tpu.api.types import (
+    Container,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueScalingGroupConfig,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+
+def clique(name, replicas=2, min_available=None, cpu=1.0, starts_after=()):
+    return PodCliqueTemplateSpec(
+        name=name,
+        spec=PodCliqueSpec(
+            replicas=replicas,
+            min_available=min_available,
+            starts_after=list(starts_after),
+            pod_spec=PodSpec(
+                containers=[Container(name="main", resources={"cpu": cpu})]
+            ),
+        ),
+    )
+
+
+def simple_pcs(name="simple1", replicas=1, cliques=None, sgs=None, startup=None):
+    return PodCliqueSet(
+        metadata=ObjectMeta(name=name),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplateSpec(
+                cliques=cliques or [clique("fe"), clique("be")],
+                pod_clique_scaling_group_configs=sgs or [],
+                startup_type=startup,
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def harness():
+    return Harness(nodes=make_nodes(16, racks_per_block=2, hosts_per_rack=4))
+
+
+class TestSimpleEndToEnd:
+    def test_pods_created_gated_then_bound_and_ready(self, harness):
+        harness.apply(simple_pcs())
+        harness.settle()
+        pods = harness.store.list(Pod.KIND)
+        assert len(pods) == 4  # 2 cliques x 2 replicas
+        assert all(p.node_name for p in pods), "all pods bound"
+        assert all(not p.spec.scheduling_gates for p in pods)
+        assert all(p.status.ready for p in pods)
+
+    def test_podcliques_and_podgang_created(self, harness):
+        harness.apply(simple_pcs())
+        harness.settle()
+        pclqs = harness.store.list(PodClique.KIND)
+        assert sorted(p.metadata.name for p in pclqs) == [
+            "simple1-0-be", "simple1-0-fe",
+        ]
+        gangs = harness.store.list(PodGang.KIND)
+        assert [g.metadata.name for g in gangs] == ["simple1-0"]
+        gang = gangs[0]
+        assert gang.status.phase == PodGangPhase.RUNNING
+        assert gang.status.placement_score is not None
+        assert {gr.name for gr in gang.spec.pod_groups} == {
+            "simple1-0-fe", "simple1-0-be",
+        }
+        # all pods referenced
+        assert sum(len(gr.pod_references) for gr in gang.spec.pod_groups) == 4
+
+    def test_env_hostname_subdomain_wiring(self, harness):
+        harness.apply(simple_pcs())
+        harness.settle()
+        pod = harness.store.get(Pod.KIND, "default", "simple1-0-fe-0")
+        assert pod.spec.hostname == "simple1-0-fe-0"
+        assert pod.spec.subdomain == "simple1-0"
+        env = pod.spec.containers[0].env
+        assert env[constants.ENV_PCS_NAME] == "simple1"
+        assert env[constants.ENV_PCLQ_NAME] == "simple1-0-fe"
+        assert env[constants.ENV_PCLQ_POD_INDEX] == "0"
+        svc = harness.store.get("Service", "default", "simple1-0")
+        assert svc is not None and svc.publish_not_ready_addresses
+
+    def test_multi_replica_creates_per_replica_trees(self, harness):
+        harness.apply(simple_pcs(replicas=2))
+        harness.settle()
+        assert len(harness.store.list(PodClique.KIND)) == 4
+        gangs = sorted(g.metadata.name for g in harness.store.list(PodGang.KIND))
+        assert gangs == ["simple1-0", "simple1-1"]
+        assert len(harness.store.list(Pod.KIND)) == 8
+
+    def test_status_counts(self, harness):
+        harness.apply(simple_pcs())
+        harness.settle()
+        pclq = harness.store.get(PodClique.KIND, "default", "simple1-0-fe")
+        s = pclq.status
+        assert (s.replicas, s.ready_replicas, s.scheduled_replicas,
+                s.schedule_gated_replicas) == (2, 2, 2, 0)
+        cond = get_condition(s.conditions, constants.CONDITION_PODCLIQUE_SCHEDULED)
+        assert cond.status == "True"
+        pcs = harness.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.available_replicas == 1
+
+    def test_delete_cascades(self, harness):
+        harness.apply(simple_pcs())
+        harness.settle()
+        harness.store.delete(PodCliqueSet.KIND, "default", "simple1")
+        harness.settle()
+        assert harness.store.get(PodCliqueSet.KIND, "default", "simple1") is None
+        assert harness.store.list(Pod.KIND) == []
+        assert harness.store.list(PodClique.KIND) == []
+        assert harness.store.list(PodGang.KIND) == []
+
+
+class TestScalingGroupEndToEnd:
+    def pcs(self):
+        return simple_pcs(
+            name="dis",
+            cliques=[clique("router", replicas=1),
+                     clique("prefill", replicas=2),
+                     clique("decode", replicas=2)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="workers", clique_names=["prefill", "decode"],
+                replicas=3, min_available=2)],
+        )
+
+    def test_base_and_scaled_gangs(self, harness):
+        harness.apply(self.pcs())
+        harness.settle()
+        gangs = {g.metadata.name: g for g in harness.store.list(PodGang.KIND)}
+        # base gang + one scaled gang (replicas 3, minAvailable 2)
+        assert sorted(gangs) == ["dis-0", "dis-0-workers-0"]
+        base = gangs["dis-0"]
+        group_names = {gr.name for gr in base.spec.pod_groups}
+        assert group_names == {
+            "dis-0-router",
+            "dis-0-workers-0-prefill", "dis-0-workers-0-decode",
+            "dis-0-workers-1-prefill", "dis-0-workers-1-decode",
+        }
+        scaled = gangs["dis-0-workers-0"]
+        assert {gr.name for gr in scaled.spec.pod_groups} == {
+            "dis-0-workers-2-prefill", "dis-0-workers-2-decode",
+        }
+        assert (scaled.metadata.labels[constants.LABEL_BASE_PODGANG] == "dis-0")
+
+    def test_all_pods_bound_and_pcsg_status(self, harness):
+        harness.apply(self.pcs())
+        harness.settle()
+        pods = harness.store.list(Pod.KIND)
+        # router 1 + 3 pcsg replicas x (2 prefill + 2 decode) = 13
+        assert len(pods) == 13
+        assert all(p.node_name and p.status.ready for p in pods)
+        pcsg = harness.store.get(PodCliqueScalingGroup.KIND, "default",
+                                 "dis-0-workers")
+        assert pcsg.status.replicas == 3
+        assert pcsg.status.scheduled_replicas == 3
+        assert pcsg.status.available_replicas == 3

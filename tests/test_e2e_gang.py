@@ -1,0 +1,156 @@
+"""Gang-scheduling + failure-path E2E suites.
+
+Named GS*/FT* after the reference's E2E scenario naming
+(operator/e2e/tests/gang_scheduling_test.go GS1-GS12): all-or-nothing under
+insufficient capacity, scale-out gangs, minAvailable semantics, breach ->
+TerminationDelay -> gang termination -> recovery.
+"""
+
+import pytest
+
+from grove_tpu.api import constants
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.podgang import PodGang, PodGangConditionType
+from grove_tpu.api.types import Pod, PodClique, PodCliqueSet
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+
+def cond(obj, ctype):
+    return get_condition(obj.status.conditions, ctype)
+
+
+class TestGS_AllOrNothing:
+    def test_gs1_insufficient_capacity_nothing_binds(self):
+        # 2 nodes x 4 cpu; gang needs 3 pods x 3 cpu in ONE... total 9 > 8
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 4.0, "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 3
+        assert all(not p.node_name for p in pods), "all-or-nothing: none bind"
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        sched = cond(gang, PodGangConditionType.SCHEDULED.value)
+        assert sched is not None and sched.status == "False"
+        assert sched.reason == "Unschedulable"
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.available_replicas == 0  # never-scheduled != available
+
+    def test_gs2_capacity_freed_then_gang_binds(self):
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 4.0, "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        # add capacity -> retry timer fires -> gang binds
+        for node in make_nodes(2, name_prefix="extra",
+                               allocatable={"cpu": 4.0, "memory": 8.0, "tpu": 0.0}):
+            h.store.create(node)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        pods = h.store.list(Pod.KIND)
+        assert all(p.node_name for p in pods)
+
+    def test_gs3_min_available_partial_gang(self):
+        # clique replicas=4, minAvailable=2: gang is 2 pods; the other 2
+        # bind best-effort
+        h = Harness(nodes=make_nodes(4, allocatable={"cpu": 2.0, "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=4, min_available=2,
+                                           cpu=1.5)]))
+        h.settle()
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        assert gang.spec.pod_groups[0].min_replicas == 2
+        bound = [p for p in h.store.list(Pod.KIND) if p.node_name]
+        # 4 nodes x 2cpu, 1.5cpu pods -> one per node: all 4 fit
+        assert len(bound) == 4
+
+    def test_gs4_two_pcs_contend_no_partial_binding(self):
+        # capacity for exactly one gang; the other must stay fully pending
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 3.0, "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(name="a", cliques=[clique("w", replicas=2, cpu=2.5)]))
+        h.apply(simple_pcs(name="b", cliques=[clique("w", replicas=2, cpu=2.5)]))
+        h.settle()
+        bound_by_pcs = {"a": 0, "b": 0}
+        for p in h.store.list(Pod.KIND):
+            if p.node_name:
+                bound_by_pcs[p.metadata.labels[constants.LABEL_PART_OF]] += 1
+        assert sorted(bound_by_pcs.values()) == [0, 2], bound_by_pcs
+
+
+class TestFT_FailureAndTermination:
+    def two_replica_pcs(self):
+        return simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+
+    def test_ft1_crash_sets_breach_and_phase(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(self.two_replica_pcs())
+        h.settle()
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        breach = cond(pclq, constants.CONDITION_MIN_AVAILABLE_BREACHED)
+        assert breach.status == "True"
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        unhealthy = cond(gang, PodGangConditionType.UNHEALTHY.value)
+        assert unhealthy.status == "True"
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.available_replicas == 0
+
+    def test_ft2_recovery_clears_breach(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(self.two_replica_pcs())
+        h.settle()
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        h.kubelet.recover_pod("default", "simple1-0-w-0")
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert cond(pclq, constants.CONDITION_MIN_AVAILABLE_BREACHED).status == "False"
+
+    def test_ft3_gang_termination_after_delay(self):
+        h = Harness(nodes=make_nodes(4))
+        pcs = self.two_replica_pcs()
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        old_pod_uid = h.store.get(Pod.KIND, "default", "simple1-0-w-0").metadata.uid
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        # before the delay expires nothing is terminated
+        h.advance(30.0)
+        assert h.store.get(PodClique.KIND, "default", "simple1-0-w") is not None
+        assert (h.store.get(Pod.KIND, "default", "simple1-0-w-0").metadata.uid
+                == old_pod_uid)
+        # crashed pod stays crashed; after the delay the whole replica is
+        # rebuilt (gang restart) with fresh pods that start CLEAN even when
+        # hole-filling reuses the crashed pod's name
+        h.advance(31.0)
+        h.settle()
+        new_pod = h.store.get(Pod.KIND, "default", "simple1-0-w-0")
+        assert new_pod is not None and new_pod.metadata.uid != old_pod_uid
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_ft4_evicted_pod_replaced_and_rebound(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(self.two_replica_pcs())
+        h.settle()
+        h.kubelet.evict_pod("default", "simple1-0-w-1")
+        h.settle()
+        pod = h.store.get(Pod.KIND, "default", "simple1-0-w-1")
+        assert pod is not None and pod.node_name and pod.status.ready
+
+    def test_ft5_unschedulable_gang_never_ticks_termination(self):
+        h = Harness(nodes=make_nodes(1, allocatable={"cpu": 1.0, "memory": 1.0,
+                                                     "tpu": 0.0}))
+        pcs = self.two_replica_pcs()  # needs 2 cpu total, only 1 available
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert cond(pclq, constants.CONDITION_MIN_AVAILABLE_BREACHED).status == "False"
+        h.advance(3600.0)
+        # cliques still exist; no termination churn for a never-scheduled gang
+        assert h.store.get(PodClique.KIND, "default", "simple1-0-w") is not None

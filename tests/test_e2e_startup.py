@@ -1,0 +1,97 @@
+"""Startup-ordering E2E suite (SO1-SO4 in the reference,
+operator/e2e/tests/startup_ordering_test.go): InOrder/Explicit orderings
+verified by readiness-time comparison, like the reference compares container
+start timestamps."""
+
+from grove_tpu.api import constants
+from grove_tpu.api.types import CliqueStartupType, Pod
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+
+def ready_order(harness):
+    """Pods grouped by clique template, with the tick at which each became
+    ready (derived by stepping the kubelet one tick at a time)."""
+    order: dict[str, int] = {}
+    tick = 0
+    for _ in range(32):
+        harness.manager.settle()
+        changed = harness.kubelet.tick()
+        tick += 1
+        for pod in harness.store.list(Pod.KIND):
+            name = pod.metadata.labels[constants.LABEL_PODCLIQUE]
+            if pod.status.ready and name not in order:
+                order[name] = tick
+        if changed == 0:
+            harness.manager.settle()
+            if harness.kubelet.tick() == 0:
+                break
+    return order
+
+
+class TestStartupOrdering:
+    def test_so1_any_order_all_start_together(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs(cliques=[clique("a"), clique("b")]))
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert all(p.status.ready for p in pods)
+        assert all(
+            constants.ANNOTATION_WAIT_FOR not in p.metadata.annotations
+            for p in pods
+        )
+
+    def test_so2_explicit_dag(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(
+            simple_pcs(
+                cliques=[
+                    clique("router"),
+                    clique("pf", starts_after=["router"]),
+                    clique("dc", starts_after=["router", "pf"]),
+                ],
+                startup=CliqueStartupType.EXPLICIT,
+            )
+        )
+        order = ready_order(h)
+        assert order["simple1-0-router"] < order["simple1-0-pf"]
+        assert order["simple1-0-pf"] < order["simple1-0-dc"]
+        # wait-for annotations carry '<fqn>:<minAvailable>'
+        pod = h.store.get(Pod.KIND, "default", "simple1-0-dc-0")
+        assert (
+            pod.metadata.annotations[constants.ANNOTATION_WAIT_FOR]
+            == "simple1-0-router:2,simple1-0-pf:2"
+        )
+
+    def test_so3_in_order_chains_previous_clique(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(
+            simple_pcs(
+                cliques=[clique("a"), clique("b"), clique("c")],
+                startup=CliqueStartupType.IN_ORDER,
+            )
+        )
+        order = ready_order(h)
+        assert order["simple1-0-a"] < order["simple1-0-b"] < order["simple1-0-c"]
+
+    def test_so4_min_available_unlocks_dependents(self):
+        # parent minAvailable=1 of 3: dependent starts once ONE parent pod
+        # is ready, not all three
+        h = Harness(nodes=make_nodes(8))
+        h.apply(
+            simple_pcs(
+                cliques=[
+                    clique("parent", replicas=3, min_available=1),
+                    clique("child", replicas=1, starts_after=["parent"]),
+                ],
+                startup=CliqueStartupType.EXPLICIT,
+            )
+        )
+        h.settle()
+        pod = h.store.get(Pod.KIND, "default", "simple1-0-child-0")
+        assert pod.metadata.annotations[constants.ANNOTATION_WAIT_FOR] == (
+            "simple1-0-parent:1"
+        )
+        assert pod.status.ready
