@@ -238,6 +238,9 @@ class PCSGRollingUpdateProgress:
     current_replica_index: Optional[int] = None
     updated_replica_indices: list[int] = field(default_factory=list)
     completed: bool = False
+    # Hash of the template this update is rolling toward; a different
+    # target mid-flight restarts the update.
+    target_generation_hash: str = ""
 
 
 @dataclass
@@ -306,6 +309,9 @@ class PCSRollingUpdateProgress:
     current_replica_index: Optional[int] = None
     updated_replica_indices: list[int] = field(default_factory=list)
     completed: bool = False
+    # Hash of the template this update is rolling toward; a different
+    # target mid-flight restarts the update.
+    target_generation_hash: str = ""
 
 
 @dataclass

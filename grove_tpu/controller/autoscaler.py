@@ -1,0 +1,126 @@
+"""In-process HPA controller.
+
+The reference creates autoscaling/v2 HPAs and lets kube's HPA controller
+PATCH the scale subresource of PodClique/PodCliqueScalingGroup
+(components/hpa/hpa.go; scale markers on all 3 CRDs). Here the control
+loop itself runs in-process against the HorizontalPodAutoscaler objects:
+desired = ceil(current * observed_utilization / target), clamped to
+[min, max], written to the target's spec.replicas — the same math as the
+k8s HPA algorithm.
+
+Utilization is fed by the test/user via Cluster metrics (pod name ->
+fraction of its REQUEST currently used), standing in for metrics-server.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..api import constants
+from ..api.auxiliary import HorizontalPodAutoscaler
+from ..api.types import Pod, PodClique, PodCliqueScalingGroup
+from ..cluster.cluster import Cluster
+from ..cluster.store import Event
+from .runtime import Request, Result
+
+KIND = HorizontalPodAutoscaler.KIND
+
+#: k8s HPA default tolerance: no scale while |ratio - 1| <= 0.1
+TOLERANCE = 0.1
+
+
+class Autoscaler:
+    name = "autoscaler"
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.store = cluster.store
+        #: pod name -> utilization fraction of request (metrics-server stand-in)
+        self.metrics: dict[str, float] = {}
+
+    def map_event(self, event: Event) -> list[Request]:
+        # Only spec changes (new HPA / retargeted bounds) trigger an
+        # immediate evaluation. Status writes must NOT — reacting to our own
+        # status update would re-evaluate stale metrics against the
+        # already-scaled replica count and double-scale. Periodic evaluation
+        # happens via run_all() (the HPA sync interval).
+        if event.kind == KIND and (
+            event.type == "Added"
+            or (
+                event.old is not None
+                and event.obj.metadata.generation != event.old.metadata.generation
+            )
+        ):
+            return [Request(event.namespace, event.name)]
+        return []
+
+    def observe(self, pod_name: str, utilization: float) -> None:
+        """Feed a metric sample; call harness.autoscale() to run the loop."""
+        self.metrics[pod_name] = utilization
+
+    def reconcile(self, request: Request) -> Result:
+        hpa = self.store.get(KIND, request.namespace, request.name)
+        if hpa is None or hpa.metadata.deletion_timestamp is not None:
+            return Result()
+        self._scale(hpa)
+        return Result()
+
+    def run_all(self) -> None:
+        """One sweep over every HPA (the periodic HPA sync)."""
+        for hpa in self.store.list(KIND):
+            self._scale(hpa)
+
+    def _scale(self, hpa: HorizontalPodAutoscaler) -> None:
+        ns = hpa.metadata.namespace
+        target = self.store.get(hpa.spec.target_kind, ns, hpa.spec.target_name)
+        if target is None:
+            return
+        current = target.spec.replicas
+        utilization = self._observed_utilization(hpa, target)
+        if utilization is None:
+            desired = current
+        else:
+            ratio = utilization / max(hpa.spec.target_utilization, 1e-9)
+            desired = (
+                current
+                if abs(ratio - 1.0) <= TOLERANCE
+                else max(1, math.ceil(current * ratio))
+            )
+        desired = min(max(desired, hpa.spec.min_replicas), hpa.spec.max_replicas)
+        if desired != current:
+            target.spec.replicas = desired
+            self.store.update(target)
+            hpa.status.last_scale_time = self.store.clock.now()
+        if (
+            hpa.status.current_replicas != current
+            or hpa.status.desired_replicas != desired
+        ):
+            hpa.status.current_replicas = current
+            hpa.status.desired_replicas = desired
+            self.store.update_status(hpa)
+
+    def _observed_utilization(self, hpa, target) -> Optional[float]:
+        """Average utilization over the target's pods (k8s HPA averages
+        over READY pods of the scale target)."""
+        ns = hpa.metadata.namespace
+        if hpa.spec.target_kind == PodCliqueScalingGroup.KIND:
+            label = {constants.LABEL_PCSG: hpa.spec.target_name}
+        else:
+            label = {constants.LABEL_PODCLIQUE: hpa.spec.target_name}
+        pods = [
+            p
+            for p in self.store.list(Pod.KIND, namespace=ns, labels=label)
+            if p.status.ready
+        ]
+        # Pods without an observed sample are excluded; with NO samples at
+        # all there is no basis to scale (k8s HPA: missing metrics never
+        # drive scale-down).
+        samples = [
+            self.metrics[p.metadata.name]
+            for p in pods
+            if p.metadata.name in self.metrics
+        ]
+        if not samples:
+            return None
+        return sum(samples) / len(samples)

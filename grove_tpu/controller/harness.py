@@ -32,6 +32,15 @@ class Harness:
         kwargs = {"engine_cls": engine_cls} if engine_cls else {}
         self.scheduler = GangScheduler(self.cluster, **kwargs)
         self.manager.register(self.scheduler)
+        from .autoscaler import Autoscaler
+
+        self.autoscaler = Autoscaler(self.cluster)
+        self.manager.register(self.autoscaler)
+
+    def autoscale(self) -> None:
+        """One periodic HPA sweep + settle (the HPA sync interval)."""
+        self.autoscaler.run_all()
+        self.settle()
 
     def apply(self, pcs: PodCliqueSet):
         return self.store.create(pcs)

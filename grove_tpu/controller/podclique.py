@@ -110,7 +110,36 @@ class PodCliqueReconciler:
             self._create_pods(pclq, active, want - len(active))
         elif len(active) > want:
             self._delete_excess(pclq, active, len(active) - want)
+        else:
+            self._rolling_replace(pclq, active)
         self._remove_gates(pclq)
+
+    def _rolling_replace(self, pclq: PodClique, active: list[Pod]) -> None:
+        """Pod-at-a-time template rollout (components/pod/rollingupdate.go:
+        73-253): pods whose template-hash label doesn't match the clique's
+        current pod template are outdated. Not-yet-ready outdated pods are
+        replaced immediately; ready outdated pods one at a time, and only
+        while every other pod is ready (no availability dip beyond one)."""
+        current = stable_hash(pclq.spec.pod_spec)
+        outdated = [
+            p
+            for p in active
+            if p.metadata.labels.get(constants.LABEL_POD_TEMPLATE_HASH) != current
+        ]
+        if not outdated:
+            return
+        ns = pclq.metadata.namespace
+        not_ready = [p for p in outdated if not p.status.ready]
+        if not_ready:
+            for pod in not_ready:
+                self.store.delete(Pod.KIND, ns, pod.metadata.name)
+            return
+        if all(p.status.ready for p in active):
+            victim = max(
+                outdated,
+                key=lambda p: int(p.metadata.labels.get(constants.LABEL_POD_INDEX, 0)),
+            )
+            self.store.delete(Pod.KIND, ns, victim.metadata.name)
 
     def _create_pods(self, pclq: PodClique, active: list[Pod], count: int) -> None:
         """Hole-filling indices (index/tracker.go:37-60) + gated creation."""

@@ -39,10 +39,21 @@ class PCSGReconciler:
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
             return [Request(event.namespace, event.name)]
-        if event.kind == PodClique.KIND:
+        if event.kind in (PodClique.KIND, "Pod"):
             pcsg = event.obj.metadata.labels.get(constants.LABEL_PCSG)
             if pcsg:
                 return [Request(event.namespace, pcsg)]
+        if event.kind == PodCliqueSet.KIND:
+            # the PCS rolling update pointing at this PCSG's replica is a
+            # status-level trigger (reconcilespec.go:70-117)
+            return [
+                Request(event.namespace, g.metadata.name)
+                for g in self.store.list(
+                    KIND,
+                    namespace=event.namespace,
+                    labels={constants.LABEL_PART_OF: event.name},
+                )
+            ]
         return []
 
     def reconcile(self, request: Request) -> Result:
@@ -54,9 +65,96 @@ class PCSGReconciler:
         self.store.add_finalizer(
             KIND, request.namespace, request.name, constants.FINALIZER_PCSG
         )
+        self._sync_rolling_update(pcsg)
         self._sync_podcliques(pcsg)
         self._reconcile_status(pcsg)
         return Result()
+
+    def _sync_rolling_update(self, pcsg: PodCliqueScalingGroup) -> None:
+        """Replica-at-a-time rollout, active only while the owning PCS's
+        rolling update points at THIS PCSG's PCS replica
+        (reconcilespec.go:70-117)."""
+        from ..api.types import PCSGRollingUpdateProgress
+        from .updates import clique_template_hashes, clique_updated
+
+        pcs = self._owner_pcs(pcsg)
+        if pcs is None:
+            return
+        pcs_prog = pcs.status.rolling_update_progress
+        my_pcs_replica = int(
+            pcsg.metadata.labels.get(constants.LABEL_PCS_REPLICA_INDEX, -1)
+        )
+        points_at_me = (
+            pcs_prog is not None
+            and not pcs_prog.completed
+            and pcs_prog.current_replica_index == my_pcs_replica
+        )
+        status = pcsg.status
+        before = asdict(status)
+        prog = status.rolling_update_progress
+        if prog is None or (
+            pcs_prog is not None
+            and prog.target_generation_hash != pcs_prog.target_generation_hash
+        ):
+            # INITIATION is gated on the PCS update pointing at this PCSG's
+            # replica; an already-started update toward the SAME target
+            # keeps advancing after the PCS moves on (it only moves on once
+            # our pods are rolled — the bookkeeping must still land). A
+            # stale update toward an OLD target is abandoned so
+            # _sync_podcliques stops propagating outside orchestration.
+            if not points_at_me:
+                if prog is not None and not prog.completed:
+                    status.rolling_update_progress = None
+                    if asdict(status) != before:
+                        self.store.update_status(pcsg)
+                        pcsg.status = status
+                return
+            prog = status.rolling_update_progress = PCSGRollingUpdateProgress(
+                target_generation_hash=pcs_prog.target_generation_hash
+            )
+        if prog.completed:
+            return
+        target = prog.target_generation_hash
+        hashes = clique_template_hashes(pcs)
+        if prog.current_replica_index is not None:
+            j = prog.current_replica_index
+            pclqs = self._replica_pclqs(pcsg, j)
+            done = bool(pclqs) and all(
+                clique_updated(
+                    self.store,
+                    pclq,
+                    hashes.get(
+                        pclq.metadata.labels.get(constants.LABEL_CLIQUE_TEMPLATE, ""),
+                        "",
+                    ),
+                )
+                for pclq in pclqs
+            )
+            if done:
+                prog.updated_replica_indices.append(j)
+                prog.current_replica_index = None
+        if prog.current_replica_index is None:
+            remaining = [
+                j
+                for j in range(pcsg.spec.replicas)
+                if j not in prog.updated_replica_indices
+            ]
+            if not remaining:
+                prog.completed = True
+                status.current_generation_hash = target
+            else:
+                prog.current_replica_index = min(remaining)
+        status.updated_replicas = len(prog.updated_replica_indices)
+        if asdict(status) != before:
+            self.store.update_status(pcsg)
+            pcsg.status = status
+
+    def _replica_pclqs(self, pcsg: PodCliqueScalingGroup, j: int) -> list[PodClique]:
+        return [
+            p
+            for p in self._owned_pclqs(pcsg)
+            if p.metadata.labels.get(constants.LABEL_PCSG_REPLICA_INDEX) == str(j)
+        ]
 
     def _reconcile_delete(self, pcsg: PodCliqueScalingGroup) -> Result:
         ns = pcsg.metadata.namespace
@@ -99,10 +197,23 @@ class PCSGReconciler:
             base_labels(pcs_name),
             **{constants.LABEL_COMPONENT: constants.COMPONENT_PCSG_PODCLIQUE},
         )
+        prog = pcsg.status.rolling_update_progress
+        updating_replica = (
+            prog.current_replica_index
+            if prog is not None and not prog.completed
+            else None
+        )
         for pclq_name, (j, clique_name) in expected.items():
-            if self.store.get(PodClique.KIND, ns, pclq_name) is not None:
-                continue
             template = templates.get(clique_name)
+            existing = self.store.get(PodClique.KIND, ns, pclq_name)
+            if existing is not None:
+                if j == updating_replica and template is not None:
+                    new_spec = copy.deepcopy(template.spec)
+                    new_spec.replicas = existing.spec.replicas
+                    if asdict(existing.spec) != asdict(new_spec):
+                        existing.spec = new_spec
+                        self.store.update(existing)
+                continue
             if template is None:
                 continue
             gang = naming.podgang_name_for_pcsg_replica(

@@ -118,24 +118,93 @@ class PodCliqueSetReconciler:
         self._sync_services(pcs)
         self._sync_hpas(pcs)
         requeue = self._sync_replicas(pcs)
+        self._sync_rolling_update(pcs)
         self._sync_podcliques(pcs)
         self._sync_pcsgs(pcs)
         self._sync_podgangs(pcs)
         return requeue
 
     def _process_generation_hash(self, pcs: PodCliqueSet) -> None:
-        """Template-hash change detection; a change initiates a rolling
-        update (reconcilespec.go:72-122). The update orchestration itself
-        lives in updates.py."""
+        """Template-hash change detection: a change initiates the rolling
+        update (reconcilespec.go:72-122); a further change mid-update
+        restarts it toward the new target."""
+        from ..api.types import PCSRollingUpdateProgress
+
         new_hash = pcs_generation_hash(pcs)
         status = pcs.status
+        before = asdict(status)
         if status.current_generation_hash == "":
             status.current_generation_hash = new_hash
-            status.observed_generation = pcs.metadata.generation
+        elif status.current_generation_hash != new_hash:
+            prog = status.rolling_update_progress
+            if prog is None or prog.target_generation_hash != new_hash:
+                status.rolling_update_progress = PCSRollingUpdateProgress(
+                    update_started_at=self.store.clock.now(),
+                    target_generation_hash=new_hash,
+                )
+        status.observed_generation = pcs.metadata.generation
+        if asdict(status) != before:
             self.store.update_status(pcs)
-        elif status.observed_generation != pcs.metadata.generation:
-            status.observed_generation = pcs.metadata.generation
+            pcs.status = status
+
+    def _sync_rolling_update(self, pcs: PodCliqueSet) -> None:
+        """One-replica-at-a-time orchestration (rollingupdate.go:40-73).
+        Advances current_replica_index as replicas finish (detected by hash
+        propagation, updates.clique_updated); on completion stamps the new
+        generation hash."""
+        from .updates import pick_next_replica
+
+        status = pcs.status
+        prog = status.rolling_update_progress
+        if prog is None or prog.completed:
+            return
+        before = asdict(status)
+        if prog.current_replica_index is not None and self._replica_updated(
+            pcs, prog.current_replica_index
+        ):
+            prog.updated_replica_indices.append(prog.current_replica_index)
+            prog.current_replica_index = None
+        if prog.current_replica_index is None:
+            remaining = [
+                i
+                for i in range(pcs.spec.replicas)
+                if i not in prog.updated_replica_indices
+            ]
+            if not remaining:
+                prog.completed = True
+                status.current_generation_hash = prog.target_generation_hash
+            else:
+                prog.current_replica_index = pick_next_replica(
+                    self.store, pcs, remaining
+                )
+        status.updated_replicas = (
+            pcs.spec.replicas if prog.completed
+            else len(prog.updated_replica_indices)
+        )
+        if asdict(status) != before:
             self.store.update_status(pcs)
+            pcs.status = status
+
+    def _replica_updated(self, pcs: PodCliqueSet, replica: int) -> bool:
+        """All standalone + PCSG-owned cliques of the replica carry the
+        target template and have re-readied (hash-propagation completion)."""
+        from .updates import clique_template_hashes, clique_updated
+
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        hashes = clique_template_hashes(pcs)
+        sel = {
+            constants.LABEL_PART_OF: name,
+            constants.LABEL_PCS_REPLICA_INDEX: str(replica),
+        }
+        pclqs = self.store.list(PodClique.KIND, namespace=ns, labels=sel)
+        if not pclqs:
+            return False
+        for pclq in pclqs:
+            template = pclq.metadata.labels.get(constants.LABEL_CLIQUE_TEMPLATE, "")
+            target = hashes.get(template)
+            if target is None or not clique_updated(self.store, pclq, target):
+                return False
+        return True
 
     # -- components --------------------------------------------------------
     def _sync_rbac(self, pcs: PodCliqueSet) -> None:
@@ -319,8 +388,25 @@ class PodCliqueSetReconciler:
             base_labels(name),
             **{constants.LABEL_COMPONENT: constants.COMPONENT_PCS_PODCLIQUE},
         )
+        prog = pcs.status.rolling_update_progress
+        updating_replica = (
+            prog.current_replica_index
+            if prog is not None and not prog.completed
+            else None
+        )
         for fqn, (i, clique_name, spec) in expected.items():
-            if self.store.get(PodClique.KIND, ns, fqn) is not None:
+            existing = self.store.get(PodClique.KIND, ns, fqn)
+            if existing is not None:
+                # Template propagation is gated on the rolling update: only
+                # the current-update replica receives the new pod template
+                # (one replica at a time; HPA-owned replica counts are
+                # preserved — reference buildResource, podclique.go:308-318).
+                if i == updating_replica:
+                    new_spec = _copy_spec(spec)
+                    new_spec.replicas = existing.spec.replicas
+                    if asdict(existing.spec) != asdict(new_spec):
+                        existing.spec = new_spec
+                        self.store.update(existing)
                 continue
             labels = dict(
                 comp_labels,

@@ -1,0 +1,209 @@
+"""Rolling-update (RU*) and autoscaling (AS*) E2E suites, after the
+reference's rolling_updates_test.go RU7-RU21 scenario family."""
+
+from grove_tpu.api import constants
+from grove_tpu.api.types import (
+    AutoScalingConfig,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodCliqueScalingGroupConfig,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.controller.common import stable_hash
+
+from test_e2e_basic import clique, simple_pcs
+
+
+def bump_image(harness, name="simple1"):
+    pcs = harness.store.get(PodCliqueSet.KIND, "default", name)
+    for c in pcs.spec.template.cliques:
+        c.spec.pod_spec.containers[0].image = "app:v2"
+    return harness.store.update(pcs)
+
+
+def pod_hashes(harness):
+    return {
+        p.metadata.name: p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH]
+        for p in harness.store.list(Pod.KIND)
+    }
+
+
+class TestRU_RollingUpdates:
+    def test_ru1_single_replica_rolls_all_pods(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs())
+        h.settle()
+        before = pod_hashes(h)
+        pcs = bump_image(h)
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        h.settle()
+        after = pod_hashes(h)
+        assert set(after.values()) == {target}
+        assert all(before[n] != after[n] for n in after)
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.rolling_update_progress.completed
+        from grove_tpu.controller.common import pcs_generation_hash
+
+        assert pcs.status.current_generation_hash == pcs_generation_hash(pcs)
+        assert pcs.status.updated_replicas == 1
+        # workload converged back to fully ready
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_ru2_two_replicas_roll_one_at_a_time(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs(replicas=2))
+        h.settle()
+        bump_image(h)
+        # drive manually: after the first manager pass only ONE replica may
+        # have received the new template
+        h.manager.settle()
+        specs = {
+            p.metadata.name: stable_hash(p.spec.pod_spec)
+            for p in h.store.list(PodClique.KIND)
+        }
+        distinct = set(specs.values())
+        assert len(distinct) == 2, "one replica updating, one still old"
+        h.settle()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.rolling_update_progress.completed
+        assert pcs.status.updated_replicas == 2
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_ru3_pod_at_a_time_no_availability_collapse(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        h.settle()
+        bump_image(h)
+        # step the loop tick by tick: at no point may more than one of the
+        # three pods be missing/unready (single-pod-at-a-time for ready pods)
+        for _ in range(64):
+            progressed = h.manager.run_once()
+            h.kubelet.tick()
+            pods = [
+                p for p in h.store.list(Pod.KIND)
+                if p.metadata.deletion_timestamp is None
+            ]
+            ready = sum(1 for p in pods if p.status.ready)
+            assert ready >= 2, f"availability collapsed to {ready}"
+            if progressed == 0:
+                pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+                prog = pcs.status.rolling_update_progress
+                if prog is not None and prog.completed:
+                    break
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.rolling_update_progress.completed
+
+    def test_ru4_pcsg_rolls_replica_at_a_time(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(
+            name="sg",
+            cliques=[clique("w", replicas=2)],
+            sgs=[PodCliqueScalingGroupConfig(name="grp", clique_names=["w"],
+                                             replicas=3, min_available=2)],
+        ))
+        h.settle()
+        bump_image(h, "sg")
+        h.settle()
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "sg-0-grp")
+        assert pcsg.status.rolling_update_progress.completed
+        assert sorted(pcsg.status.rolling_update_progress.updated_replica_indices) \
+            == [0, 1, 2]
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "sg")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        assert set(pod_hashes(h).values()) == {target}
+
+    def test_ru5_update_during_scale_out(self):
+        """RU x scale race: scale-out lands mid-update; everything converges
+        to the new template at the larger size."""
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(
+            name="sg",
+            cliques=[clique("w", replicas=1)],
+            sgs=[PodCliqueScalingGroupConfig(name="grp", clique_names=["w"],
+                                             replicas=2, min_available=1)],
+        ))
+        h.settle()
+        bump_image(h, "sg")
+        h.manager.run_once()  # update starts
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "sg-0-grp")
+        pcsg.spec.replicas = 4
+        h.store.update(pcsg)
+        h.settle()
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "sg")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        hashes = pod_hashes(h)
+        assert len(hashes) == 4
+        assert set(hashes.values()) == {target}
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+class TestAS_Autoscaling:
+    def scaled_pcs(self):
+        pcs = simple_pcs(
+            name="as",
+            cliques=[clique("w", replicas=2)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1,
+                scale_config=AutoScalingConfig(min_replicas=1, max_replicas=5,
+                                               target_utilization=0.5))],
+        )
+        return pcs
+
+    def test_as1_hpa_object_created(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.scaled_pcs())
+        h.settle()
+        hpa = h.store.get("HorizontalPodAutoscaler", "default", "as-0-grp-hpa")
+        assert hpa is not None
+        assert hpa.spec.target_kind == PodCliqueScalingGroup.KIND
+        assert (hpa.spec.min_replicas, hpa.spec.max_replicas) == (1, 5)
+
+    def test_as2_scale_out_on_load_creates_scaled_gangs(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.scaled_pcs())
+        h.settle()
+        for p in h.store.list(Pod.KIND):
+            h.autoscaler.observe(p.metadata.name, 1.0)  # 2x the 0.5 target
+        h.autoscale()
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+        assert pcsg.spec.replicas == 4  # ceil(2 * 1.0/0.5)
+        gangs = sorted(g.metadata.name for g in h.store.list("PodGang"))
+        assert gangs == ["as-0", "as-0-grp-0", "as-0-grp-1", "as-0-grp-2"]
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_as3_scale_in_on_idle(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.scaled_pcs())
+        h.settle()
+        for p in h.store.list(Pod.KIND):
+            h.autoscaler.observe(p.metadata.name, 0.05)
+        h.autoscale()
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+        assert pcsg.spec.replicas == 1
+        assert sorted(g.metadata.name for g in h.store.list("PodGang")) == ["as-0"]
+
+    def test_as4_within_tolerance_no_scale(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.scaled_pcs())
+        h.settle()
+        for p in h.store.list(Pod.KIND):
+            h.autoscaler.observe(p.metadata.name, 0.52)  # within 10% of 0.5
+        h.autoscale()
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+        assert pcsg.spec.replicas == 2
+
+    def test_as5_no_metrics_no_scale(self):
+        """Missing metrics must never drive scale-down (review finding)."""
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.scaled_pcs())
+        h.settle()
+        h.autoscale()  # no observe() calls at all
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+        assert pcsg.spec.replicas == 2
