@@ -1,0 +1,14 @@
+"""Native (C++) components, loaded through ctypes.
+
+The compute path of grove_tpu is JAX/XLA; the native layer holds the parts
+a production control plane keeps in compiled code. Today: the serial
+baseline scorer (serial_scorer.cpp) standing in for the reference's
+external serial Go scorer, so benchmark speedups are measured against
+compiled code. Build is lazy and cached; everything degrades gracefully to
+the pure-Python implementations when no toolchain is present.
+"""
+
+from .build import native_available
+from .serial_native import solve_serial_native
+
+__all__ = ["native_available", "solve_serial_native"]

@@ -1,0 +1,54 @@
+"""Lazy, cached g++ build of the native library (ctypes, no pybind11)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).with_name("serial_scorer.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_path() -> Path:
+    src_hash = hashlib.sha1(_SRC.read_bytes()).hexdigest()[:12]
+    cache_dir = Path(
+        os.environ.get("GROVE_TPU_NATIVE_CACHE", tempfile.gettempdir())
+    ) / "grove_tpu_native"
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    return cache_dir / f"serial_scorer-{src_hash}.so"
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Compile (once, content-hashed cache) and dlopen; None if no g++."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    so = _cache_path()
+    try:
+        if not so.exists():
+            tmp = so.with_suffix(".tmp.so")
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 str(_SRC), "-o", str(tmp)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(str(so))
+        lib.solve_serial.restype = ctypes.c_int32
+        _lib = lib
+    except (OSError, subprocess.SubprocessError):
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None

@@ -1,0 +1,185 @@
+"""ctypes wrapper: SolverGangs -> flat arrays -> C++ solve_serial.
+
+Same problem encoding as the Python serial path; the caller pre-sorts
+gangs by (priority desc, name) exactly like serial.solve_serial so both
+baselines walk gangs in the identical order. Group preferred levels and
+constraint groups are approximated as unconstrained here (the C++ baseline
+implements one nesting level of REQUIRED group constraints); the Python
+paths remain the semantic reference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..solver.fit import placement_score_for_nodes
+from ..solver.problem import SolverGang
+from ..solver.result import GangPlacement, SolveResult
+from ..solver.serial import gang_sort_key
+from ..topology.encoding import TopologySnapshot
+from .build import load_library
+
+
+def solve_serial_native(
+    snapshot: TopologySnapshot,
+    gangs: list[SolverGang],
+    free: np.ndarray | None = None,
+) -> SolveResult | None:
+    """Returns None when the native library is unavailable."""
+    lib = load_library()
+    if lib is None:
+        return None
+    t0 = time.perf_counter()
+    order = sorted(gangs, key=gang_sort_key)
+    n, r = snapshot.num_nodes, len(snapshot.resource_names)
+    if free is None:
+        free = snapshot.free.copy()
+
+    pod_offsets = np.zeros(len(order) + 1, np.int32)
+    group_offsets = np.zeros(len(order) + 1, np.int32)
+    demands, group_ids, group_levels, required = [], [], [], []
+    for i, g in enumerate(order):
+        pod_offsets[i + 1] = pod_offsets[i] + g.num_pods
+        group_offsets[i + 1] = group_offsets[i] + len(g.group_names)
+        demands.append(g.demand)
+        group_ids.append(g.group_ids)
+        group_levels.append(g.group_required_level)
+        required.append(g.required_level)
+    demand = np.concatenate(demands).astype(np.float32)
+    group_ids_arr = np.concatenate(group_ids).astype(np.int32)
+    group_levels_arr = np.concatenate(group_levels).astype(np.int32)
+    required_arr = np.asarray(required, np.int32)
+    assign = np.full(int(pod_offsets[-1]), -1, np.int32)
+
+    cap = np.ascontiguousarray(snapshot.capacity, np.float32)
+    free_c = np.ascontiguousarray(free, np.float32)
+    sched = np.ascontiguousarray(snapshot.schedulable, np.uint8)
+    dom_ids = np.ascontiguousarray(snapshot.domain_ids, np.int32)
+
+    import ctypes as ct
+
+    def ptr(a, typ):
+        return a.ctypes.data_as(ct.POINTER(typ))
+
+    lib.solve_serial(
+        ct.c_int32(n), ct.c_int32(r), ct.c_int32(snapshot.num_levels),
+        ptr(cap, ct.c_float), ptr(free_c, ct.c_float),
+        ptr(sched, ct.c_uint8), ptr(dom_ids, ct.c_int32),
+        ct.c_int32(len(order)),
+        ptr(pod_offsets, ct.c_int32), ptr(demand, ct.c_float),
+        ptr(required_arr, ct.c_int32), ptr(group_ids_arr, ct.c_int32),
+        ptr(group_offsets, ct.c_int32), ptr(group_levels_arr, ct.c_int32),
+        ptr(assign, ct.c_int32),
+    )
+
+    result = SolveResult()
+    for i, g in enumerate(order):
+        a = assign[pod_offsets[i] : pod_offsets[i + 1]].astype(np.int64)
+        if (a < 0).any():
+            result.unplaced[g.name] = "no feasible domain"
+            continue
+        result.placed[g.name] = GangPlacement(
+            gang=g,
+            pod_to_node={
+                g.pod_names[j]: snapshot.node_names[a[j]]
+                for j in range(g.num_pods)
+            },
+            node_indices=a,
+            placement_score=placement_score_for_nodes(snapshot, a),
+        )
+        for j in range(g.num_pods):
+            free[a[j]] -= g.demand[j]
+    result.wall_seconds = time.perf_counter() - t0
+    return result
+
+
+def repair_native(
+    snapshot: TopologySnapshot,
+    order: list[SolverGang],
+    top_val: np.ndarray,
+    top_dom: np.ndarray,
+    dom_level: np.ndarray,
+    dom_offsets: np.ndarray,
+    free: np.ndarray,
+):
+    """Native commit phase for the accelerator path. Returns
+    (placements dict, fallback count) or None if the library is missing.
+    MUTATES free in place (like the Python repair loop).
+
+    Only called for native-compatible backlogs: no constraint groups and no
+    group-preferred levels (PlacementEngine gates on gang_native_compatible).
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    n, r = snapshot.num_nodes, len(snapshot.resource_names)
+    g = len(order)
+    pod_offsets = np.zeros(g + 1, np.int32)
+    group_offsets = np.zeros(g + 1, np.int32)
+    demands, group_ids, group_levels, required = [], [], [], []
+    for i, gang in enumerate(order):
+        pod_offsets[i + 1] = pod_offsets[i] + gang.num_pods
+        group_offsets[i + 1] = group_offsets[i] + len(gang.group_names)
+        demands.append(gang.demand)
+        group_ids.append(gang.group_ids)
+        group_levels.append(gang.group_required_level)
+        required.append(gang.required_level)
+    demand = np.ascontiguousarray(np.concatenate(demands), np.float32)
+    group_ids_arr = np.ascontiguousarray(np.concatenate(group_ids), np.int32)
+    group_levels_arr = np.ascontiguousarray(np.concatenate(group_levels), np.int32)
+    required_arr = np.ascontiguousarray(required, np.int32)
+    assign = np.full(int(pod_offsets[-1]), -1, np.int32)
+
+    cap = np.ascontiguousarray(snapshot.capacity, np.float32)
+    free_c = np.ascontiguousarray(free, np.float32)
+    sched = np.ascontiguousarray(snapshot.schedulable, np.uint8)
+    dom_ids = np.ascontiguousarray(snapshot.domain_ids, np.int32)
+    top_dom_c = np.ascontiguousarray(top_dom[:g], np.int32)
+    top_val_c = np.ascontiguousarray(top_val[:g], np.float32)
+    dom_level_c = np.ascontiguousarray(dom_level, np.int32)
+    dom_offsets_c = np.ascontiguousarray(dom_offsets, np.int32)
+
+    import ctypes as ct
+
+    def ptr(a, typ):
+        return a.ctypes.data_as(ct.POINTER(typ))
+
+    fallbacks = ct.c_int32(0)
+    lib.repair_gangs.restype = ct.c_int32
+    lib.repair_gangs(
+        ct.c_int32(n), ct.c_int32(r), ct.c_int32(snapshot.num_levels),
+        ptr(cap, ct.c_float), ptr(free_c, ct.c_float),
+        ptr(sched, ct.c_uint8), ptr(dom_ids, ct.c_int32),
+        ct.c_int32(g), ptr(pod_offsets, ct.c_int32), ptr(demand, ct.c_float),
+        ptr(required_arr, ct.c_int32), ptr(group_ids_arr, ct.c_int32),
+        ptr(group_offsets, ct.c_int32), ptr(group_levels_arr, ct.c_int32),
+        ptr(top_dom_c, ct.c_int32), ptr(top_val_c, ct.c_float),
+        ct.c_int32(top_dom_c.shape[1]),
+        ptr(dom_level_c, ct.c_int32), ptr(dom_offsets_c, ct.c_int32),
+        ptr(assign, ct.c_int32), ct.byref(fallbacks),
+    )
+
+    placements = {}
+    for i, gang in enumerate(order):
+        a = assign[pod_offsets[i] : pod_offsets[i + 1]].astype(np.int64)
+        if (a < 0).any():
+            continue
+        placements[gang.name] = GangPlacement(
+            gang=gang,
+            pod_to_node={
+                gang.pod_names[j]: snapshot.node_names[a[j]]
+                for j in range(gang.num_pods)
+            },
+            node_indices=a,
+            placement_score=placement_score_for_nodes(snapshot, a),
+        )
+        for j in range(gang.num_pods):
+            free[a[j]] -= gang.demand[j]
+    return placements, int(fallbacks.value)
+
+
+def gang_native_compatible(gang: SolverGang) -> bool:
+    """The C++ paths implement required group constraints only."""
+    return not gang.constraint_groups and (gang.group_preferred_level < 0).all()

@@ -74,7 +74,8 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, nlevels_p1: int, top_k: int):
             P(),                 # dom_level   [D]
             P(),                 # anc_ids     [D, L+1]
             P("gangs", None),    # total_demand[G, R]
-            P("gangs", None),    # max_pod     [G, R]
+            P(),                 # u_max_pod   [U, R] (unique rows, replicated)
+            P("gangs"),          # max_pod_inverse [G]
             P("gangs"),          # required_level [G]
             P("gangs"),          # preferred_level[G]
             P("gangs"),          # valid       [G]
@@ -87,14 +88,16 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, nlevels_p1: int, top_k: int):
         # asserted instead by test_sharded_matches_single_device.
         check_vma=False,
     )
-    def fn(free, gdom, dom_level, anc_ids, total_demand, max_pod,
-           required_level, preferred_level, valid, cap_scale):
+    def fn(free, gdom, dom_level, anc_ids, total_demand, u_max_pod,
+           max_pod_inverse, required_level, preferred_level, valid, cap_scale):
         m = membership_matrix(gdom, num_domains)             # [Nl, D]
         dom_free = jax.lax.psum(m.T @ free, "nodes")         # [D, R]
         node_fits = jnp.all(
-            free[None, :, :] + 1e-6 >= max_pod[:, None, :], axis=-1
-        ).astype(jnp.float32)                                # [Gl, Nl]
-        cnt_fit = jax.lax.psum(node_fits @ m, "nodes")       # [Gl, D]
+            free[None, :, :] + 1e-6 >= u_max_pod[:, None, :], axis=-1
+        ).astype(jnp.float32)                                # [U, Nl]
+        cnt_fit = jax.lax.psum(node_fits @ m, "nodes")[
+            max_pod_inverse
+        ]                                                    # [Gl, D]
         value_l = value_from_aggregates(
             dom_free, cnt_fit, dom_level, total_demand, required_level,
             preferred_level, valid, cap_scale, nlevels_p1,
@@ -146,13 +149,15 @@ class ShardedPlacementEngine(PlacementEngine):
             return self._pad_nodes(a, 0, gangs_axis)
 
         g = total_demand.shape[0]
+        u_max_pod, inverse = self._unique_max_pods(max_pod)
         top_val, top_dom = self._fn(
             jnp.asarray(self._pad_nodes(dev_free, 0, nodes_axis)),
             jnp.asarray(self._pad_nodes(self.space.gdom, 1, nodes_axis)),
             jnp.asarray(self.space.dom_level),
             jnp.asarray(self.space.anc_ids),
             jnp.asarray(pad_g(total_demand)),
-            jnp.asarray(pad_g(max_pod)),
+            jnp.asarray(u_max_pod),
+            jnp.asarray(pad_g(inverse)),
             jnp.asarray(pad_g(required_level)),
             jnp.asarray(pad_g(preferred_level)),
             jnp.asarray(pad_g(valid)),

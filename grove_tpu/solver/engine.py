@@ -134,47 +134,78 @@ def value_from_aggregates(
     allowed = dom_level[None, :] >= required_level[:, None]
     level_score = (dom_level.astype(jnp.float32) + 2.0) / jnp.float32(nlevels_p1 + 1)
     pref_bonus = (dom_level[None, :] >= preferred_level[:, None]).astype(jnp.float32)
-    slack = jnp.max(
-        (dom_free[None, :, :] - total_demand[:, None, :])
-        / cap_scale[None, None, :],
-        axis=-1,
-    )
+    # Per-resource loop (R is tiny and static) instead of a [G, D, R]
+    # broadcast: a 3-wide minor dimension wastes the TPU's 128-lane
+    # registers and turned this into the hot spot.
+    slack = None
+    for res in range(dom_free.shape[1]):
+        cur = (dom_free[:, res][None, :] - total_demand[:, res][:, None]) / cap_scale[res]
+        slack = cur if slack is None else jnp.maximum(slack, cur)
     slack = slack / (1.0 + jnp.abs(slack))  # squash: ordering, not magnitude
     value = 4.0 * level_score[None, :] + 1.0 * pref_bonus - 0.5 * slack
     static_mask = (cnt_fit >= 1.0) & allowed & valid[:, None]
     return jnp.where(static_mask, value, _NEG)
 
 
-def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int):
-    """Contention pass: sequential virtual commit in priority order (= row
-    order). resid carries residual aggregate capacity per domain (+1
-    absorbing dummy row for ancestor-chain padding); each gang takes its
-    best residually feasible domain, records its top-k residual-feasible
-    alternates, and the chosen domain's whole ancestor chain is decremented
-    before the next gang chooses."""
-    d = dom_free.shape[0]
+def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int,
+                chunk: int = 32):
+    """Contention pass: virtual commit in priority order (= row order),
+    CHUNKED for device efficiency. resid carries residual aggregate
+    capacity per domain (+1 absorbing dummy row for ancestor-chain
+    padding).
+
+    Gangs are processed `chunk` at a time: every gang in a chunk picks its
+    best residually-feasible domain against the same residual state, then
+    all chunk choices are committed (demand scattered up the ancestor
+    chains) before the next chunk. A deterministic sub-quantum jitter
+    spreads exactly-tied gangs across equally-good domains so a chunk of
+    identical gangs doesn't pile onto one argmax winner. Within-chunk
+    collisions can transiently overcommit a domain; the EXACT host repair
+    phase resolves them (and strict priority order is restored there),
+    which is the same score-approximate/commit-exact contract the whole
+    engine is built on. Wall-clock: G/chunk scan iterations instead of G.
+    """
+    g_total, d = value.shape
+    chunk = max(1, min(chunk, g_total))
+    while g_total % chunk:
+        chunk -= 1  # g_total is a power-of-two bucket; chunk normally stays 32
     resid0 = jnp.concatenate(
         [dom_free, jnp.zeros((1, dom_free.shape[1]), jnp.float32)], axis=0
     )
+    # Deterministic tie-break jitter, far below the value function's
+    # quanta. Integer hash mixing (murmur-style) — a multiplicative
+    # congruence here has lattice structure that correlates different
+    # gangs' top choices and piles chunk-mates onto the same domains.
+    gi = jnp.arange(g_total, dtype=jnp.uint32)[:, None]
+    di = jnp.arange(d, dtype=jnp.uint32)[None, :]
+    h = gi * jnp.uint32(0x9E3779B1) + di * jnp.uint32(0x85EBCA77)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    jitter = 1e-4 * (h.astype(jnp.float32) / jnp.float32(2**32))
+    jittered = jnp.where(value > _NEG / 2, value + jitter, value)
 
-    def step(resid, g):
-        fits = jnp.all(
-            resid[:d] + 1e-6 >= total_demand[g][None, :], axis=-1
-        )                                                    # [D]
-        row = jnp.where(fits, value[g], _NEG)
-        best_val, best_dom = jax.lax.top_k(row, top_k)
-        choice = best_dom[0]
-        ok = best_val[0] > _NEG / 2
-        # commit demand up the ancestor chain (dummy row absorbs padding
-        # and the not-placeable case)
-        chain = jnp.where(ok, anc_ids[choice], d)
-        resid = resid.at[chain].add(-total_demand[g][None, :])
+    def step(resid, gs):  # gs: [chunk] gang indices
+        td = total_demand[gs]                                # [C, R]
+        # per-resource loop on [C, D] for lane-friendly layout (see
+        # value_from_aggregates)
+        fits = None
+        for res in range(td.shape[1]):
+            cur = resid[:d, res][None, :] + 1e-6 >= td[:, res][:, None]
+            fits = cur if fits is None else (fits & cur)     # [C, D]
+        rows = jnp.where(fits, jittered[gs], _NEG)
+        best_val, best_dom = jax.lax.top_k(rows, top_k)      # [C, K]
+        choice = best_dom[:, 0]
+        ok = best_val[:, 0] > _NEG / 2
+        chains = jnp.where(ok[:, None], anc_ids[choice], d)  # [C, L+1]
+        resid = resid.at[chains.reshape(-1)].add(
+            -jnp.repeat(td, chains.shape[1], axis=0)
+        )
         return resid, (best_val, best_dom)
 
-    _, (top_val, top_dom) = jax.lax.scan(
-        step, resid0, jnp.arange(total_demand.shape[0])
-    )
-    return top_val, top_dom
+    chunks = jnp.arange(g_total).reshape(g_total // chunk, chunk)
+    _, (top_val, top_dom) = jax.lax.scan(step, resid0, chunks)
+    return top_val.reshape(g_total, -1), top_dom.reshape(g_total, -1)
 
 
 @partial(
@@ -187,7 +218,8 @@ def _device_score(
     dom_level,       # i32 [D]
     anc_ids,         # i32 [D, L+1] ancestor chains (padded with D)
     total_demand,    # f32 [G, R]
-    max_pod,         # f32 [G, R]
+    u_max_pod,       # f32 [U, R] UNIQUE max-pod demand rows
+    max_pod_inverse, # i32 [G] gang -> unique row
     required_level,  # i32 [G]
     preferred_level, # i32 [G]
     valid,           # bool [G]
@@ -200,24 +232,37 @@ def _device_score(
     m = membership_matrix(gdom, num_domains)
     dom_free = m.T @ free                                   # [D, R]
     # Node-granularity proxy: #nodes able to host the gang's largest pod.
+    # Gangs come from few pod templates, so the [G, N] fit matrix collapses
+    # to its U unique rows (U << G) before the MXU product — the dominant
+    # FLOP term of the whole device phase scales with U, not G.
     node_fits = jnp.all(
-        free[None, :, :] + 1e-6 >= max_pod[:, None, :], axis=-1
-    ).astype(jnp.float32)                                   # [G, N]
-    cnt_fit = node_fits @ m                                 # [G, D] (MXU)
+        free[None, :, :] + 1e-6 >= u_max_pod[:, None, :], axis=-1
+    ).astype(jnp.float32)                                   # [U, N]
+    cnt_fit = (node_fits @ m)[max_pod_inverse]              # [G, D]
     value = value_from_aggregates(
         dom_free, cnt_fit, dom_level, total_demand, required_level,
         preferred_level, valid, cap_scale, nlevels_p1,
     )
-    return commit_scan(value, dom_free, anc_ids, total_demand, top_k)
+    top_val, top_dom = commit_scan(value, dom_free, anc_ids, total_demand, top_k)
+    # Pack both outputs into ONE array: a host fetch through the dev
+    # tunnel has large fixed latency, so results ship in a single
+    # transfer (domain ids < 2^24 are exact in f32).
+    return jnp.concatenate([top_val, top_dom.astype(jnp.float32)], axis=1)
 
 
 class PlacementEngine:
     """Batched TPU-path solver bound to one topology snapshot."""
 
-    def __init__(self, snapshot: TopologySnapshot, top_k: int = 8):
+    def __init__(
+        self,
+        snapshot: TopologySnapshot,
+        top_k: int = 8,
+        native_repair: bool = True,
+    ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
         self.top_k = top_k
+        self.native_repair = native_repair
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
 
     def solve(
@@ -261,6 +306,42 @@ class PlacementEngine:
         )
         result.stats["device_seconds"] = time.perf_counter() - t_dev
 
+        placed_map, fallbacks = self._repair(order, top_val, top_dom, free)
+        for gang in order:
+            if gang.name in placed_map:
+                result.placed[gang.name] = placed_map[gang.name]
+            else:
+                result.unplaced[gang.name] = "no feasible domain"
+        result.stats["fallbacks"] = float(fallbacks)
+        result.wall_seconds = time.perf_counter() - t0
+        return result
+
+    def _repair(self, order, top_val, top_dom, free):
+        """Exact commit phase. Uses the native (C++) implementation when the
+        backlog is native-compatible (no constraint groups / group
+        preferences — grove_tpu/native/serial_scorer.cpp implements required
+        group constraints only); otherwise the Python fit primitives, which
+        are the semantic reference."""
+        if self.native_repair:
+            from ..native.serial_native import (
+                gang_native_compatible,
+                repair_native,
+            )
+
+            if all(gang_native_compatible(g) for g in order):
+                out = repair_native(
+                    self.snapshot,
+                    order,
+                    top_val,
+                    top_dom,
+                    self.space.dom_level,
+                    np.asarray(self.space.offsets[:-1], np.int32),
+                    free,
+                )
+                if out is not None:
+                    return out
+        snapshot = self.snapshot
+        placed_map = {}
         fallbacks = 0
         for i, gang in enumerate(order):
             placed = None
@@ -278,25 +359,33 @@ class PlacementEngine:
                 # Exactness net: stale scores or all-candidates-conflicted.
                 fallbacks += 1
                 placed = _place_one(gang, snapshot, free, self._sched_nodes)
-            if placed is None:
-                result.unplaced[gang.name] = "no feasible domain"
-            else:
-                result.placed[gang.name] = placed
-        result.stats["fallbacks"] = float(fallbacks)
-        result.wall_seconds = time.perf_counter() - t0
-        return result
+            if placed is not None:
+                placed_map[gang.name] = placed
+        return placed_map, fallbacks
+
+    @staticmethod
+    def _unique_max_pods(max_pod: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Collapse per-gang max-pod rows to unique rows + inverse, padded
+        to a small power-of-two bucket to keep jit cache keys stable."""
+        u, inverse = np.unique(max_pod, axis=0, return_inverse=True)
+        u_pad = _bucket(u.shape[0], minimum=4)
+        if u.shape[0] < u_pad:
+            u = np.vstack([u, np.zeros((u_pad - u.shape[0], u.shape[1]), u.dtype)])
+        return u.astype(np.float32), inverse.astype(np.int32)
 
     def _device_phase(self, dev_free, total_demand, max_pod, required_level,
                       preferred_level, valid, cap_scale):
         """Single-device scoring; ShardedPlacementEngine overrides this with
         the mesh-SPMD version (grove_tpu/parallel/sharded.py)."""
-        top_val, top_dom = _device_score(
+        u_max_pod, inverse = self._unique_max_pods(max_pod)
+        packed = _device_score(
             jnp.asarray(dev_free),
             jnp.asarray(self.space.gdom),
             jnp.asarray(self.space.dom_level),
             jnp.asarray(self.space.anc_ids),
             jnp.asarray(total_demand),
-            jnp.asarray(max_pod),
+            jnp.asarray(u_max_pod),
+            jnp.asarray(inverse),
             jnp.asarray(required_level),
             jnp.asarray(preferred_level),
             jnp.asarray(valid),
@@ -304,7 +393,9 @@ class PlacementEngine:
             num_domains=self.space.num_domains,
             top_k=min(self.top_k, self.space.num_domains),
         )
-        return np.asarray(top_val), np.asarray(top_dom)
+        packed = np.asarray(packed)  # single D2H transfer
+        k = packed.shape[1] // 2
+        return packed[:, :k], packed[:, k:].astype(np.int32)
 
     def _mk_placement(self, gang: SolverGang, assign: np.ndarray) -> GangPlacement:
         return GangPlacement(

@@ -1,0 +1,83 @@
+"""Native serial scorer parity vs the Python serial baseline."""
+
+import numpy as np
+import pytest
+
+from grove_tpu.native import native_available, solve_serial_native
+from grove_tpu.solver import solve_serial
+
+from test_solver import cluster, gang
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain"
+)
+
+
+def backlog():
+    return [
+        gang("a", pods=2, cpu=2.0),
+        gang("b", pods=4, cpu=6.0, required=1),
+        gang("c", pods=3, cpu=3.0),
+        gang("d", pods=4, cpu=6.0,
+             group_levels=[(2, 1, -1), (2, 1, -1)], required=0),
+        gang("infeasible", pods=4, cpu=9.0),
+        gang("prio", pods=1, cpu=8.0, priority=5.0),
+    ]
+
+
+class TestNativeParity:
+    def test_matches_python_serial(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = backlog()
+        py = solve_serial(snap, gangs)
+        cc = solve_serial_native(snap, gangs)
+        assert cc is not None
+        assert set(cc.placed) == set(py.placed)
+        assert set(cc.unplaced) == set(py.unplaced)
+        for name in py.placed:
+            np.testing.assert_array_equal(
+                cc.placed[name].node_indices, py.placed[name].node_indices
+            )
+            assert cc.placed[name].placement_score == pytest.approx(
+                py.placed[name].placement_score
+            )
+
+    def test_capacity_respected_under_contention(self):
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=8.0)
+        gangs = [gang(f"g{i}", pods=2, cpu=8.0, required=1) for i in range(3)]
+        cc = solve_serial_native(snap, gangs)
+        py = solve_serial(snap, gangs)
+        assert set(cc.placed) == set(py.placed)
+        used = np.zeros_like(snap.free)
+        for p in cc.placed.values():
+            for j, n in enumerate(p.node_indices):
+                used[n] += p.gang.demand[j]
+        assert (used <= snap.free + 1e-6).all()
+
+    def test_cordoned_nodes_skipped(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        snap.schedulable[0] = False
+        cc = solve_serial_native(snap, [gang("a", pods=1, cpu=2.0)])
+        assert list(cc.placed["a"].node_indices) == [1]
+
+
+class TestNativeRepairParity:
+    def test_engine_native_repair_matches_python_repair(self):
+        snap = cluster(blocks=2, racks=4, hosts=4, cpu=8.0)
+        gangs = [
+            gang(f"g{i}", pods=2, cpu=4.0, tpu=2.0, required=1) for i in range(8)
+        ] + [
+            gang("lw", pods=4, cpu=6.0,
+                 group_levels=[(2, 1, -1), (2, 1, -1)], required=0),
+            gang("big", pods=6, cpu=5.0),
+        ]
+        from grove_tpu.solver import PlacementEngine
+
+        nat = PlacementEngine(snap, native_repair=True).solve(gangs)
+        py = PlacementEngine(snap, native_repair=False).solve(gangs)
+        assert set(nat.placed) == set(py.placed)
+        for name in py.placed:
+            np.testing.assert_array_equal(
+                nat.placed[name].node_indices, py.placed[name].node_indices
+            )
+        assert nat.stats["fallbacks"] == py.stats["fallbacks"]
