@@ -150,17 +150,21 @@ class ShardedPlacementEngine(PlacementEngine):
 
         g = total_demand.shape[0]
         u_max_pod, inverse = self._unique_max_pods(max_pod)
+        # Hand numpy arrays straight to the jitted shard_map fn: jit places
+        # them per in_specs onto the MESH's devices. An eager jnp.asarray
+        # here would commit them to the default backend instead — under the
+        # driver env that default is a TPU client the dry run must not touch.
         top_val, top_dom = self._fn(
-            jnp.asarray(self._pad_nodes(dev_free, 0, nodes_axis)),
-            jnp.asarray(self._pad_nodes(self.space.gdom, 1, nodes_axis)),
-            jnp.asarray(self.space.dom_level),
-            jnp.asarray(self.space.anc_ids),
-            jnp.asarray(pad_g(total_demand)),
-            jnp.asarray(u_max_pod),
-            jnp.asarray(pad_g(inverse)),
-            jnp.asarray(pad_g(required_level)),
-            jnp.asarray(pad_g(preferred_level)),
-            jnp.asarray(pad_g(valid)),
-            jnp.asarray(cap_scale),
+            self._pad_nodes(dev_free, 0, nodes_axis),
+            self._pad_nodes(self.space.gdom, 1, nodes_axis),
+            self.space.dom_level,
+            self.space.anc_ids,
+            pad_g(total_demand),
+            u_max_pod,
+            pad_g(inverse),
+            pad_g(required_level),
+            pad_g(preferred_level),
+            pad_g(valid),
+            cap_scale,
         )
         return np.asarray(top_val)[:g], np.asarray(top_dom)[:g]
