@@ -16,6 +16,8 @@ from ..api import (
     validate_podcliqueset,
     validate_podcliqueset_update,
 )
+from ..api.auxiliary import PriorityClass
+from ..api.meta import ObjectMeta
 from ..api.types import ClusterTopology, Node, Pod, PodPhase
 from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
 from .clock import SimClock
@@ -48,6 +50,17 @@ class Cluster:
             else _infer_levels(nodes)
         )
         self.store.create(self.topology)
+        # Built-in PriorityClasses (k8s seeds the system-* pair the same
+        # way); user-defined classes are created like any other object.
+        for pc_name, value in (
+            ("system-cluster-critical", 2_000_000_000.0),
+            ("system-node-critical", 2_000_001_000.0),
+        ):
+            self.store.create(
+                PriorityClass(
+                    metadata=ObjectMeta(name=pc_name, namespace=""), value=value
+                )
+            )
         for node in nodes or []:
             self.store.create(node)
 
@@ -79,9 +92,22 @@ class Cluster:
                 per_node[res] = per_node.get(res, 0.0) + amount
         return out
 
+    def live_topology(self) -> ClusterTopology:
+        """The stored singleton ClusterTopology when present, else the
+        bootstrap object. Scheduling must follow topology UPDATES made
+        through the store — the PCS reconciler already reads the store for
+        constraint translation, and the solver snapshot has to agree with it
+        or unknown keys silently weaken to unconstrained."""
+        ct = self.store.get(
+            ClusterTopology.KIND,
+            self.topology.metadata.namespace,
+            self.topology.metadata.name,
+        )
+        return ct if ct is not None else self.topology
+
     def topology_snapshot(self) -> TopologySnapshot:
         return encode_topology(
-            self.topology, self.store.list(Node.KIND), usage=self.usage()
+            self.live_topology(), self.store.list(Node.KIND), usage=self.usage()
         )
 
     def pod_demand_fn(self, resource_names: list[str]):

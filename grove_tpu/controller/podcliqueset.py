@@ -34,6 +34,7 @@ from ..api.auxiliary import (
 from ..api.meta import NamespacedName, get_condition, set_condition
 from ..api.podgang import (
     PodGang,
+    PodGangConditionType,
     PodGangSpec,
     PodGroup,
     TopologyConstraint,
@@ -71,6 +72,13 @@ class PodCliqueSetReconciler:
             owner = event.obj.metadata.labels.get(constants.LABEL_PART_OF)
             if owner:
                 return [Request(event.namespace, owner)]
+        if event.kind == ClusterTopology.KIND:
+            # Level set changed: every PCS must re-translate its PodGang
+            # constraints and refresh TopologyLevelsUnavailable.
+            return [
+                Request(p.metadata.namespace, p.metadata.name)
+                for p in self.store.list(KIND)
+            ]
         return []
 
     # -- reconcile ---------------------------------------------------------
@@ -367,6 +375,19 @@ class PodCliqueSetReconciler:
             if pclq.metadata.deletion_timestamp is None:
                 self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
         for gang in self.store.list(PodGang.KIND, namespace=ns, labels=sel):
+            # Mark the victim BEFORE deletion (podgang.go:156-169): the
+            # scheduler-side contract distinguishes deliberate disruption
+            # (gang termination) from member failure, and the marking is
+            # observable in the store's event log.
+            set_condition(
+                gang.status.conditions,
+                PodGangConditionType.DISRUPTION_TARGET.value,
+                "True",
+                reason="GangTerminationDelayExpired",
+                message="MinAvailable breached longer than terminationDelay",
+                now=self.store.clock.now(),
+            )
+            self.store.update_status(gang)
             self.store.delete(PodGang.KIND, ns, gang.metadata.name)
 
     def _sync_podcliques(self, pcs: PodCliqueSet) -> None:
@@ -691,14 +712,18 @@ def _translate(
 ) -> Optional[TopologyConstraint]:
     """Operator-side domain names -> scheduler-contract label keys
     (the KAI Topology CR hand-off in the reference, clustertopology.go:
-    141-175; here a direct translation). Unknown domains are dropped — the
-    PCS status carries TopologyLevelsUnavailable instead."""
+    141-175; here a direct translation). An unknown PREFERRED domain is
+    dropped (best-effort); an unknown REQUIRED domain is passed through as
+    an `unresolved:` sentinel key that can never match a snapshot level, so
+    the solver marks the gang unschedulable instead of silently scheduling a
+    hard constraint unconstrained. The PCS status additionally carries
+    TopologyLevelsUnavailable."""
     if tc is None or tc.pack_constraint is None:
         return None
     req = tc.pack_constraint.required
     pref = tc.pack_constraint.preferred
     out = TopologyPackConstraint(
-        required=levels.get(req) if req else None,
+        required=levels.get(req, f"unresolved:{req}") if req else None,
         preferred=levels.get(pref) if pref else None,
     )
     if out.required is None and out.preferred is None:

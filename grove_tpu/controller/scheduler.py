@@ -26,12 +26,14 @@ from typing import Optional
 import numpy as np
 
 from ..api import constants
+from ..api.auxiliary import PriorityClass
 from ..api.meta import get_condition, set_condition
 from ..api.podgang import PodGang, PodGangConditionType, PodGangPhase
-from ..api.types import Node, Pod, PodPhase
+from ..api.types import ClusterTopology, Node, Pod, PodPhase
 from ..cluster.cluster import Cluster
 from ..cluster.store import Event
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
+from ..solver.problem import UNRESOLVED_LEVEL, _resolve_level
 from .runtime import Request, Result
 
 RETRY_SECONDS = constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS
@@ -51,6 +53,9 @@ class GangScheduler:
             return [_SINGLETON_REQ]
         if event.kind == Pod.KIND:
             # new/ungated/deleted pods change the backlog or free capacity
+            return [_SINGLETON_REQ]
+        if event.kind == ClusterTopology.KIND:
+            # level set changed: snapshot encoding + constraint resolution shift
             return [_SINGLETON_REQ]
         return []
 
@@ -139,18 +144,18 @@ class GangScheduler:
         return True
 
     def _priority_of(self, gang: PodGang) -> float:
-        """PriorityClassName -> numeric priority. Unknown classes are 0;
-        'system-*' classes win (a minimal PriorityClass table)."""
-        pc = gang.spec.priority_class_name
-        if not pc:
-            return 0.0
-        if pc.startswith("system-"):
-            return 1000.0
-        if pc.endswith("-high"):
-            return 100.0
-        if pc.endswith("-low"):
-            return -100.0
-        return 10.0
+        """Resolve PriorityClassName against the PriorityClass objects in
+        the store (cluster-scoped, like scheduling.k8s.io/v1 — the built-in
+        system-* classes are seeded by Cluster). An unnamed gang takes the
+        global-default class's value; an unknown name resolves to 0."""
+        pc_name = gang.spec.priority_class_name
+        if pc_name:
+            pc = self.store.get(PriorityClass.KIND, "", pc_name)
+            return float(pc.value) if pc is not None else 0.0
+        for pc in self.store.list(PriorityClass.KIND):
+            if pc.global_default:
+                return float(pc.value)
+        return 0.0
 
     # -- binding ------------------------------------------------------------
     def _bind(self, gang: PodGang, placement) -> None:
@@ -191,7 +196,9 @@ class GangScheduler:
                     demand = demand_fn(ref.namespace, ref.name)
                     if demand is None:
                         continue
-                    req, pref = _group_levels(group, snapshot)
+                    req, pref = _resolve_level(group.topology_constraint, snapshot)
+                    if req == UNRESOLVED_LEVEL:
+                        continue  # hard level missing: hold the pod, don't weaken
                     singles.append(
                         SolverGang(
                             name=f"single/{ref.name}",
@@ -254,23 +261,6 @@ class GangScheduler:
         )
         if asdict(gang.status) != before:
             self.store.update_status(gang)
-
-
-def _group_levels(group, snapshot) -> tuple[int, int]:
-    req = pref = -1
-    tc = group.topology_constraint
-    if tc is not None and tc.pack_constraint is not None:
-        if tc.pack_constraint.required:
-            try:
-                req = snapshot.level_index(tc.pack_constraint.required)
-            except KeyError:
-                pass
-        if tc.pack_constraint.preferred:
-            try:
-                pref = snapshot.level_index(tc.pack_constraint.preferred)
-            except KeyError:
-                pass
-    return req, pref
 
 
 def _cond_true(gang: PodGang, cond_type: str) -> bool:

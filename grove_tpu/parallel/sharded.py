@@ -59,7 +59,7 @@ def make_solver_mesh(devices=None, gang_axis: int | None = None) -> Mesh:
     return Mesh(arr, axis_names=("gangs", "nodes"))
 
 
-def sharded_score_fn(mesh: Mesh, num_domains: int, nlevels_p1: int, top_k: int):
+def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int):
     """Build the jitted, mesh-sharded equivalent of solver.engine's
     _device_score. Inputs must be padded: G divisible by the gangs axis,
     N by the nodes axis (PlacementEngine pads gangs; ShardedPlacementEngine
@@ -100,7 +100,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, nlevels_p1: int, top_k: int):
         ]                                                    # [Gl, D]
         value_l = value_from_aggregates(
             dom_free, cnt_fit, dom_level, total_demand, required_level,
-            preferred_level, valid, cap_scale, nlevels_p1,
+            preferred_level, valid, cap_scale,
         )                                                    # [Gl, D]
         # Gather full value/demand so the sequential commit scan sees the
         # global priority order; it is cheap [D, R] arithmetic per gang and
@@ -126,7 +126,6 @@ class ShardedPlacementEngine(PlacementEngine):
         self._fn = sharded_score_fn(
             mesh,
             self.space.num_domains,
-            self.space.gdom.shape[0],
             min(self.top_k, self.space.num_domains),
         )  # jit caches per input shape; one wrapper serves all of them
 
@@ -137,7 +136,20 @@ class ShardedPlacementEngine(PlacementEngine):
             return arr
         widths = [(0, 0)] * arr.ndim
         widths[axis] = (0, pad)
-        return np.pad(arr, widths)  # zero free / root domain for dummies
+        return np.pad(arr, widths)  # zero free capacity for dummy nodes
+
+    def _pad_gdom(self, gdom: np.ndarray, mult: int) -> np.ndarray:
+        """Pad node columns with the absorbing domain index num_domains
+        (dropped by membership_matrix's scatter) — zero-padding would make
+        every dummy node a member of global domain 0 (the root) at all
+        levels, inflating the root's cnt_fit for all-zero max-pod rows."""
+        n = gdom.shape[1]
+        pad = (-n) % mult
+        if pad == 0:
+            return gdom
+        return np.pad(
+            gdom, ((0, 0), (0, pad)), constant_values=self.space.num_domains
+        )
 
     def _device_phase(self, dev_free, total_demand, max_pod, required_level,
                       preferred_level, valid, cap_scale):
@@ -156,7 +168,7 @@ class ShardedPlacementEngine(PlacementEngine):
         # driver env that default is a TPU client the dry run must not touch.
         top_val, top_dom = self._fn(
             self._pad_nodes(dev_free, 0, nodes_axis),
-            self._pad_nodes(self.space.gdom, 1, nodes_axis),
+            self._pad_gdom(self.space.gdom, nodes_axis),
             self.space.dom_level,
             self.space.anc_ids,
             pad_g(total_demand),

@@ -110,7 +110,10 @@ def membership_matrix(gdom, num_domains: int):
     nlevels_p1, n = gdom.shape
     m = jnp.zeros((n, num_domains), dtype=jnp.float32)
     for l in range(nlevels_p1):  # static tiny loop, unrolled at trace time
-        m = m.at[jnp.arange(n), gdom[l]].add(1.0)
+        # mode="drop": padded dummy nodes carry the out-of-range domain id
+        # num_domains (see ShardedPlacementEngine._pad_gdom) and must not
+        # contribute membership anywhere — not even the root column.
+        m = m.at[jnp.arange(n), gdom[l]].add(1.0, mode="drop")
     return m
 
 
@@ -123,7 +126,6 @@ def value_from_aggregates(
     preferred_level, # i32 [G]
     valid,           # bool [G]
     cap_scale,       # f32 [R]
-    nlevels_p1: int,
 ):
     """value[G, D]: pack narrowness dominates (it IS the placement score),
     then a bonus for satisfying the preferred level, minus normalized slack
@@ -132,7 +134,11 @@ def value_from_aggregates(
     # Hierarchy mask: gangs may only use domains at least as narrow as their
     # required level; the root (-1) only when unconstrained.
     allowed = dom_level[None, :] >= required_level[:, None]
-    level_score = (dom_level.astype(jnp.float32) + 2.0) / jnp.float32(nlevels_p1 + 1)
+    # Per-level value gap is 2.5, strictly above the worst-case competing
+    # swing (pref bonus 1.0 + squashed slack 1.0), so a broader domain can
+    # never outrank a feasible narrower one regardless of topology depth —
+    # pack narrowness stays lexicographically dominant.
+    level_score = 2.5 * (dom_level.astype(jnp.float32) + 2.0)
     pref_bonus = (dom_level[None, :] >= preferred_level[:, None]).astype(jnp.float32)
     # Per-resource loop (R is tiny and static) instead of a [G, D, R]
     # broadcast: a 3-wide minor dimension wastes the TPU's 128-lane
@@ -142,7 +148,7 @@ def value_from_aggregates(
         cur = (dom_free[:, res][None, :] - total_demand[:, res][:, None]) / cap_scale[res]
         slack = cur if slack is None else jnp.maximum(slack, cur)
     slack = slack / (1.0 + jnp.abs(slack))  # squash: ordering, not magnitude
-    value = 4.0 * level_score[None, :] + 1.0 * pref_bonus - 0.5 * slack
+    value = level_score[None, :] + 1.0 * pref_bonus - 0.5 * slack
     static_mask = (cnt_fit >= 1.0) & allowed & valid[:, None]
     return jnp.where(static_mask, value, _NEG)
 
@@ -228,7 +234,6 @@ def _device_score(
     num_domains: int,
     top_k: int,
 ):
-    nlevels_p1, _ = gdom.shape
     m = membership_matrix(gdom, num_domains)
     dom_free = m.T @ free                                   # [D, R]
     # Node-granularity proxy: #nodes able to host the gang's largest pod.
@@ -241,7 +246,7 @@ def _device_score(
     cnt_fit = (node_fits @ m)[max_pod_inverse]              # [G, D]
     value = value_from_aggregates(
         dom_free, cnt_fit, dom_level, total_demand, required_level,
-        preferred_level, valid, cap_scale, nlevels_p1,
+        preferred_level, valid, cap_scale,
     )
     top_val, top_dom = commit_scan(value, dom_free, anc_ids, total_demand, top_k)
     # Pack both outputs into ONE array: a host fetch through the dev
@@ -273,11 +278,20 @@ class PlacementEngine:
         if free is None:
             free = snapshot.free.copy()
         result = SolveResult()
-        if not gangs:
+        # Pre-declared unschedulable gangs (unknown required pack level)
+        # never enter the solve: a hard constraint that cannot be resolved
+        # must hold the gang, not weaken to best-effort.
+        solvable = []
+        for g in gangs:
+            if g.unschedulable_reason:
+                result.unplaced[g.name] = g.unschedulable_reason
+            else:
+                solvable.append(g)
+        if not solvable:
             result.wall_seconds = time.perf_counter() - t0
             return result
 
-        order = sorted(gangs, key=gang_sort_key)
+        order = sorted(solvable, key=gang_sort_key)
         g_pad = _bucket(len(order))
         r = len(snapshot.resource_names)
         total_demand = np.zeros((g_pad, r), dtype=np.float32)

@@ -17,6 +17,11 @@ import numpy as np
 from ..api.podgang import PodGang, TopologyConstraint
 from ..topology.encoding import TopologySnapshot
 
+#: Sentinel for a REQUIRED pack level whose label key is absent from the
+#: snapshot. Distinct from -1 (unconstrained): a gang demanding packing at a
+#: level the cluster doesn't carry must be held, not scheduled best-effort.
+UNRESOLVED_LEVEL = -2
+
 
 @dataclass
 class SolverGang:
@@ -39,6 +44,10 @@ class SolverGang:
     # base gang, podgang.go:121-132): (member group indices, required_level,
     # preferred_level).
     constraint_groups: list[tuple[list[int], int, int]] = field(default_factory=list)
+    # Set when the gang cannot legally be solved at all (e.g. a required
+    # pack level is UNRESOLVED_LEVEL); both solve paths report it unplaced
+    # with this reason instead of scheduling it unconstrained.
+    unschedulable_reason: Optional[str] = None
 
     @property
     def num_pods(self) -> int:
@@ -56,11 +65,12 @@ def _resolve_level(
 ) -> tuple[int, int]:
     """TopologyConstraint (label keys) -> (required_level, preferred_level).
 
-    Unknown keys resolve to -1 (unconstrained) rather than erroring: the
-    solver must keep scheduling other gangs even if one gang references a
-    level the current ClusterTopology no longer carries (the reference
-    surfaces this as the TopologyLevelsUnavailable condition instead of
-    failing the scheduler).
+    An unknown PREFERRED key resolves to -1 (a preference for a missing
+    level is simply unsatisfiable, so it is dropped). An unknown REQUIRED
+    key resolves to UNRESOLVED_LEVEL: a hard constraint must never be
+    silently weakened to best-effort — encode_podgangs marks such gangs
+    unschedulable and the scheduler holds them with a reason (the operator
+    side additionally surfaces TopologyLevelsUnavailable on the PCS).
     """
     req = pref = -1
     if tc is not None and tc.pack_constraint is not None:
@@ -69,7 +79,7 @@ def _resolve_level(
             try:
                 req = snapshot.level_index(pc.required)
             except KeyError:
-                req = -1
+                req = UNRESOLVED_LEVEL
         if pc.preferred is not None:
             try:
                 pref = snapshot.level_index(pc.preferred)
@@ -104,10 +114,22 @@ def encode_podgangs(
         group_names: list[str] = []
         group_req: list[int] = []
         group_pref: list[int] = []
+        unresolved: list[str] = []
+
+        def resolve(tc):
+            req, pref = _resolve_level(tc, snapshot)
+            if req == UNRESOLVED_LEVEL:
+                # strip the operator-side sentinel prefix so status messages
+                # show the domain the user actually wrote
+                unresolved.append(
+                    tc.pack_constraint.required.removeprefix("unresolved:")
+                )
+            return req, pref
+
         stale = False
         for gi, group in enumerate(pg.spec.pod_groups):
             group_names.append(group.name)
-            req, pref = _resolve_level(group.topology_constraint, snapshot)
+            req, pref = resolve(group.topology_constraint)
             group_req.append(req)
             group_pref.append(pref)
             for ref in group.pod_references[: group.min_replicas]:
@@ -122,14 +144,20 @@ def encode_podgangs(
                 break
         if stale or not demands:
             continue
-        req, pref = _resolve_level(pg.spec.topology_constraint, snapshot)
+        req, pref = resolve(pg.spec.topology_constraint)
         name_to_idx = {n: i for i, n in enumerate(group_names)}
         cgroups: list[tuple[list[int], int, int]] = []
         for cg in pg.spec.topology_constraint_group_configs:
             members = [name_to_idx[n] for n in cg.pod_group_names if n in name_to_idx]
-            cg_req, cg_pref = _resolve_level(cg.topology_constraint, snapshot)
+            cg_req, cg_pref = resolve(cg.topology_constraint)
             if members and (cg_req >= 0 or cg_pref >= 0):
                 cgroups.append((members, cg_req, cg_pref))
+        reason = None
+        if unresolved:
+            reason = (
+                "required topology level(s) unavailable: "
+                + ",".join(sorted(set(unresolved)))
+            )
         gangs.append(
             SolverGang(
                 name=pg.metadata.name,
@@ -144,6 +172,7 @@ def encode_podgangs(
                 preferred_level=pref,
                 priority=priority_of(pg),
                 constraint_groups=cgroups,
+                unschedulable_reason=reason,
             )
         )
     return gangs

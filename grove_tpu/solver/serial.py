@@ -51,6 +51,9 @@ def solve_serial(
     sched_nodes = np.flatnonzero(snapshot.schedulable)
     result = SolveResult()
     for gang in sorted(gangs, key=gang_sort_key):
+        if gang.unschedulable_reason:
+            result.unplaced[gang.name] = gang.unschedulable_reason
+            continue
         placed = _place_one(gang, snapshot, free, sched_nodes)
         if placed is None:
             result.unplaced[gang.name] = "no feasible domain"

@@ -201,3 +201,28 @@ class TestClusterFacade:
         fn = c.pod_demand_fn(["cpu", "memory", "tpu"])
         assert list(fn("default", "p")) == [1.0, 0.0, 0.0]
         assert fn("default", "missing") is None
+
+
+class TestLiveTopology:
+    def test_topology_snapshot_follows_store_update(self):
+        from grove_tpu.api.types import ClusterTopology, TopologyLevel, sort_topology_levels
+
+        nodes = make_nodes(4, racks_per_block=2, hosts_per_rack=2)
+        for n in nodes:
+            n.metadata.labels["t/zone"] = "z0"
+        c = Cluster(nodes=nodes)
+        assert "t/zone" not in c.topology_snapshot().level_keys
+        ct = c.store.get(
+            ClusterTopology.KIND,
+            c.topology.metadata.namespace,
+            c.topology.metadata.name,
+        )
+        ct.spec.levels = sort_topology_levels(
+            ct.spec.levels + [TopologyLevel(domain="zone", key="t/zone")]
+        )
+        c.store.update(ct)
+        # the snapshot must track the STORED topology, not the bootstrap copy
+        snap = c.topology_snapshot()
+        assert "t/zone" in snap.level_keys
+        zl = snap.level_index("t/zone")
+        assert snap.domains_at(zl) == 1  # all four nodes share zone z0

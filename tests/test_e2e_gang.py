@@ -154,3 +154,149 @@ class TestFT_FailureAndTermination:
         h.advance(3600.0)
         # cliques still exist; no termination churn for a never-scheduled gang
         assert h.store.get(PodClique.KIND, "default", "simple1-0-w") is not None
+
+
+class TestGS_TopologyGating:
+    """A PCS demanding a pack level the cluster topology doesn't carry must
+    be HELD (Unschedulable with reason + TopologyLevelsUnavailable), not
+    scheduled unconstrained; adding the level to the stored ClusterTopology
+    unblocks it live (no restart)."""
+
+    def test_unknown_required_domain_holds_gang_then_recovers(self):
+        from grove_tpu.api.types import (
+            ClusterTopology,
+            TopologyConstraintSpec,
+            TopologyLevel,
+            TopologyPackConstraintSpec,
+            sort_topology_levels,
+        )
+
+        nodes = make_nodes(4, racks_per_block=2, hosts_per_rack=2)
+        for n in nodes:
+            n.metadata.labels["t/zone"] = "z0"
+        h = Harness(nodes=nodes)
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.topology_constraint = TopologyConstraintSpec(
+            pack_constraint=TopologyPackConstraintSpec(required="zone")
+        )
+        h.apply(pcs)
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 2
+        assert all(not p.node_name for p in pods), (
+            "hard constraint must hold the gang, not weaken to unconstrained"
+        )
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        sched = cond(gang, PodGangConditionType.SCHEDULED.value)
+        assert sched is not None and sched.status == "False"
+        assert "unavailable" in sched.message
+        pcs_live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        topo = cond(pcs_live, constants.CONDITION_TOPOLOGY_LEVELS_UNAVAILABLE)
+        assert topo is not None and topo.status == "True"
+        assert "zone" in topo.message
+
+        # live topology update: add the zone level -> gang schedules
+        ct = h.store.get(
+            ClusterTopology.KIND,
+            h.cluster.topology.metadata.namespace,
+            h.cluster.topology.metadata.name,
+        )
+        ct.spec.levels = sort_topology_levels(
+            ct.spec.levels + [TopologyLevel(domain="zone", key="t/zone")]
+        )
+        h.store.update(ct)
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert all(p.node_name for p in pods)
+        pcs_live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        topo = cond(pcs_live, constants.CONDITION_TOPOLOGY_LEVELS_UNAVAILABLE)
+        assert topo.status == "False"
+
+
+class TestFT_DisruptionTarget:
+    def test_ft6_gang_termination_marks_disruption_target(self):
+        h = Harness(nodes=make_nodes(4))
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        seq = h.store.last_seq
+        h.advance(61.0)
+        h.settle()
+        # the victim gang was marked DisruptionTarget BEFORE deletion
+        # (podgang.go:156-169) — visible in the watch event stream
+        events = [
+            e for e in h.store.events_since(seq)
+            if e.kind == PodGang.KIND and e.name == "simple1-0"
+        ]
+        marked = [
+            e for e in events
+            if e.type == "Modified"
+            and (c := cond(e.obj, PodGangConditionType.DISRUPTION_TARGET.value))
+            is not None
+            and c.status == "True"
+            and c.reason == "GangTerminationDelayExpired"
+        ]
+        deleted = [e for e in events if e.type == "Deleted"]
+        assert marked and deleted
+        assert marked[0].seq < deleted[0].seq
+
+
+class TestGS_PriorityClass:
+    def test_priorityclass_object_orders_contention(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+
+        # capacity for exactly one gang; priority decides which. Nodes start
+        # cordoned so BOTH gangs are pending in the same backlog when
+        # capacity appears (otherwise whichever reconciles first binds).
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 3.0, "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.cluster.cordon("node-0")
+        h.cluster.cordon("node-1")
+        h.store.create(
+            PriorityClass(metadata=ObjectMeta(name="gold", namespace=""),
+                          value=500.0)
+        )
+        a = simple_pcs(name="a", cliques=[clique("w", replicas=2, cpu=2.5)])
+        b = simple_pcs(name="b", cliques=[clique("w", replicas=2, cpu=2.5)])
+        b.spec.template.priority_class_name = "gold"
+        h.apply(a)
+        h.apply(b)
+        h.settle()
+        assert all(not p.node_name for p in h.store.list(Pod.KIND))
+        h.cluster.uncordon("node-0")
+        h.cluster.uncordon("node-1")
+        h.settle()
+        bound_by_pcs = {"a": 0, "b": 0}
+        for p in h.store.list(Pod.KIND):
+            if p.node_name:
+                bound_by_pcs[p.metadata.labels[constants.LABEL_PART_OF]] += 1
+        # without the PriorityClass object "a" would win on name order
+        assert bound_by_pcs == {"a": 0, "b": 2}
+
+    def test_priority_resolution_semantics(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.podgang import PodGang, PodGangSpec
+
+        h = Harness(nodes=make_nodes(1))
+
+        def gang_with(pc_name):
+            g = PodGang(metadata=ObjectMeta(name="g"))
+            g.spec = PodGangSpec(priority_class_name=pc_name)
+            return g
+
+        prio = h.scheduler._priority_of
+        # seeded system classes are real objects, not name heuristics
+        assert prio(gang_with("system-node-critical")) == 2_000_001_000.0
+        assert prio(gang_with("system-cluster-critical")) == 2_000_000_000.0
+        assert prio(gang_with("unknown-high")) == 0.0  # no suffix heuristics
+        assert prio(gang_with(None)) == 0.0
+        h.store.create(
+            PriorityClass(metadata=ObjectMeta(name="dft", namespace=""),
+                          value=7.0, global_default=True)
+        )
+        assert prio(gang_with(None)) == 7.0

@@ -63,3 +63,45 @@ class TestShardedEngine:
         # "b" needs 24 cpu in one rack (16 available) -> infeasible on both
         assert set(res.placed) == set(single.placed) == {"a", "c"}
         assert res.unplaced == {"b": "no feasible domain"}
+
+
+class TestPadDomainAbsorption:
+    def test_membership_matrix_drops_pad_domain(self):
+        import jax.numpy as jnp
+
+        from grove_tpu.solver.engine import membership_matrix
+
+        # node 2 is a pad column carrying the absorbing id num_domains=5:
+        # it must contribute NO membership, not root membership
+        gdom = jnp.asarray(np.array([[0, 0, 5], [1, 2, 5]], np.int32))
+        m = np.asarray(membership_matrix(gdom, 5))
+        assert m[2].sum() == 0.0
+        assert m[:2].sum() == 4.0  # real nodes: one entry per level each
+
+    def test_zero_demand_gang_ragged_parity(self, mesh):
+        # 9 nodes against a 2-wide nodes axis forces pad columns; a gang
+        # whose max-pod row is all-zero is exactly the case where root-domain
+        # pad pollution showed: dummy "nodes" (free 0) would count as fitting
+        from grove_tpu.solver import SolverGang
+
+        snap = cluster(blocks=1, racks=3, hosts=3, cpu=8.0)
+        zg = SolverGang(
+            name="z",
+            namespace="default",
+            demand=np.zeros((2, 3), np.float32),
+            pod_names=["z-p0", "z-p1"],
+            group_ids=np.zeros(2, np.int32),
+            group_names=["g0"],
+            group_required_level=np.array([-1], np.int32),
+            group_preferred_level=np.array([-1], np.int32),
+        )
+        gangs = [zg, gang("a", pods=2, cpu=2.0), gang("b", pods=2, cpu=2.0,
+                                                      required=1)]
+        single = PlacementEngine(snap).solve(gangs)
+        sharded = ShardedPlacementEngine(snap, mesh).solve(gangs)
+        assert set(sharded.placed) == set(single.placed)
+        for name in sharded.placed:
+            np.testing.assert_array_equal(
+                sharded.placed[name].node_indices,
+                single.placed[name].node_indices,
+            )

@@ -215,3 +215,101 @@ class TestEngineParity:
         rack_ids = snap.domain_ids[1, res.placed["g"].node_indices]
         assert rack_ids[0] == rack_ids[1]
         assert rack_ids[2] == rack_ids[3]
+
+
+class TestRequiredLevelGating:
+    """A REQUIRED pack level missing from the topology must hold the gang
+    (solver/problem.py UNRESOLVED_LEVEL), never weaken to unconstrained."""
+
+    def test_pre_declared_unschedulable_held_by_both_paths(self):
+        snap = cluster()
+        held = gang("held", pods=2, cpu=1.0)
+        held.unschedulable_reason = "required topology level(s) unavailable: t/zone"
+        ok = gang("ok", pods=2, cpu=1.0)
+        eng = PlacementEngine(snap).solve([held, ok])
+        assert eng.unplaced["held"] == held.unschedulable_reason
+        assert "ok" in eng.placed
+        ser = solve_serial(snap, [held, ok])
+        assert ser.unplaced["held"] == held.unschedulable_reason
+        assert "ok" in ser.placed
+
+    def test_encode_marks_unknown_required_key(self):
+        from grove_tpu.api.meta import NamespacedName, ObjectMeta
+        from grove_tpu.api.podgang import (
+            PodGang,
+            PodGangSpec,
+            PodGroup,
+            TopologyConstraint,
+            TopologyPackConstraint,
+        )
+        from grove_tpu.solver import encode_podgangs
+
+        snap = cluster()
+        demand = np.array([1.0, 1.0, 0.0], np.float32)
+
+        def pg(name, required):
+            return PodGang(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=PodGangSpec(
+                    pod_groups=[
+                        PodGroup(
+                            name="w",
+                            min_replicas=1,
+                            pod_references=[
+                                NamespacedName(namespace="default", name=f"{name}-p0")
+                            ],
+                        )
+                    ],
+                    topology_constraint=TopologyConstraint(
+                        pack_constraint=TopologyPackConstraint(required=required)
+                    ),
+                ),
+            )
+
+        out = encode_podgangs(
+            [pg("bad", "unresolved:zone"), pg("good", "t/rack")],
+            snap,
+            lambda ns, n: demand,
+        )
+        by_name = {g.name: g for g in out}
+        assert "unavailable" in by_name["bad"].unschedulable_reason
+        assert by_name["good"].unschedulable_reason is None
+        assert by_name["good"].required_level == snap.level_index("t/rack")
+        # unknown PREFERRED stays best-effort (-1), not unschedulable
+        bad_pref = pg("pref", "t/rack")
+        bad_pref.spec.topology_constraint.pack_constraint.preferred = "nope"
+        bad_pref.spec.topology_constraint.pack_constraint.required = None
+        (enc,) = encode_podgangs([bad_pref], snap, lambda ns, n: demand)
+        assert enc.unschedulable_reason is None
+        assert enc.preferred_level == -1
+
+
+class TestValueNarrownessDominance:
+    def test_narrowness_beats_extreme_slack_at_any_depth(self):
+        """A broader domain must never outrank a feasible narrower one, even
+        when the broader is overcommitted (strongly negative slack makes its
+        -0.5*slack term maximally positive) and the narrower is maximally
+        slack — the level weight scales with topology depth."""
+        import jax.numpy as jnp
+
+        from grove_tpu.solver.engine import value_from_aggregates
+
+        dom_level = jnp.asarray(np.array([-1, 0, 1], np.int32))
+        # level-0 domain overcommitted (free -100), level-1 domain huge
+        dom_free = jnp.asarray(
+            np.array([[300.0], [-100.0], [100.0]], np.float32)
+        )
+        cnt_fit = jnp.ones((1, 3), jnp.float32)
+        value = np.asarray(
+            value_from_aggregates(
+                dom_free,
+                cnt_fit,
+                dom_level,
+                jnp.asarray(np.array([[2.0]], np.float32)),
+                jnp.asarray(np.array([-1], np.int32)),
+                jnp.asarray(np.array([-1], np.int32)),
+                jnp.asarray(np.array([True])),
+                jnp.asarray(np.array([100.0], np.float32)),
+            )
+        )
+        assert value[0].argmax() == 2, value
