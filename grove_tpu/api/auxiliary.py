@@ -67,6 +67,21 @@ class Secret:
 
 
 @dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass equivalent. PodGang's
+    PriorityClassName (podgang.go:62-64) is an opaque reference to one of
+    these objects — NOT a naming convention; the scheduler resolves it to
+    `value` for backlog ordering and contention."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: float = 0.0
+    global_default: bool = False
+    description: str = ""
+
+    KIND = "PriorityClass"
+
+
+@dataclass
 class HPASpec:
     target_kind: str = ""     # PodClique | PodCliqueScalingGroup
     target_name: str = ""
