@@ -66,5 +66,17 @@ from .validation import (
     validate_podcliqueset,
     validate_podcliqueset_update,
 )
+from .config import (
+    AuthorizationConfig,
+    AutoscalerConfig,
+    ControllerConfig,
+    LogConfig,
+    OperatorConfig,
+    SolverConfig,
+    TopologyAwareSchedulingConfig,
+    WorkloadDefaultsConfig,
+    load_operator_config,
+    validate_operator_config,
+)
 
 __all__ = [name for name in dir() if not name.startswith("_")]

@@ -1,0 +1,52 @@
+"""Managed-resource authorization policy.
+
+Parity with the reference's authorization webhook
+(operator/internal/webhook/admission/pcs/authorization/): only the
+operator's own identity (plus a configured exempt list) may mutate
+Grove-MANAGED resources — the children the operator stamps with
+`app.kubernetes.io/managed-by: grove-operator` (PodCliques, PCSGs, Pods,
+PodGangs, Services, ...). User-owned objects (the PodCliqueSets users
+apply) are not gated: users own what they created; the protection exists
+so nobody strips finalizers or rewrites specs out from under the
+reconcilers. The `grove.io/disable-managed-resource-protection` annotation
+opts a single object out, mirroring the reference's escape hatch
+(constants.go:42-48).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from . import constants
+from .config import AuthorizationConfig
+
+#: Identities authorized regardless of config (apiserver-internal agents).
+SYSTEM_ACTORS = frozenset({"system:garbage-collector"})
+
+
+def make_authorizer(
+    cfg: AuthorizationConfig,
+) -> Callable[[str, str, Any], None]:
+    """Build the store's authorize(actor, verb, obj) hook. Raises
+    cluster.store.Forbidden on a denied mutation."""
+    from ..cluster.store import Forbidden
+
+    allowed = SYSTEM_ACTORS | {cfg.operator_identity, *cfg.exempt_actors}
+
+    def authorize(actor: str, verb: str, obj: Any) -> None:
+        labels = obj.metadata.labels
+        if labels.get(constants.LABEL_MANAGED_BY) != constants.LABEL_MANAGED_BY_VALUE:
+            return  # not a Grove-managed resource
+        ann = obj.metadata.annotations
+        if ann.get(constants.ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION) == "true":
+            return
+        if actor in allowed:
+            return
+        raise Forbidden(
+            f"actor {actor!r} may not {verb} Grove-managed {obj.KIND} "
+            f"{obj.metadata.namespace}/{obj.metadata.name} "
+            f"(managed resources are mutable only by the operator identity "
+            f"{cfg.operator_identity!r} or exempt actors)"
+        )
+
+    return authorize

@@ -1,0 +1,256 @@
+"""Versioned operator configuration.
+
+The reference drives the whole operator from one validated, versioned
+`OperatorConfiguration` YAML (client QPS, per-controller concurrency,
+servers, logging, authorizer, topology-aware scheduling —
+operator/api/config/v1alpha1/types.go:57-202, decoded through the k8s
+scheme machinery in cmd/cli/cli.go:89-106 and validated in
+api/config/validation/validation.go). grove_tpu mirrors that contract:
+every knob the framework tunes lives here — nothing is a hard-coded
+constant in a controller — and configs load from plain dicts (the YAML
+decode analog) with strict unknown-field rejection and aggregated
+validation errors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+from .validation import ValidationError
+
+API_VERSION = "config.grove.io/v1alpha1"
+KIND = "OperatorConfiguration"
+
+_LOG_LEVELS = ("debug", "info", "error")
+_LOG_FORMATS = ("text", "json")
+
+
+@dataclass
+class WorkloadDefaultsConfig:
+    """Defaulting-webhook tunables (defaulting/podcliqueset.go:30-117)."""
+
+    termination_delay_seconds: float = 4 * 60 * 60.0
+    replicas: int = 1
+
+
+@dataclass
+class ControllerConfig:
+    """Reconcile-loop tuning — the ConcurrentSyncs/flow-control analog
+    (types.go:151-174). The deterministic manager has no thread pool, so
+    concurrency maps to round budgets + retry pacing."""
+
+    sync_retry_interval_seconds: float = 5.0
+    settle_max_rounds: int = 256
+    harness_max_rounds: int = 64
+
+
+@dataclass
+class SolverConfig:
+    """Placement-engine tuning (the part the reference delegates to KAI)."""
+
+    top_k: int = 8                 # exact-repair candidates per gang
+    commit_chunk: int = 32         # gangs per commit-scan step
+    gang_bucket_minimum: int = 8   # smallest padded backlog bucket
+    native_repair: bool = True     # use the C++ exact-commit path
+
+
+@dataclass
+class AutoscalerConfig:
+    """k8s HPA controller knobs."""
+
+    tolerance: float = 0.1  # no scale while |ratio - 1| <= tolerance
+
+
+@dataclass
+class AuthorizationConfig:
+    """Store-mutation authorization — the authorization webhook analog
+    (webhook/admission/pcs/authorization/; types.go authorizer config).
+    When enabled, only the operator identity (+ exempt actors) may mutate
+    Grove-managed resources."""
+
+    enabled: bool = False
+    operator_identity: str = "system:serviceaccount:grove-system:grove-operator"
+    exempt_actors: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TopologyAwareSchedulingConfig:
+    """TopologyAwareScheduling{Enabled, Levels} (types.go:190-202). Levels
+    seed the bootstrap ClusterTopology: list of {domain, key} pairs,
+    broadest first; empty = infer from node inventory labels."""
+
+    enabled: bool = True
+    levels: list[dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"
+    format: str = "text"
+
+
+@dataclass
+class OperatorConfig:
+    api_version: str = API_VERSION
+    kind: str = KIND
+    workload_defaults: WorkloadDefaultsConfig = field(
+        default_factory=WorkloadDefaultsConfig
+    )
+    controllers: ControllerConfig = field(default_factory=ControllerConfig)
+    solver: SolverConfig = field(default_factory=SolverConfig)
+    autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
+    authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
+    topology_aware_scheduling: TopologyAwareSchedulingConfig = field(
+        default_factory=TopologyAwareSchedulingConfig
+    )
+    log: LogConfig = field(default_factory=LogConfig)
+
+
+def _build(cls, data: Any, path: str, errs: list[str]):
+    """Strict recursive dataclass decode: unknown fields are errors (the
+    reference's scheme decode rejects unknown YAML keys the same way)."""
+    if not isinstance(data, dict):
+        errs.append(f"{path}: expected mapping, got {type(data).__name__}")
+        return cls()
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in data.items():
+        if key not in fields:
+            errs.append(f"{path}.{key}: unknown field")
+            continue
+        ftype = fields[key].type
+        if dataclasses.is_dataclass(_resolve(ftype)):
+            kwargs[key] = _build(_resolve(ftype), value, f"{path}.{key}", errs)
+        else:
+            kwargs[key] = value
+    try:
+        return cls(**kwargs)
+    except TypeError as e:  # wrong primitive shape (e.g. list for float)
+        errs.append(f"{path}: {e}")
+        return cls()
+
+
+_TYPES = {
+    "WorkloadDefaultsConfig": WorkloadDefaultsConfig,
+    "ControllerConfig": ControllerConfig,
+    "SolverConfig": SolverConfig,
+    "AutoscalerConfig": AutoscalerConfig,
+    "AuthorizationConfig": AuthorizationConfig,
+    "TopologyAwareSchedulingConfig": TopologyAwareSchedulingConfig,
+    "LogConfig": LogConfig,
+    "OperatorConfig": OperatorConfig,
+}
+
+
+def _resolve(ftype):
+    """Dataclass field types are strings under `from __future__ import
+    annotations`; map them back to classes."""
+    if isinstance(ftype, str):
+        return _TYPES.get(ftype, ftype)
+    return ftype
+
+
+def load_operator_config(data: dict | None) -> OperatorConfig:
+    """Decode + validate. Raises ValidationError with ALL problems at once
+    (validation.go aggregates the same way)."""
+    errs: list[str] = []
+    cfg = _build(OperatorConfig, data or {}, "config", errs)
+    errs += validate_operator_config(cfg)  # aggregate decode + semantic errors
+    if errs:
+        raise ValidationError(errs)
+    return cfg
+
+
+def validate_operator_config(cfg: OperatorConfig) -> list[str]:
+    errs: list[str] = []
+    if cfg.api_version != API_VERSION:
+        errs.append(
+            f"config.api_version: unsupported {cfg.api_version!r} "
+            f"(want {API_VERSION!r})"
+        )
+    if cfg.kind != KIND:
+        errs.append(f"config.kind: unsupported {cfg.kind!r} (want {KIND!r})")
+
+    wd = cfg.workload_defaults
+    if not _num(wd.termination_delay_seconds) or wd.termination_delay_seconds <= 0:
+        errs.append(
+            "config.workload_defaults.termination_delay_seconds: must be > 0"
+        )
+    if not _int(wd.replicas) or wd.replicas < 1:
+        errs.append("config.workload_defaults.replicas: must be an int >= 1")
+
+    cc = cfg.controllers
+    if not _num(cc.sync_retry_interval_seconds) or cc.sync_retry_interval_seconds <= 0:
+        errs.append(
+            "config.controllers.sync_retry_interval_seconds: must be > 0"
+        )
+    for f in ("settle_max_rounds", "harness_max_rounds"):
+        v = getattr(cc, f)
+        if not _int(v) or v < 1:
+            errs.append(f"config.controllers.{f}: must be an int >= 1")
+
+    sv = cfg.solver
+    for f in ("top_k", "commit_chunk", "gang_bucket_minimum"):
+        v = getattr(sv, f)
+        if not _int(v) or v < 1:
+            errs.append(f"config.solver.{f}: must be an int >= 1")
+    if _int(sv.gang_bucket_minimum) and sv.gang_bucket_minimum >= 1:
+        if sv.gang_bucket_minimum & (sv.gang_bucket_minimum - 1):
+            errs.append(
+                "config.solver.gang_bucket_minimum: must be a power of two "
+                "(backlogs pad to power-of-two buckets for jit cache stability)"
+            )
+    if not isinstance(sv.native_repair, bool):
+        errs.append("config.solver.native_repair: must be a bool")
+
+    if not _num(cfg.autoscaler.tolerance) or not (0 <= cfg.autoscaler.tolerance < 1):
+        errs.append("config.autoscaler.tolerance: must be in [0, 1)")
+
+    az = cfg.authorization
+    if not isinstance(az.enabled, bool):
+        errs.append("config.authorization.enabled: must be a bool")
+    if az.enabled and not az.operator_identity:
+        errs.append(
+            "config.authorization.operator_identity: required when enabled"
+        )
+    if not isinstance(az.exempt_actors, list) or any(
+        not isinstance(a, str) or not a for a in az.exempt_actors
+    ):
+        errs.append(
+            "config.authorization.exempt_actors: must be a list of non-empty "
+            "strings"
+        )
+
+    ts = cfg.topology_aware_scheduling
+    if not isinstance(ts.enabled, bool):
+        errs.append("config.topology_aware_scheduling.enabled: must be a bool")
+    if not isinstance(ts.levels, list):
+        errs.append("config.topology_aware_scheduling.levels: must be a list")
+        ts = dataclasses.replace(ts, levels=[])
+    seen_domains: set[str] = set()
+    for i, lv in enumerate(ts.levels):
+        path = f"config.topology_aware_scheduling.levels[{i}]"
+        if not isinstance(lv, dict) or set(lv) != {"domain", "key"}:
+            errs.append(f"{path}: must be a {{domain, key}} mapping")
+            continue
+        if not lv["domain"] or not lv["key"]:
+            errs.append(f"{path}: domain and key must be non-empty")
+        if lv["domain"] in seen_domains:
+            errs.append(f"{path}.domain: duplicate domain {lv['domain']!r}")
+        seen_domains.add(lv["domain"])
+
+    if cfg.log.level not in _LOG_LEVELS:
+        errs.append(f"config.log.level: must be one of {_LOG_LEVELS}")
+    if cfg.log.format not in _LOG_FORMATS:
+        errs.append(f"config.log.format: must be one of {_LOG_FORMATS}")
+    return errs
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)

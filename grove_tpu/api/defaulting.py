@@ -18,18 +18,28 @@ from .types import (
 )
 
 
-def default_podcliqueset(pcs: PodCliqueSet) -> PodCliqueSet:
-    """Apply defaults in place and return pcs."""
+def default_podcliqueset(pcs: PodCliqueSet, defaults=None) -> PodCliqueSet:
+    """Apply defaults in place and return pcs.
+
+    defaults: an api.config.WorkloadDefaultsConfig; None uses the built-in
+    constants (the reference's defaulting webhook reads the same values from
+    its OperatorConfiguration)."""
+    default_replicas = defaults.replicas if defaults else constants.DEFAULT_REPLICAS
+    default_delay = (
+        defaults.termination_delay_seconds
+        if defaults
+        else float(constants.DEFAULT_TERMINATION_DELAY_SECONDS)
+    )
     if pcs.metadata.namespace == "":
         pcs.metadata.namespace = "default"
     if pcs.spec.replicas is None or pcs.spec.replicas == 0:
-        pcs.spec.replicas = constants.DEFAULT_REPLICAS
+        pcs.spec.replicas = default_replicas
 
     tmpl = pcs.spec.template
     if tmpl.startup_type is None:
         tmpl.startup_type = CliqueStartupType.ANY_ORDER
     if tmpl.termination_delay is None:
-        tmpl.termination_delay = float(constants.DEFAULT_TERMINATION_DELAY_SECONDS)
+        tmpl.termination_delay = float(default_delay)
     if tmpl.head_less_service_config is None:
         tmpl.head_less_service_config = HeadlessServiceConfig(
             publish_not_ready_addresses=True

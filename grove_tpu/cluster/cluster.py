@@ -17,8 +17,9 @@ from ..api import (
     validate_podcliqueset_update,
 )
 from ..api.auxiliary import PriorityClass
+from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
-from ..api.types import ClusterTopology, Node, Pod, PodPhase
+from ..api.types import ClusterTopology, Node, Pod, PodPhase, TopologyLevel
 from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
 from .clock import SimClock
 from .kubelet import SimKubelet
@@ -27,14 +28,17 @@ from .store import Admission, ObjectStore
 
 class Cluster:
     def __init__(self, nodes: list[Node] | None = None,
-                 topology: ClusterTopology | None = None):
+                 topology: ClusterTopology | None = None,
+                 config: OperatorConfig | None = None):
+        self.config = config or OperatorConfig()
         self.clock = SimClock()
         self.store = ObjectStore(self.clock)
         self.kubelet = SimKubelet(self.store)
+        defaults = self.config.workload_defaults
         self.store.register_admission(
             "PodCliqueSet",
             Admission(
-                default=default_podcliqueset,
+                default=lambda pcs: default_podcliqueset(pcs, defaults),
                 validate=validate_podcliqueset,
                 validate_update=validate_podcliqueset_update,
             ),
@@ -42,12 +46,22 @@ class Cluster:
         self.store.register_admission(
             "ClusterTopology", Admission(validate=validate_cluster_topology)
         )
+        if self.config.authorization.enabled:
+            from ..api.authorization import make_authorizer
+
+            self.store.authorizer = make_authorizer(self.config.authorization)
         # Topology sync at startup (clustertopology.go:41): ensure the
         # singleton ClusterTopology exists before any controller runs.
+        # Precedence: explicit topology arg > config levels > inventory
+        # label inference.
+        cfg_levels = [
+            TopologyLevel(domain=lv["domain"], key=lv["key"])
+            for lv in self.config.topology_aware_scheduling.levels
+        ]
         self.topology = topology or default_cluster_topology(
-            []
-            if nodes is None
-            else _infer_levels(nodes)
+            cfg_levels
+            if cfg_levels
+            else ([] if nodes is None else _infer_levels(nodes))
         )
         self.store.create(self.topology)
         # Built-in PriorityClasses (k8s seeds the system-* pair the same

@@ -21,6 +21,7 @@ from __future__ import annotations
 import copy
 import dataclasses
 import itertools
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -38,6 +39,11 @@ class NotFound(StoreError):
 
 class AlreadyExists(StoreError):
     pass
+
+
+class Forbidden(StoreError):
+    """Raised by the authorization hook (the authorization-webhook analog,
+    operator/internal/webhook/admission/pcs/authorization/)."""
 
 
 @dataclass
@@ -79,6 +85,15 @@ def _spec_dict(obj: Any) -> dict:
     return full
 
 
+#: Actor attributed to direct store calls (tests, users at the kubectl
+#: boundary). Controllers impersonate the operator identity via the manager.
+DEFAULT_ACTOR = "user"
+
+#: The store-internal garbage collector's identity (always authorized, like
+#: the apiserver's own GC controller).
+GC_ACTOR = "system:garbage-collector"
+
+
 class ObjectStore:
     def __init__(self, clock: SimClock | None = None):
         self.clock = clock or SimClock()
@@ -87,10 +102,30 @@ class ObjectStore:
         self._events: list[Event] = []
         self._seq = itertools.count(1)
         self._uid = itertools.count(1)
+        #: authorize(actor, verb, obj) -> None | raise Forbidden. None =
+        #: authorization disabled (the default; see api.config).
+        self.authorizer: Optional[Callable[[str, str, Any], None]] = None
+        self.actor = DEFAULT_ACTOR
 
     # -- admission ---------------------------------------------------------
     def register_admission(self, kind: str, admission: Admission) -> None:
         self._admission[kind] = admission
+
+    # -- authorization ------------------------------------------------------
+    @contextmanager
+    def impersonate(self, identity: str):
+        """Attribute writes inside the block to `identity` (how the
+        controller manager runs reconciles as the operator service
+        account)."""
+        prev, self.actor = self.actor, identity
+        try:
+            yield
+        finally:
+            self.actor = prev
+
+    def _authorize(self, verb: str, obj: Any) -> None:
+        if self.authorizer is not None:
+            self.authorizer(self.actor, verb, obj)
 
     # -- event log ---------------------------------------------------------
     def events_since(self, seq: int) -> list[Event]:
@@ -151,6 +186,7 @@ class ObjectStore:
     # -- writes ------------------------------------------------------------
     def create(self, obj: Any) -> Any:
         kind = obj.KIND
+        self._authorize("create", obj)
         adm = self._admission.get(kind)
         obj = copy.deepcopy(obj)
         if adm and adm.default:
@@ -188,6 +224,10 @@ class ObjectStore:
         current = bucket.get(key)
         if current is None:
             raise NotFound(f"{kind} {key} not found")
+        if not is_status:
+            # status subresource writes stay unguarded (kubelet heartbeats,
+            # condition updates) — the protection covers spec/metadata
+            self._authorize("update", current)
         obj = copy.deepcopy(obj)
         old = copy.deepcopy(current)
         if is_status:
@@ -220,6 +260,7 @@ class ObjectStore:
         current = bucket.get(key)
         if current is None:
             raise NotFound(f"{kind} {key} not found")
+        self._authorize("delete", current)
         if current.metadata.finalizers:
             if current.metadata.deletion_timestamp is None:
                 old = copy.deepcopy(current)
@@ -237,6 +278,7 @@ class ObjectStore:
         current = self._objs.get(kind, {}).get(key)
         if current is None:
             return
+        self._authorize("update", current)
         if finalizer in current.metadata.finalizers:
             old = copy.deepcopy(current)
             current.metadata.finalizers.remove(finalizer)
@@ -254,6 +296,7 @@ class ObjectStore:
         current = self._objs.get(kind, {}).get(_key(namespace, name))
         if current is None:
             raise NotFound(f"{kind} {namespace}/{name} not found")
+        self._authorize("update", current)
         if finalizer not in current.metadata.finalizers:
             old = copy.deepcopy(current)
             current.metadata.finalizers.append(finalizer)
@@ -270,10 +313,13 @@ class ObjectStore:
             for bucket in self._objs.values()
             for o in bucket.values()
         }
-        for kind, bucket in list(self._objs.items()):
-            for obj in list(bucket.values()):
-                refs = obj.metadata.owner_references
-                if refs and all(r.uid not in live_uids for r in refs):
-                    self.delete(kind, obj.metadata.namespace, obj.metadata.name)
-                    deleted += 1
+        with self.impersonate(GC_ACTOR):
+            for kind, bucket in list(self._objs.items()):
+                for obj in list(bucket.values()):
+                    refs = obj.metadata.owner_references
+                    if refs and all(r.uid not in live_uids for r in refs):
+                        self.delete(
+                            kind, obj.metadata.namespace, obj.metadata.name
+                        )
+                        deleted += 1
         return deleted

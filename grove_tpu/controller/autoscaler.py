@@ -26,9 +26,6 @@ from .runtime import Request, Result
 
 KIND = HorizontalPodAutoscaler.KIND
 
-#: k8s HPA default tolerance: no scale while |ratio - 1| <= 0.1
-TOLERANCE = 0.1
-
 
 class Autoscaler:
     name = "autoscaler"
@@ -36,6 +33,9 @@ class Autoscaler:
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self.store = cluster.store
+        # k8s HPA tolerance: no scale while |ratio - 1| <= tolerance
+        # (0.1 default, config.autoscaler.tolerance)
+        self.tolerance = cluster.config.autoscaler.tolerance
         #: pod name -> utilization fraction of request (metrics-server stand-in)
         self.metrics: dict[str, float] = {}
 
@@ -84,7 +84,7 @@ class Autoscaler:
             ratio = utilization / max(hpa.spec.target_utilization, 1e-9)
             desired = (
                 current
-                if abs(ratio - 1.0) <= TOLERANCE
+                if abs(ratio - 1.0) <= self.tolerance
                 else max(1, math.ceil(current * ratio))
             )
         desired = min(max(desired, hpa.spec.min_replicas), hpa.spec.max_replicas)

@@ -9,6 +9,7 @@ termination timer) and re-settles.
 
 from __future__ import annotations
 
+from ..api.config import OperatorConfig, load_operator_config
 from ..api.types import Node, PodCliqueSet
 from ..cluster.cluster import Cluster
 from .podclique import PodCliqueReconciler
@@ -20,13 +21,25 @@ from .scheduler import GangScheduler
 
 class Harness:
     def __init__(self, nodes: list[Node] | None = None,
-                 cluster: Cluster | None = None, engine_cls=None):
-        self.cluster = cluster or Cluster(nodes=nodes)
+                 cluster: Cluster | None = None, engine_cls=None,
+                 config: OperatorConfig | dict | None = None):
+        """config: an OperatorConfig, or a plain dict decoded+validated
+        through api.config.load_operator_config (the --config YAML analog,
+        cmd/cli/cli.go:89-106). Ignored when an existing cluster (which owns
+        its config) is passed."""
+        if isinstance(config, dict):
+            config = load_operator_config(config)
+        self.cluster = cluster or Cluster(nodes=nodes, config=config)
+        self.config = self.cluster.config
         self.store = self.cluster.store
         self.clock = self.cluster.clock
         self.kubelet = self.cluster.kubelet
-        self.manager = ControllerManager(self.store)
-        self.manager.register(PodCliqueSetReconciler(self.store))
+        self.manager = ControllerManager(
+            self.store, identity=self.config.authorization.operator_identity
+        )
+        self.manager.register(
+            PodCliqueSetReconciler(self.store, config=self.config)
+        )
         self.manager.register(PCSGReconciler(self.store))
         self.manager.register(PodCliqueReconciler(self.store))
         kwargs = {"engine_cls": engine_cls} if engine_cls else {}
@@ -38,21 +51,26 @@ class Harness:
         self.manager.register(self.autoscaler)
 
     def autoscale(self) -> None:
-        """One periodic HPA sweep + settle (the HPA sync interval)."""
-        self.autoscaler.run_all()
+        """One periodic HPA sweep + settle (the HPA sync interval). The
+        sweep mutates managed scale targets, so it runs as the operator
+        identity like any reconcile."""
+        with self.store.impersonate(self.manager.identity or self.store.actor):
+            self.autoscaler.run_all()
         self.settle()
 
     def apply(self, pcs: PodCliqueSet):
         return self.store.create(pcs)
 
-    def settle(self, max_rounds: int = 64) -> None:
+    def settle(self, max_rounds: int | None = None) -> None:
         """Controllers + kubelet to fixpoint: reconcile until quiescent,
         tick the kubelet, repeat until neither produces changes."""
+        max_rounds = max_rounds or self.config.controllers.harness_max_rounds
+        inner = self.config.controllers.settle_max_rounds
         for _ in range(max_rounds):
-            self.manager.settle()
+            self.manager.settle(inner)
             if self.kubelet.tick() == 0:
                 # one more manager pass in case final kubelet writes queued
-                self.manager.settle()
+                self.manager.settle(inner)
                 if self.kubelet.tick() == 0:
                     return
         raise RuntimeError("harness did not settle")

@@ -22,6 +22,7 @@ from dataclasses import asdict
 from typing import Optional
 
 from ..api import constants, naming
+from ..api.config import OperatorConfig
 from ..api.auxiliary import (
     HorizontalPodAutoscaler,
     HPASpec,
@@ -61,8 +62,9 @@ KIND = PodCliqueSet.KIND
 class PodCliqueSetReconciler:
     name = "podcliqueset"
 
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, config: OperatorConfig | None = None):
         self.store = store
+        self.config = config or OperatorConfig()
 
     # -- watches (register.go:53-121) --------------------------------------
     def map_event(self, event: Event) -> list[Request]:
@@ -332,7 +334,7 @@ class PodCliqueSetReconciler:
         when a breach is ticking but not yet expired."""
         ns, name = pcs.metadata.namespace, pcs.metadata.name
         delay = pcs.spec.template.termination_delay or float(
-            constants.DEFAULT_TERMINATION_DELAY_SECONDS
+            self.config.workload_defaults.termination_delay_seconds
         )
         now = self.store.clock.now()
         min_wait: Optional[float] = None
@@ -486,7 +488,11 @@ class PodCliqueSetReconciler:
     # -- podgang component (components/podgang/syncflow.go) ----------------
     def _sync_podgangs(self, pcs: PodCliqueSet) -> None:
         ns, name = pcs.metadata.namespace, pcs.metadata.name
-        levels = self._topology_levels()
+        levels = (
+            self._topology_levels()
+            if self.config.topology_aware_scheduling.enabled
+            else None  # disabled: constraints are ignored, not unresolved
+        )
         expected = self._compute_expected_podgangs(pcs, levels)
         comp_labels = dict(
             base_labels(name),
@@ -674,6 +680,8 @@ class PodCliqueSetReconciler:
             self.store.update_status(fresh)
 
     def _missing_levels(self, pcs: PodCliqueSet) -> list[str]:
+        if not self.config.topology_aware_scheduling.enabled:
+            return []  # constraints ignored wholesale, nothing is "missing"
         levels = self._topology_levels()
         tmpl = pcs.spec.template
         wanted: set[str] = set()
@@ -708,7 +716,7 @@ def _constituent_available(obj) -> bool:
 
 
 def _translate(
-    tc: Optional[TopologyConstraintSpec], levels: dict[str, str]
+    tc: Optional[TopologyConstraintSpec], levels: Optional[dict[str, str]]
 ) -> Optional[TopologyConstraint]:
     """Operator-side domain names -> scheduler-contract label keys
     (the KAI Topology CR hand-off in the reference, clustertopology.go:
@@ -717,8 +725,13 @@ def _translate(
     an `unresolved:` sentinel key that can never match a snapshot level, so
     the solver marks the gang unschedulable instead of silently scheduling a
     hard constraint unconstrained. The PCS status additionally carries
-    TopologyLevelsUnavailable."""
-    if tc is None or tc.pack_constraint is None:
+    TopologyLevelsUnavailable.
+
+    levels=None means topology-aware scheduling is DISABLED by config: all
+    constraints are ignored wholesale (the reference deletes the KAI
+    Topology CR and stops translating), which is different from an enabled
+    system missing one level."""
+    if tc is None or tc.pack_constraint is None or levels is None:
         return None
     req = tc.pack_constraint.required
     pref = tc.pack_constraint.preferred

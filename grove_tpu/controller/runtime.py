@@ -53,8 +53,12 @@ class Reconciler(Protocol):
 
 
 class ControllerManager:
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, identity: str | None = None):
         self.store = store
+        #: the operator's service-account identity: reconciles run
+        #: impersonating it so the store's authorization hook can gate
+        #: managed-resource mutation to the operator (+ exempt actors).
+        self.identity = identity
         self.controllers: list[Reconciler] = []
         self._cursor = 0  # event-log position
         self._queue: list[tuple[str, Request]] = []
@@ -102,7 +106,11 @@ class ControllerManager:
         by_name = {c.name: c for c in self.controllers}
         for cname, req in batch:
             controller = by_name[cname]
-            result = controller.reconcile(req)
+            if self.identity is not None:
+                with self.store.impersonate(self.identity):
+                    result = controller.reconcile(req)
+            else:
+                result = controller.reconcile(req)
             if result.error:
                 self.errors.append((cname, req, result.error))
             if result.requeue_after is not None:

@@ -36,7 +36,6 @@ from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import UNRESOLVED_LEVEL, _resolve_level
 from .runtime import Request, Result
 
-RETRY_SECONDS = constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS
 _SINGLETON_REQ = Request("", "schedule")
 
 
@@ -47,6 +46,14 @@ class GangScheduler:
         self.cluster = cluster
         self.store = cluster.store
         self.engine_cls = engine_cls
+        cfg = cluster.config
+        self.retry_seconds = cfg.controllers.sync_retry_interval_seconds
+        self._engine_kwargs = dict(
+            top_k=cfg.solver.top_k,
+            native_repair=cfg.solver.native_repair,
+            commit_chunk=cfg.solver.commit_chunk,
+            bucket_min=cfg.solver.gang_bucket_minimum,
+        )
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == PodGang.KIND or event.kind == Node.KIND:
@@ -80,7 +87,7 @@ class GangScheduler:
             return Result()
 
         snapshot = self.cluster.topology_snapshot()
-        engine = self.engine_cls(snapshot)
+        engine = self.engine_cls(snapshot, **self._engine_kwargs)
         free = snapshot.free.copy()
         demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
 
@@ -108,7 +115,7 @@ class GangScheduler:
                 )
                 if asdict(gang.status) != before:
                     self.store.update_status(gang)
-                requeue = RETRY_SECONDS
+                requeue = self.retry_seconds
 
         self._bind_best_effort(scheduled_gangs, snapshot, free, demand_fn, engine)
         for gang in self.store.list(PodGang.KIND):

@@ -59,7 +59,8 @@ def make_solver_mesh(devices=None, gang_axis: int | None = None) -> Mesh:
     return Mesh(arr, axis_names=("gangs", "nodes"))
 
 
-def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int):
+def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
+                     chunk: int = 32):
     """Build the jitted, mesh-sharded equivalent of solver.engine's
     _device_score. Inputs must be padded: G divisible by the gangs axis,
     N by the nodes axis (PlacementEngine pads gangs; ShardedPlacementEngine
@@ -107,7 +108,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int):
         # runs replicated (bitwise-identical on every device).
         value = jax.lax.all_gather(value_l, "gangs", axis=0, tiled=True)
         td = jax.lax.all_gather(total_demand, "gangs", axis=0, tiled=True)
-        return commit_scan(value, dom_free, anc_ids, td, top_k)
+        return commit_scan(value, dom_free, anc_ids, td, top_k, chunk)
 
     return jax.jit(fn)
 
@@ -120,13 +121,15 @@ class ShardedPlacementEngine(PlacementEngine):
     single-device engine (asserted by tests/test_parallel.py).
     """
 
-    def __init__(self, snapshot: TopologySnapshot, mesh: Mesh, top_k: int = 8):
-        super().__init__(snapshot, top_k=top_k)
+    def __init__(self, snapshot: TopologySnapshot, mesh: Mesh, top_k: int = 8,
+                 **kwargs):
+        super().__init__(snapshot, top_k=top_k, **kwargs)
         self.mesh = mesh
         self._fn = sharded_score_fn(
             mesh,
             self.space.num_domains,
             min(self.top_k, self.space.num_domains),
+            self.commit_chunk,
         )  # jit caches per input shape; one wrapper serves all of them
 
     def _pad_nodes(self, arr: np.ndarray, axis: int, mult: int) -> np.ndarray:

@@ -216,7 +216,7 @@ def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int,
 
 @partial(
     jax.jit,
-    static_argnames=("num_domains", "top_k"),
+    static_argnames=("num_domains", "top_k", "chunk"),
 )
 def _device_score(
     free,            # f32 [N, R] (unschedulable nodes zeroed)
@@ -233,6 +233,7 @@ def _device_score(
     *,
     num_domains: int,
     top_k: int,
+    chunk: int = 32,
 ):
     m = membership_matrix(gdom, num_domains)
     dom_free = m.T @ free                                   # [D, R]
@@ -248,7 +249,9 @@ def _device_score(
         dom_free, cnt_fit, dom_level, total_demand, required_level,
         preferred_level, valid, cap_scale,
     )
-    top_val, top_dom = commit_scan(value, dom_free, anc_ids, total_demand, top_k)
+    top_val, top_dom = commit_scan(
+        value, dom_free, anc_ids, total_demand, top_k, chunk
+    )
     # Pack both outputs into ONE array: a host fetch through the dev
     # tunnel has large fixed latency, so results ship in a single
     # transfer (domain ids < 2^24 are exact in f32).
@@ -263,11 +266,15 @@ class PlacementEngine:
         snapshot: TopologySnapshot,
         top_k: int = 8,
         native_repair: bool = True,
+        commit_chunk: int = 32,
+        bucket_min: int = 8,
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
         self.top_k = top_k
         self.native_repair = native_repair
+        self.commit_chunk = commit_chunk
+        self.bucket_min = bucket_min
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
 
     def solve(
@@ -292,7 +299,7 @@ class PlacementEngine:
             return result
 
         order = sorted(solvable, key=gang_sort_key)
-        g_pad = _bucket(len(order))
+        g_pad = _bucket(len(order), minimum=self.bucket_min)
         r = len(snapshot.resource_names)
         total_demand = np.zeros((g_pad, r), dtype=np.float32)
         max_pod = np.zeros((g_pad, r), dtype=np.float32)
@@ -406,6 +413,7 @@ class PlacementEngine:
             jnp.asarray(cap_scale),
             num_domains=self.space.num_domains,
             top_k=min(self.top_k, self.space.num_domains),
+            chunk=self.commit_chunk,
         )
         packed = np.asarray(packed)  # single D2H transfer
         k = packed.shape[1] // 2

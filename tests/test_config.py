@@ -1,0 +1,285 @@
+"""Operator configuration API tests (api/config/v1alpha1 parity).
+
+The reference drives the operator from a validated OperatorConfiguration
+YAML (types.go:57-202, validation.go); here configs decode from dicts with
+strict unknown-field rejection, aggregate validation errors, and every
+formerly-hard-coded knob observably changes behavior through the Harness.
+"""
+
+import pytest
+
+from grove_tpu.api import ValidationError
+from grove_tpu.api.config import (
+    OperatorConfig,
+    load_operator_config,
+    validate_operator_config,
+)
+from grove_tpu.api.types import Pod, PodCliqueSet
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+
+class TestConfigDecode:
+    def test_empty_dict_yields_defaults(self):
+        cfg = load_operator_config({})
+        assert cfg.workload_defaults.termination_delay_seconds == 4 * 3600
+        assert cfg.solver.top_k == 8
+        assert cfg.controllers.sync_retry_interval_seconds == 5.0
+        assert cfg.autoscaler.tolerance == 0.1
+        assert not cfg.authorization.enabled
+
+    def test_nested_overrides(self):
+        cfg = load_operator_config(
+            {
+                "workload_defaults": {"termination_delay_seconds": 60.0},
+                "solver": {"top_k": 4, "native_repair": False},
+                "log": {"level": "debug", "format": "json"},
+            }
+        )
+        assert cfg.workload_defaults.termination_delay_seconds == 60.0
+        assert cfg.solver.top_k == 4
+        assert not cfg.solver.native_repair
+        assert cfg.solver.commit_chunk == 32  # untouched default
+        assert cfg.log.level == "debug"
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValidationError, match="unknown field"):
+            load_operator_config({"solver": {"topk": 4}})
+
+    def test_errors_aggregate(self):
+        with pytest.raises(ValidationError) as e:
+            load_operator_config(
+                {
+                    "solver": {"top_k": 0, "gang_bucket_minimum": 6},
+                    "autoscaler": {"tolerance": 2.0},
+                    "log": {"level": "verbose"},
+                }
+            )
+        msgs = e.value.errors
+        assert len(msgs) == 4, msgs
+        assert any("top_k" in m for m in msgs)
+        assert any("power of two" in m for m in msgs)
+        assert any("tolerance" in m for m in msgs)
+        assert any("log.level" in m for m in msgs)
+
+    def test_authorization_validation(self):
+        errs = validate_operator_config(
+            load_operator_config({"authorization": {"enabled": True}})
+        )
+        assert errs == []  # default identity satisfies the requirement
+        with pytest.raises(ValidationError, match="operator_identity"):
+            load_operator_config(
+                {"authorization": {"enabled": True, "operator_identity": ""}}
+            )
+
+    def test_topology_levels_validation(self):
+        with pytest.raises(ValidationError, match="duplicate domain"):
+            load_operator_config(
+                {
+                    "topology_aware_scheduling": {
+                        "levels": [
+                            {"domain": "rack", "key": "a"},
+                            {"domain": "rack", "key": "b"},
+                        ]
+                    }
+                }
+            )
+
+
+class TestConfigChangesBehavior:
+    def test_workload_defaults_flow_into_admission(self):
+        h = Harness(
+            nodes=make_nodes(4),
+            config={
+                "workload_defaults": {
+                    "termination_delay_seconds": 123.0,
+                    "replicas": 2,
+                }
+            },
+        )
+        pcs = simple_pcs(cliques=[clique("w", replicas=1)])
+        pcs.spec.replicas = None  # let defaulting fill it
+        h.apply(pcs)
+        h.settle()
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.spec.template.termination_delay == 123.0
+        assert live.spec.replicas == 2
+        assert len(h.store.list(Pod.KIND)) == 2  # one pod per PCS replica
+
+    def test_scheduler_retry_interval_from_config(self):
+        h = Harness(
+            nodes=make_nodes(1, allocatable={"cpu": 1.0, "memory": 1.0,
+                                             "tpu": 0.0}),
+            config={"controllers": {"sync_retry_interval_seconds": 60.0}},
+        )
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=2.0)]))
+        h.settle()
+        assert all(not p.node_name for p in h.store.list(Pod.KIND))
+        # the unschedulable gang's retry is paced by the configured 60s,
+        # not the built-in 5s default
+        next_retry = h.manager.next_requeue_at()
+        assert next_retry is not None
+        assert next_retry == pytest.approx(h.clock.now() + 60.0, abs=1e-6)
+
+    def test_solver_knobs_reach_engine(self):
+        captured = {}
+
+        class Probe:
+            def __init__(self, snapshot, **kwargs):
+                captured.update(kwargs)
+                from grove_tpu.solver import PlacementEngine
+
+                self._e = PlacementEngine(snapshot, **kwargs)
+
+            def solve(self, gangs, free=None):
+                return self._e.solve(gangs, free=free)
+
+        h = Harness(
+            nodes=make_nodes(2),
+            engine_cls=Probe,
+            config={
+                "solver": {
+                    "top_k": 3,
+                    "commit_chunk": 16,
+                    "gang_bucket_minimum": 4,
+                    "native_repair": False,
+                }
+            },
+        )
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        assert captured == {
+            "top_k": 3,
+            "commit_chunk": 16,
+            "bucket_min": 4,
+            "native_repair": False,
+        }
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+
+    def test_topology_levels_seed_bootstrap(self):
+        nodes = make_nodes(4, racks_per_block=2, hosts_per_rack=2)
+        for i, n in enumerate(nodes):
+            n.metadata.labels["t/zone"] = f"z{i % 2}"
+        h = Harness(
+            nodes=nodes,
+            config={
+                "topology_aware_scheduling": {
+                    "levels": [{"domain": "zone", "key": "t/zone"}]
+                }
+            },
+        )
+        snap = h.cluster.topology_snapshot()
+        assert "t/zone" in snap.level_keys
+
+    def test_topology_disabled_ignores_constraints(self):
+        from grove_tpu.api.types import (
+            TopologyConstraintSpec,
+            TopologyPackConstraintSpec,
+        )
+
+        # with TAS disabled a zone-required workload schedules UNCONSTRAINED
+        # (reference: no KAI Topology CR, no constraint translation) —
+        # distinct from enabled-but-missing-level, which HOLDS the gang
+        h = Harness(
+            nodes=make_nodes(4),
+            config={"topology_aware_scheduling": {"enabled": False}},
+        )
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.topology_constraint = TopologyConstraintSpec(
+            pack_constraint=TopologyPackConstraintSpec(required="zone")
+        )
+        h.apply(pcs)
+        h.settle()
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+
+
+class TestAuthorization:
+    """Managed-resource protection (authorization webhook analog):
+    non-operator actors cannot mutate operator-created children."""
+
+    def harness(self, **az):
+        return Harness(
+            nodes=make_nodes(4),
+            config={"authorization": {"enabled": True, **az}},
+        )
+
+    def test_user_cannot_mutate_managed_resources(self):
+        from grove_tpu.api.types import PodClique
+        from grove_tpu.cluster.store import Forbidden
+
+        h = self.harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert pclq is not None
+        # direct store calls run as the unprivileged "user" actor
+        pclq.spec.replicas = 99
+        with pytest.raises(Forbidden, match="may not update"):
+            h.store.update(pclq)
+        with pytest.raises(Forbidden, match="may not delete"):
+            h.store.delete(PodClique.KIND, "default", "simple1-0-w")
+        with pytest.raises(Forbidden, match="may not update"):
+            h.store.remove_finalizer(
+                PodClique.KIND, "default", "simple1-0-w",
+                pclq.metadata.finalizers[0],
+            )
+
+    def test_user_still_owns_their_podcliqueset(self):
+        from grove_tpu.api.types import PodCliqueSet
+
+        h = self.harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        pcs.spec.replicas = 2  # user-applied object: freely mutable
+        h.store.update(pcs)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 4
+
+    def test_controllers_and_lifecycle_unaffected(self):
+        # the full reconcile lifecycle (create children, bind, gang
+        # terminate, cascade delete) runs as the operator identity
+        from grove_tpu.api.types import PodCliqueSet
+
+        h = self.harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+        h.store.delete(PodCliqueSet.KIND, "default", "simple1")
+        h.settle()
+        assert h.store.list(Pod.KIND) == []
+
+    def test_exempt_actor_allowed(self):
+        from grove_tpu.api.types import PodClique
+
+        h = self.harness(exempt_actors=["admin@corp"])
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        pclq.spec.replicas = 3
+        with h.store.impersonate("admin@corp"):
+            h.store.update(pclq)
+        h.settle()
+        assert h.store.get(
+            PodClique.KIND, "default", "simple1-0-w"
+        ).spec.replicas == 3
+
+    def test_disable_protection_annotation(self):
+        from grove_tpu.api import constants
+        from grove_tpu.api.types import PodClique
+
+        h = self.harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        pclq.metadata.annotations[
+            constants.ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION
+        ] = "true"
+        with h.store.impersonate(h.config.authorization.operator_identity):
+            h.store.update(pclq)
+        # now the user may touch it
+        fresh = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        fresh.spec.replicas = 5
+        h.store.update(fresh)
