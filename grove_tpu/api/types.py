@@ -185,6 +185,9 @@ class PodCliqueStatus:
     current_pod_template_hash: str = ""
     current_pcs_generation_hash: str = ""
     rolling_update_progress: Optional[PodCliqueRollingUpdateProgress] = None
+    # podclique.go:107-108: each kind carries its OWN controller errors.
+    last_errors: list["LastError"] = field(default_factory=list)
+    last_operation: Optional["LastOperation"] = None
 
 
 @dataclass
@@ -256,6 +259,9 @@ class PodCliqueScalingGroupStatus:
     selector: str = ""
     current_generation_hash: str = ""
     rolling_update_progress: Optional[PCSGRollingUpdateProgress] = None
+    # scalinggroup.go:94-95
+    last_errors: list["LastError"] = field(default_factory=list)
+    last_operation: Optional["LastOperation"] = None
 
 
 @dataclass

@@ -20,6 +20,7 @@ from ..api.auxiliary import PriorityClass
 from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
 from ..api.types import ClusterTopology, Node, Pod, PodPhase, TopologyLevel
+from ..observability import Logger, MetricsRegistry
 from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
 from .clock import SimClock
 from .kubelet import SimKubelet
@@ -34,6 +35,13 @@ class Cluster:
         self.clock = SimClock()
         self.store = ObjectStore(self.clock)
         self.kubelet = SimKubelet(self.store)
+        # One registry per cluster: scheduler + engine feed it, bench.py and
+        # the /metrics text exposition read it (SURVEY §5: the reference has
+        # no custom scheduler metrics; the north-star numbers live here).
+        self.metrics = MetricsRegistry()
+        self.logger = Logger(
+            level=self.config.log.level, format=self.config.log.format
+        )
         defaults = self.config.workload_defaults
         self.store.register_admission(
             "PodCliqueSet",

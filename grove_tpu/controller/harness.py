@@ -35,7 +35,12 @@ class Harness:
         self.clock = self.cluster.clock
         self.kubelet = self.cluster.kubelet
         self.manager = ControllerManager(
-            self.store, identity=self.config.authorization.operator_identity
+            self.store,
+            identity=self.config.authorization.operator_identity,
+            error_retry_seconds=(
+                self.config.controllers.sync_retry_interval_seconds
+            ),
+            logger=self.cluster.logger.with_name("manager"),
         )
         self.manager.register(
             PodCliqueSetReconciler(self.store, config=self.config)

@@ -31,6 +31,8 @@ from ..api.types import (
 )
 from ..cluster.store import Event, ObjectStore
 from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
+from ..observability.events import EventRecorder, REASON_CREATE_SUCCESSFUL
+from .errors import GroveError, clear_status_errors, record_status_error
 from .runtime import Request, Result
 
 KIND = PodClique.KIND
@@ -41,6 +43,14 @@ class PodCliqueReconciler:
 
     def __init__(self, store: ObjectStore):
         self.store = store
+        self.recorder = EventRecorder(store, controller=self.name)
+
+    def record_error(self, request: Request, err: GroveError) -> None:
+        """Every kind surfaces its own controller errors
+        (podclique.go:107-108)."""
+        record_status_error(
+            self.store, KIND, request.namespace, request.name, err
+        )
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
@@ -152,6 +162,12 @@ class PodCliqueReconciler:
         for idx in free_indices:
             pod = self._build_pod(pclq, pcs, idx)
             self.store.create(pod)
+        if free_indices:
+            self.recorder.normal(
+                pclq,
+                REASON_CREATE_SUCCESSFUL,
+                f"created {len(free_indices)} pod(s) (scheduling gated)",
+            )
 
     def _build_pod(self, pclq: PodClique, pcs: PodCliqueSet | None, idx: int) -> Pod:
         ns = pclq.metadata.namespace
@@ -388,6 +404,7 @@ class PodCliqueReconciler:
             ),
             now=now,
         )
+        clear_status_errors(self.store, status, now)
         if asdict(status) != before:
             self.store.update_status(fresh)
 

@@ -25,6 +25,7 @@ from ..api.types import (
 )
 from ..cluster.store import Event, ObjectStore
 from .common import base_labels, new_meta
+from .errors import GroveError, clear_status_errors, record_status_error
 from .runtime import Request, Result
 
 KIND = PodCliqueScalingGroup.KIND
@@ -35,6 +36,13 @@ class PCSGReconciler:
 
     def __init__(self, store: ObjectStore):
         self.store = store
+
+    def record_error(self, request: Request, err: GroveError) -> None:
+        """Every kind surfaces its own controller errors
+        (scalinggroup.go:94-95)."""
+        record_status_error(
+            self.store, KIND, request.namespace, request.name, err
+        )
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
@@ -289,6 +297,7 @@ class PCSGReconciler:
             ),
             now=now,
         )
+        clear_status_errors(self.store, status, now)
         if asdict(status) != before:
             self.store.update_status(fresh)
 

@@ -44,6 +44,7 @@ from ..api.podgang import (
 )
 from ..api.types import (
     ClusterTopology,
+    LastOperation,
     Pod,
     PodClique,
     PodCliqueScalingGroup,
@@ -53,7 +54,12 @@ from ..api.types import (
     TopologyConstraintSpec,
 )
 from ..cluster.store import Event, ObjectStore
+from ..observability.events import (
+    EventRecorder,
+    REASON_GANG_TERMINATED,
+)
 from .common import base_labels, is_pod_active, new_meta, pcs_generation_hash
+from .errors import GroveError, clear_status_errors, record_pcs_error
 from .runtime import Request, Result
 
 KIND = PodCliqueSet.KIND
@@ -65,6 +71,12 @@ class PodCliqueSetReconciler:
     def __init__(self, store: ObjectStore, config: OperatorConfig | None = None):
         self.store = store
         self.config = config or OperatorConfig()
+        self.recorder = EventRecorder(store, controller=self.name)
+
+    def record_error(self, request: Request, err: GroveError) -> None:
+        """Manager error hook: surface to status.last_errors/last_operation
+        (reconcile_error_recorder.go analog)."""
+        record_pcs_error(self.store, request.namespace, request.name, err)
 
     # -- watches (register.go:53-121) --------------------------------------
     def map_event(self, event: Event) -> list[Request]:
@@ -369,6 +381,12 @@ class PodCliqueSetReconciler:
         """Delete every PodClique of the replica (PCSG-owned included) and
         its PodGangs; reconcile recreates them (gang restart)."""
         ns, name = pcs.metadata.namespace, pcs.metadata.name
+        self.recorder.warning(
+            pcs,
+            REASON_GANG_TERMINATED,
+            f"replica {replica}: MinAvailable breached longer than "
+            f"terminationDelay; deleting constituent PodCliques and PodGangs",
+        )
         sel = {
             constants.LABEL_PART_OF: name,
             constants.LABEL_PCS_REPLICA_INDEX: str(replica),
@@ -676,6 +694,7 @@ class PodCliqueSetReconciler:
             now=self.store.clock.now(),
         )
         status.selector = f"{constants.LABEL_PART_OF}={name}"
+        clear_status_errors(self.store, status, self.store.clock.now())
         if asdict(status) != before:
             self.store.update_status(fresh)
 

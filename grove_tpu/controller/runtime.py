@@ -53,12 +53,17 @@ class Reconciler(Protocol):
 
 
 class ControllerManager:
-    def __init__(self, store: ObjectStore, identity: str | None = None):
+    def __init__(self, store: ObjectStore, identity: str | None = None,
+                 error_retry_seconds: float = 5.0, logger=None):
         self.store = store
         #: the operator's service-account identity: reconciles run
         #: impersonating it so the store's authorization hook can gate
         #: managed-resource mutation to the operator (+ exempt actors).
         self.identity = identity
+        #: requeue delay after a reconcile raises (ERR_REQUEUE_AFTER flow)
+        self.error_retry_seconds = error_retry_seconds
+        #: observability.Logger (config.log); None = silent
+        self.logger = logger
         self.controllers: list[Reconciler] = []
         self._cursor = 0  # event-log position
         self._queue: list[tuple[str, Request]] = []
@@ -106,13 +111,43 @@ class ControllerManager:
         by_name = {c.name: c for c in self.controllers}
         for cname, req in batch:
             controller = by_name[cname]
-            if self.identity is not None:
-                with self.store.impersonate(self.identity):
+            try:
+                if self.identity is not None:
+                    with self.store.impersonate(self.identity):
+                        result = controller.reconcile(req)
+                else:
                     result = controller.reconcile(req)
-            else:
-                result = controller.reconcile(req)
+            except Exception as exc:
+                # A reconcile panic never kills the manager (the reference
+                # sets RecoverPanic, manager.go:105-107): record it, let the
+                # controller surface it to the owning object's status, and
+                # retry on the error interval.
+                from .errors import to_grove_error
+
+                err = to_grove_error(exc, f"{cname}:{req.namespace}/{req.name}")
+                self.errors.append((cname, req, str(err)))
+                if self.logger is not None:
+                    self.logger.error(
+                        "reconcile failed", controller=cname,
+                        namespace=req.namespace, name=req.name,
+                        code=err.code, error=err.message,
+                    )
+                recorder = getattr(controller, "record_error", None)
+                if recorder is not None:
+                    if self.identity is not None:
+                        with self.store.impersonate(self.identity):
+                            recorder(req, err)
+                    else:
+                        recorder(req, err)
+                result = Result(requeue_after=self.error_retry_seconds)
             if result.error:
                 self.errors.append((cname, req, result.error))
+            if self.logger is not None:
+                self.logger.debug(
+                    "reconciled", controller=cname,
+                    namespace=req.namespace, name=req.name,
+                    requeue_after=result.requeue_after,
+                )
             if result.requeue_after is not None:
                 heapq.heappush(
                     self._requeues,

@@ -25,13 +25,17 @@ from typing import Optional
 
 import numpy as np
 
-from ..api import constants
 from ..api.auxiliary import PriorityClass
 from ..api.meta import get_condition, set_condition
 from ..api.podgang import PodGang, PodGangConditionType, PodGangPhase
 from ..api.types import ClusterTopology, Node, Pod, PodPhase
 from ..cluster.cluster import Cluster
 from ..cluster.store import Event
+from ..observability.events import (
+    EventRecorder,
+    REASON_PODGANG_SCHEDULED,
+    REASON_PODGANG_UNSCHEDULABLE,
+)
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import UNRESOLVED_LEVEL, _resolve_level
 from .runtime import Request, Result
@@ -48,11 +52,15 @@ class GangScheduler:
         self.engine_cls = engine_cls
         cfg = cluster.config
         self.retry_seconds = cfg.controllers.sync_retry_interval_seconds
+        self.metrics = cluster.metrics
+        self.recorder = EventRecorder(cluster.store, controller=self.name)
+        self.log = cluster.logger.with_name("scheduler")
         self._engine_kwargs = dict(
             top_k=cfg.solver.top_k,
             native_repair=cfg.solver.native_repair,
             commit_chunk=cfg.solver.commit_chunk,
             bucket_min=cfg.solver.gang_bucket_minimum,
+            metrics=cluster.metrics,
         )
 
     def map_event(self, event: Event) -> list[Request]:
@@ -97,6 +105,11 @@ class GangScheduler:
                 backlog, snapshot, demand_fn, priority_of=self._priority_of
             )
             result = engine.solve(solver_gangs, free=free)
+            self.log.debug(
+                "backlog solved", gangs=len(backlog),
+                placed=result.num_placed, unplaced=len(result.unplaced),
+                wall_seconds=round(result.wall_seconds, 4),
+            )
             by_name = {g.metadata.name: g for g in backlog}
             for name, placement in result.placed.items():
                 self._bind(by_name[name], placement)
@@ -105,6 +118,10 @@ class GangScheduler:
 
                 gang = by_name[name]
                 before = asdict(gang.status)
+                prev = get_condition(
+                    gang.status.conditions, PodGangConditionType.SCHEDULED.value
+                )
+                entered = prev is None or prev.status != "False"
                 set_condition(
                     gang.status.conditions,
                     PodGangConditionType.SCHEDULED.value,
@@ -115,6 +132,14 @@ class GangScheduler:
                 )
                 if asdict(gang.status) != before:
                     self.store.update_status(gang)
+                if entered:  # count state TRANSITIONS, not message churn
+                    self.metrics.counter(
+                        "grove_scheduler_gangs_unschedulable_total",
+                        "gangs that entered the Unschedulable state",
+                    ).inc()
+                    self.recorder.warning(
+                        gang, REASON_PODGANG_UNSCHEDULABLE, reason
+                    )
                 requeue = self.retry_seconds
 
         self._bind_best_effort(scheduled_gangs, snapshot, free, demand_fn, engine)
@@ -183,6 +208,20 @@ class GangScheduler:
             now=self.store.clock.now(),
         )
         self.store.update_status(gang)
+        self.metrics.counter(
+            "grove_scheduler_gangs_scheduled_total", "gangs bound to nodes"
+        ).inc()
+        # control-plane bind latency: gang creation -> bind (virtual clock)
+        self.metrics.histogram(
+            "grove_scheduler_gang_bind_latency_seconds",
+            "virtual seconds from PodGang creation to bind",
+        ).observe(self.store.clock.now() - gang.metadata.creation_timestamp)
+        self.recorder.normal(
+            gang,
+            REASON_PODGANG_SCHEDULED,
+            f"placed {len(placement.pod_to_node)} pods "
+            f"(score {placement.placement_score:.3f})",
+        )
 
     def _bind_best_effort(self, scheduled_gangs, snapshot, free, demand_fn, engine):
         """Pods referenced beyond MinReplicas (or replacements for evicted

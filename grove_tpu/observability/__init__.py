@@ -1,0 +1,25 @@
+"""Observability: metrics registry, event recorder, structured logging.
+
+The reference serves controller-runtime Prometheus metrics
+(internal/controller/manager.go:94-96), emits k8s Events on every
+create/delete/fail (internal/constants/constants.go:36-98), and logs
+through a structured zap logger (internal/logger/). SURVEY §5 notes it has
+NO custom scheduler metrics — the gangs/sec + bind-latency numbers this
+framework treats as its north star are first-class here instead: the
+scheduler and placement engine feed an in-framework registry that bench.py
+reads rather than re-deriving.
+"""
+
+from .events import ClusterEvent, EventRecorder
+from .logging import Logger
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+__all__ = [
+    "ClusterEvent",
+    "Counter",
+    "EventRecorder",
+    "Gauge",
+    "Histogram",
+    "Logger",
+    "MetricsRegistry",
+]

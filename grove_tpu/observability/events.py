@@ -1,0 +1,83 @@
+"""Kubernetes-Event-style records for controller actions.
+
+The reference emits a k8s Event for every significant create/delete/fail
+(reasons enumerated in internal/constants/constants.go:36-98, recorded via
+controller-runtime's EventRecorder). ClusterEvent is the store-object
+analog: controllers record against the involved object; identical
+(object, reason) pairs dedup with a count bump, exactly like the k8s
+events compaction."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api.meta import ObjectMeta
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+# Reasons (constants.go:36-98 flavor).
+REASON_CREATE_SUCCESSFUL = "CreateSuccessful"
+REASON_DELETE_SUCCESSFUL = "DeleteSuccessful"
+REASON_PODGANG_SCHEDULED = "PodGangScheduled"
+REASON_PODGANG_UNSCHEDULABLE = "PodGangUnschedulable"
+REASON_GANG_TERMINATED = "PodGangTerminated"
+REASON_RECONCILE_ERROR = "ReconcileError"
+
+
+@dataclass
+class ClusterEvent:
+    """corev1.Event equivalent (involvedObject + reason + message + count)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = TYPE_NORMAL
+    reason: str = ""
+    message: str = ""
+    involved_kind: str = ""
+    involved_name: str = ""
+    reporting_controller: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+    KIND = "Event"
+
+
+class EventRecorder:
+    """Store-backed recorder; dedup key is (namespace, involved kind+name,
+    reason) with count/last_timestamp compaction."""
+
+    def __init__(self, store, controller: str = ""):
+        self.store = store
+        self.controller = controller
+
+    def event(self, involved, type_: str, reason: str, message: str) -> None:
+        ns = involved.metadata.namespace or "default"
+        name = f"{involved.KIND.lower()}-{involved.metadata.name}-{reason.lower()}"
+        now = self.store.clock.now()
+        existing = self.store.get(ClusterEvent.KIND, ns, name)
+        if existing is not None:
+            existing.count += 1
+            existing.message = message
+            existing.last_timestamp = now
+            self.store.update(existing)
+            return
+        self.store.create(
+            ClusterEvent(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                type=type_,
+                reason=reason,
+                message=message,
+                involved_kind=involved.KIND,
+                involved_name=involved.metadata.name,
+                reporting_controller=self.controller,
+                first_timestamp=now,
+                last_timestamp=now,
+            )
+        )
+
+    def normal(self, involved, reason: str, message: str) -> None:
+        self.event(involved, TYPE_NORMAL, reason, message)
+
+    def warning(self, involved, reason: str, message: str) -> None:
+        self.event(involved, TYPE_WARNING, reason, message)

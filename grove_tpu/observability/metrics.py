@@ -1,0 +1,137 @@
+"""In-framework metrics: counters, gauges, histograms + Prometheus text.
+
+Parity target: the reference exposes controller-runtime's default
+Prometheus endpoint (manager.go:94-96) but defines no scheduler metrics of
+its own. Here the registry carries the framework's north-star numbers —
+gangs scheduled/sec, backlog bind latency, placement-score distribution,
+repair fallbacks — fed by GangScheduler and PlacementEngine and consumed
+by bench.py (the driver metric) and tests.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass, field
+
+
+def _label_key(labels: dict[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str = ""
+    _values: dict[tuple, float] = field(default_factory=dict)
+
+    def set(self, value: float, **labels: str) -> None:
+        self._values[_label_key(labels)] = value
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+@dataclass
+class Histogram:
+    """Exact-percentile histogram: observations are kept sorted (cheap at
+    control-plane volumes) so p50/p99 are exact, not bucket-interpolated."""
+
+    name: str
+    help: str = ""
+    _obs: list[float] = field(default_factory=list)
+
+    def observe(self, value: float) -> None:
+        bisect.insort(self._obs, value)
+
+    @property
+    def count(self) -> int:
+        return len(self._obs)
+
+    @property
+    def sum(self) -> float:
+        return float(sum(self._obs))
+
+    def mean(self) -> float:
+        return self.sum / self.count if self._obs else 0.0
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 100]; nearest-rank on the sorted observations."""
+        if not self._obs:
+            return 0.0
+        idx = min(len(self._obs) - 1, max(0, round(q / 100 * (len(self._obs) - 1))))
+        return self._obs[int(idx)]
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(name, Counter, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(name, Gauge, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get_or_make(name, Histogram, help)
+
+    def _get_or_make(self, name, cls, help):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name=name, help=help)
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}")
+        return m
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition (the /metrics endpoint analog)."""
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                for key, v in sorted(m._values.items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {v}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {name} gauge")
+                for key, v in sorted(m._values.items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {v}")
+            else:
+                lines.append(f"# TYPE {name} summary")
+                for q in (50, 90, 99):
+                    lines.append(
+                        f'{name}{{quantile="0.{q}"}} {m.percentile(q)}'
+                    )
+                lines.append(f"{name}_sum {m.sum}")
+                lines.append(f"{name}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"

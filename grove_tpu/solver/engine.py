@@ -268,6 +268,7 @@ class PlacementEngine:
         native_repair: bool = True,
         commit_chunk: int = 32,
         bucket_min: int = 8,
+        metrics=None,
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
@@ -275,6 +276,9 @@ class PlacementEngine:
         self.native_repair = native_repair
         self.commit_chunk = commit_chunk
         self.bucket_min = bucket_min
+        #: observability.MetricsRegistry; solve() feeds the north-star
+        #: numbers (backlog bind latency, placements, score distribution)
+        self.metrics = metrics
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
 
     def solve(
@@ -296,6 +300,8 @@ class PlacementEngine:
                 solvable.append(g)
         if not solvable:
             result.wall_seconds = time.perf_counter() - t0
+            if self.metrics is not None:
+                self._record_metrics(result, len(gangs))
             return result
 
         order = sorted(solvable, key=gang_sort_key)
@@ -335,7 +341,29 @@ class PlacementEngine:
                 result.unplaced[gang.name] = "no feasible domain"
         result.stats["fallbacks"] = float(fallbacks)
         result.wall_seconds = time.perf_counter() - t0
+        if self.metrics is not None:
+            self._record_metrics(result, len(gangs))
         return result
+
+    def _record_metrics(self, result: SolveResult, backlog: int) -> None:
+        m = self.metrics
+        m.gauge("grove_solver_backlog_size",
+                "gangs entering the last solve").set(float(backlog))
+        m.histogram("grove_solver_backlog_bind_seconds",
+                    "wall time to bind one full backlog").observe(
+            result.wall_seconds)
+        m.counter("grove_solver_gangs_placed_total",
+                  "gangs placed across all solves").inc(result.num_placed)
+        m.counter("grove_solver_gangs_unplaced_total",
+                  "gangs left unplaced across all solves").inc(
+            len(result.unplaced))
+        m.counter("grove_solver_repair_fallbacks_total",
+                  "exact-repair serial fallbacks").inc(
+            result.stats.get("fallbacks", 0.0))
+        score_h = m.histogram("grove_solver_placement_score",
+                              "per-gang placement score (0,1]")
+        for p in result.placed.values():
+            score_h.observe(p.placement_score)
 
     def _repair(self, order, top_val, top_dom, free):
         """Exact commit phase. Uses the native (C++) implementation when the

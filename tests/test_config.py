@@ -150,6 +150,7 @@ class TestConfigChangesBehavior:
         )
         h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
         h.settle()
+        assert captured.pop("metrics") is h.cluster.metrics
         assert captured == {
             "top_k": 3,
             "commit_chunk": 16,

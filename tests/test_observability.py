@@ -1,0 +1,246 @@
+"""Error-model + observability tests.
+
+VERDICT r1 #3: (a) a reconciler failure becomes a typed error written to
+the owning PCS's status.last_errors/last_operation (errors.go:90-103,
+reconcile_error_recorder.go analog); (b) an in-framework metrics registry
+carries the north-star numbers and controllers emit k8s-style Events
+(constants.go:36-98)."""
+
+import pytest
+
+from grove_tpu.api.podgang import PodGang
+from grove_tpu.api.types import Pod, PodClique, PodCliqueSet
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability import ClusterEvent, MetricsRegistry
+from grove_tpu.observability.events import (
+    REASON_GANG_TERMINATED,
+    REASON_PODGANG_SCHEDULED,
+    REASON_PODGANG_UNSCHEDULABLE,
+)
+
+from test_e2e_basic import clique, simple_pcs
+
+
+class TestErrorSurfacing:
+    def test_reconciler_crash_surfaces_to_pcs_status(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+
+        # kill the PCS reconciler mid-flight: every reconcile now raises
+        original = h.manager.controllers[0].reconcile
+        calls = {"n": 0}
+
+        def boom(request):
+            calls["n"] += 1
+            raise RuntimeError("injected reconciler crash")
+
+        h.manager.controllers[0].reconcile = boom
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        pcs.spec.replicas = 2  # trigger a reconcile
+        h.store.update(pcs)
+        h.settle()  # must NOT hang or raise: error is caught + recorded
+
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert len(live.status.last_errors) == 1
+        err = live.status.last_errors[0]
+        assert err.code == "ERR_INTERNAL"
+        assert "injected reconciler crash" in err.description
+        assert live.status.last_operation.state == "Error"
+        assert calls["n"] >= 1
+        assert h.manager.errors, "manager records the failure too"
+
+        # recovery: restore the reconciler, retry fires on the error
+        # interval, status clears
+        h.manager.controllers[0].reconcile = original
+        h.advance(h.config.controllers.sync_retry_interval_seconds + 0.1)
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.status.last_errors == []
+        assert live.status.last_operation.state == "Succeeded"
+        assert len(h.store.list(Pod.KIND)) == 4  # replica 2 got built
+
+    def test_success_stamps_last_operation(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.status.last_operation is not None
+        assert live.status.last_operation.state == "Succeeded"
+        assert live.status.last_errors == []
+
+    def test_child_reconciler_error_on_child_status(self):
+        # each kind carries its OWN last_errors (podclique.go:107-108) —
+        # a failing PodClique reconciler surfaces on the PodClique, and the
+        # healthy PCS reconciler's success pass must NOT clear it
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pclq_rec = next(
+            c for c in h.manager.controllers if c.name == "podclique"
+        )
+        original = pclq_rec.reconcile
+        pclq_rec.reconcile = lambda req: (_ for _ in ()).throw(
+            ValueError("child blew up")
+        )
+        # poke the PodClique so its reconciler runs
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        pclq.spec.replicas = 3
+        with h.store.impersonate(h.config.authorization.operator_identity):
+            h.store.update(pclq)
+        h.settle()
+        live = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert live.status.last_errors
+        assert "child blew up" in live.status.last_errors[0].description
+        assert live.status.last_operation.state == "Error"
+        # PCS's own reconcile stays green
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.last_errors == []
+        # recovery clears the child's error
+        pclq_rec.reconcile = original
+        h.advance(h.config.controllers.sync_retry_interval_seconds + 0.1)
+        live = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert live.status.last_errors == []
+        assert live.status.last_operation.state == "Succeeded"
+
+
+class TestMetrics:
+    def test_registry_primitives(self):
+        r = MetricsRegistry()
+        c = r.counter("c", "help")
+        c.inc()
+        c.inc(2.0, kind="x")
+        assert c.total() == 3.0
+        assert c.value(kind="x") == 2.0
+        h = r.histogram("h")
+        for v in (0.1, 0.2, 0.3, 0.4):
+            h.observe(v)
+        assert h.count == 4
+        assert h.percentile(50) == pytest.approx(0.2, abs=0.11)
+        assert h.percentile(99) == 0.4
+        g = r.gauge("g")
+        g.set(7.0)
+        assert g.value() == 7.0
+        text = r.render()
+        assert "# TYPE c counter" in text
+        assert 'c{kind="x"} 2.0' in text
+        assert "h_count 4" in text
+
+    def test_scheduler_feeds_registry(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        m = h.cluster.metrics
+        assert m.counter("grove_scheduler_gangs_scheduled_total").total() == 1
+        assert m.counter("grove_solver_gangs_placed_total").total() >= 1
+        bind = m.histogram("grove_scheduler_gang_bind_latency_seconds")
+        assert bind.count == 1
+        assert m.histogram("grove_solver_backlog_bind_seconds").count >= 1
+        score = m.histogram("grove_solver_placement_score")
+        assert 0.0 < score.mean() <= 1.0
+
+    def test_unschedulable_counted(self):
+        h = Harness(nodes=make_nodes(1, allocatable={"cpu": 1.0,
+                                                     "memory": 1.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=3.0)]))
+        h.settle()
+        m = h.cluster.metrics
+        assert m.counter(
+            "grove_scheduler_gangs_unschedulable_total"
+        ).total() == 1
+
+
+class TestEvents:
+    def test_schedule_and_creation_events(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        events = h.store.list(ClusterEvent.KIND)
+        reasons = {e.reason for e in events}
+        assert REASON_PODGANG_SCHEDULED in reasons
+        assert "CreateSuccessful" in reasons
+        sched = next(e for e in events
+                     if e.reason == REASON_PODGANG_SCHEDULED)
+        assert sched.involved_kind == PodGang.KIND
+        assert sched.involved_name == "simple1-0"
+        assert sched.reporting_controller == "scheduler"
+        assert sched.type == "Normal"
+
+    def test_unschedulable_and_termination_events(self):
+        h = Harness(nodes=make_nodes(4))
+        pcs = simple_pcs(cliques=[clique("w", replicas=2)])
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        h.advance(61.0)
+        events = h.store.list(ClusterEvent.KIND)
+        term = [e for e in events if e.reason == REASON_GANG_TERMINATED]
+        assert term and term[0].type == "Warning"
+        assert term[0].involved_kind == PodCliqueSet.KIND
+
+    def test_event_dedup_bumps_count(self):
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 1.0,
+                                                     "memory": 1.0,
+                                                     "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=3.0)]))
+        h.settle()
+        # the unschedulable event exists once with count 1 (status-change
+        # gated); crash through more failed cycles via capacity flap
+        evts = [e for e in h.store.list(ClusterEvent.KIND)
+                if e.reason == REASON_PODGANG_UNSCHEDULABLE]
+        assert len(evts) == 1
+        assert evts[0].count >= 1
+
+
+class TestLogging:
+    def test_log_config_drives_output(self):
+        import io
+
+        from grove_tpu.api.config import load_operator_config
+        from grove_tpu.cluster import Cluster
+
+        buf = io.StringIO()
+        cluster = Cluster(
+            nodes=make_nodes(4),
+            config=load_operator_config(
+                {"log": {"level": "debug", "format": "json"}}
+            ),
+        )
+        cluster.logger.stream = buf
+        h = Harness(cluster=cluster)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        out = buf.getvalue()
+        assert '"logger": "grove.scheduler"' in out
+        assert '"msg": "backlog solved"' in out
+        assert '"placed": 1' in out
+        # info level filters the debug records out
+        buf2 = io.StringIO()
+        c2 = Cluster(nodes=make_nodes(4))  # default level: info
+        c2.logger.stream = buf2
+        h2 = Harness(cluster=c2)
+        h2.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h2.settle()
+        assert "backlog solved" not in buf2.getvalue()
+
+    def test_reconcile_errors_logged(self):
+        import io
+
+        from grove_tpu.cluster import Cluster
+
+        buf = io.StringIO()
+        cluster = Cluster(nodes=make_nodes(4))
+        cluster.logger.stream = buf
+        h = Harness(cluster=cluster)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        h.scheduler.reconcile = lambda req: (_ for _ in ()).throw(
+            OSError("tunnel down")
+        )
+        h.store.create(make_nodes(1, name_prefix="poke")[0])
+        h.settle()
+        assert "reconcile failed" in buf.getvalue()
+        assert "tunnel down" in buf.getvalue()
