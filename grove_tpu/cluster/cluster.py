@@ -104,7 +104,7 @@ class Cluster:
         accounting)."""
         out: dict[str, dict[str, float]] = {}
         terminal = (PodPhase.SUCCEEDED, PodPhase.FAILED)
-        for pod in self.store.list(Pod.KIND):
+        for pod in self.store.scan(Pod.KIND):  # read-only accounting scan
             if not pod.node_name or pod.status.phase in terminal:
                 continue
             if pod.metadata.deletion_timestamp is not None:
@@ -129,14 +129,14 @@ class Cluster:
 
     def topology_snapshot(self) -> TopologySnapshot:
         return encode_topology(
-            self.live_topology(), self.store.list(Node.KIND), usage=self.usage()
+            self.live_topology(), self.store.scan(Node.KIND), usage=self.usage()
         )
 
     def pod_demand_fn(self, resource_names: list[str]):
         """pod_demand callable for solver.problem.encode_podgangs."""
 
         def fn(namespace: str, name: str):
-            pod = self.store.get(Pod.KIND, namespace, name)
+            pod = self.store.peek(Pod.KIND, namespace, name)  # read-only
             if pod is None:
                 return None
             req = pod.spec.total_requests()

@@ -83,12 +83,17 @@ class SimKubelet:
         whole startsAfter chain would cascade to ready within one tick,
         which no real cluster does (informer propagation delay)."""
         changes = 0
+        # no-copy scans: decisions read live state; mutations re-fetch a
+        # real copy below (list()'s defensive copies of every pod per tick
+        # dominated settle wall-clock at control-plane scale)
         ready_at_tick_start = {
             (p.metadata.namespace, p.metadata.name)
-            for p in self.store.list(Pod.KIND)
+            for p in self.store.scan(Pod.KIND)
             if p.status.ready
         }
-        for pod in self.store.list(Pod.KIND):
+        to_run: list[tuple[str, str]] = []
+        to_ready: list[tuple[str, str]] = []
+        for pod in self.store.scan(Pod.KIND):
             if pod.metadata.uid in self._crashed:
                 continue  # stays NotReady until recover_pod
             if pod.status.phase == PodPhase.FAILED:
@@ -97,18 +102,24 @@ class SimKubelet:
                 continue
             if pod.metadata.deletion_timestamp is not None:
                 continue
+            key = (pod.metadata.namespace, pod.metadata.name)
             if pod.status.phase == PodPhase.PENDING:
-                pod.status.phase = PodPhase.RUNNING
-                pod.status.started_at = self.store.clock.now()
-                self.store.update_status(pod)
-                changes += 1
-                continue
-            if pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
+                to_run.append(key)
+            elif pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
                 if self._barrier_open(pod, ready_at_tick_start):
-                    pod.status.ready = True
-                    pod.status.ever_started = True
-                    self.store.update_status(pod)
-                    changes += 1
+                    to_ready.append(key)
+        for ns, name in to_run:
+            pod = self.store.get(Pod.KIND, ns, name)
+            pod.status.phase = PodPhase.RUNNING
+            pod.status.started_at = self.store.clock.now()
+            self.store.update_status(pod)
+            changes += 1
+        for ns, name in to_ready:
+            pod = self.store.get(Pod.KIND, ns, name)
+            pod.status.ready = True
+            pod.status.ever_started = True
+            self.store.update_status(pod)
+            changes += 1
         return changes
 
     def run_to_quiesce(self, max_ticks: int = 64) -> None:
@@ -123,7 +134,7 @@ class SimKubelet:
         for pclq_fqn, min_available in parse_wait_for(spec):
             ready = sum(
                 1
-                for p in self.store.list(
+                for p in self.store.scan(
                     Pod.KIND,
                     namespace=pod.metadata.namespace,
                     labels={constants.LABEL_PODCLIQUE: pclq_fqn},

@@ -66,7 +66,7 @@ class PodCliqueReconciler:
             if owner:
                 return [
                     Request(event.namespace, p.metadata.name)
-                    for p in self.store.list(
+                    for p in self.store.scan(  # names only: no-copy scan
                         KIND,
                         namespace=event.namespace,
                         labels={constants.LABEL_PART_OF: owner},
@@ -98,7 +98,10 @@ class PodCliqueReconciler:
         return Result()
 
     def _owned_pods(self, pclq: PodClique) -> list[Pod]:
-        return self.store.list(
+        """Read-only scan (live references): callers decide and then act
+        through the store API (create/delete/get-then-update) — they never
+        mutate these objects directly."""
+        return self.store.scan(
             Pod.KIND,
             namespace=pclq.metadata.namespace,
             labels={constants.LABEL_PODCLIQUE: pclq.metadata.name},
@@ -337,7 +340,7 @@ class PodCliqueReconciler:
             gang_name = pod.metadata.labels.get(constants.LABEL_PODGANG)
             if not gang_name:
                 continue
-            gang = self.store.get(PodGang.KIND, ns, gang_name)
+            gang = self.store.peek(PodGang.KIND, ns, gang_name)
             if gang is None:
                 continue
             refs = {
@@ -349,11 +352,12 @@ class PodCliqueReconciler:
                 continue  # not yet referenced -> keep gated (:261)
             base_name = pod.metadata.labels.get(constants.LABEL_BASE_PODGANG)
             if base_name:
-                base = self.store.get(PodGang.KIND, ns, base_name)
+                base = self.store.peek(PodGang.KIND, ns, base_name)
                 if base is None or not _is_scheduled(base):
                     continue  # scaled gang waits for base (:306-345)
-            pod.spec.scheduling_gates = []
-            self.store.update(pod)
+            fresh = self.store.get(Pod.KIND, ns, pod.metadata.name)
+            fresh.spec.scheduling_gates = []
+            self.store.update(fresh)
 
     # -- status flow (reconcilestatus.go) ----------------------------------
     def _reconcile_status(self, pclq: PodClique) -> None:

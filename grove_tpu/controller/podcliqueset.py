@@ -91,7 +91,7 @@ class PodCliqueSetReconciler:
             # constraints and refresh TopologyLevelsUnavailable.
             return [
                 Request(p.metadata.namespace, p.metadata.name)
-                for p in self.store.list(KIND)
+                for p in self.store.scan(KIND)
             ]
         return []
 
@@ -125,7 +125,7 @@ class PodCliqueSetReconciler:
             Role.KIND,
             ServiceAccount.KIND,
         ):
-            for child in self.store.list(kind, namespace=ns, labels=labels):
+            for child in self.store.scan(kind, namespace=ns, labels=labels):
                 if child.metadata.deletion_timestamp is None:
                     self.store.delete(kind, ns, child.metadata.name)
                 for fin in list(child.metadata.finalizers):
@@ -218,7 +218,7 @@ class PodCliqueSetReconciler:
             constants.LABEL_PART_OF: name,
             constants.LABEL_PCS_REPLICA_INDEX: str(replica),
         }
-        pclqs = self.store.list(PodClique.KIND, namespace=ns, labels=sel)
+        pclqs = self.store.scan(PodClique.KIND, namespace=ns, labels=sel)
         if not pclqs:
             return False
         for pclq in pclqs:
@@ -287,7 +287,7 @@ class PodCliqueSetReconciler:
                         ),
                     )
                 )
-        for svc in self.store.list(Service.KIND, namespace=ns, labels=labels):
+        for svc in self.store.scan(Service.KIND, namespace=ns, labels=labels):
             if svc.metadata.name not in expected:
                 self.store.delete(Service.KIND, ns, svc.metadata.name)
 
@@ -332,7 +332,7 @@ class PodCliqueSetReconciler:
                         metadata=new_meta(hpa_name, ns, pcs, labels), spec=spec
                     )
                 )
-        for hpa in self.store.list(
+        for hpa in self.store.scan(
             HorizontalPodAutoscaler.KIND, namespace=ns, labels=labels
         ):
             if hpa.metadata.name not in expected:
@@ -373,9 +373,10 @@ class PodCliqueSetReconciler:
             constants.LABEL_PART_OF: name,
             constants.LABEL_PCS_REPLICA_INDEX: str(replica),
         }
-        return self.store.list(
+        # read-only: callers only inspect conditions/availability
+        return self.store.scan(
             PodClique.KIND, namespace=ns, labels=sel
-        ) + self.store.list(PodCliqueScalingGroup.KIND, namespace=ns, labels=sel)
+        ) + self.store.scan(PodCliqueScalingGroup.KIND, namespace=ns, labels=sel)
 
     def _terminate_replica(self, pcs: PodCliqueSet, replica: int) -> None:
         """Delete every PodClique of the replica (PCSG-owned included) and
@@ -391,7 +392,7 @@ class PodCliqueSetReconciler:
             constants.LABEL_PART_OF: name,
             constants.LABEL_PCS_REPLICA_INDEX: str(replica),
         }
-        for pclq in self.store.list(PodClique.KIND, namespace=ns, labels=sel):
+        for pclq in self.store.scan(PodClique.KIND, namespace=ns, labels=sel):
             if pclq.metadata.deletion_timestamp is None:
                 self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
         for gang in self.store.list(PodGang.KIND, namespace=ns, labels=sel):
@@ -463,7 +464,7 @@ class PodCliqueSetReconciler:
                     spec=_copy_spec(spec),
                 )
             )
-        for pclq in self.store.list(PodClique.KIND, namespace=ns, labels=comp_labels):
+        for pclq in self.store.scan(PodClique.KIND, namespace=ns, labels=comp_labels):
             if pclq.metadata.name not in expected:
                 self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
 
@@ -497,7 +498,7 @@ class PodCliqueSetReconciler:
                         ),
                     )
                 )
-        for pcsg in self.store.list(
+        for pcsg in self.store.scan(
             PodCliqueScalingGroup.KIND, namespace=ns, labels=comp_labels
         ):
             if pcsg.metadata.name not in expected:
@@ -522,7 +523,7 @@ class PodCliqueSetReconciler:
             for group in spec.pod_groups:
                 pods = [
                     p
-                    for p in self.store.list(
+                    for p in self.store.scan(
                         Pod.KIND,
                         namespace=ns,
                         labels={
@@ -558,7 +559,7 @@ class PodCliqueSetReconciler:
             elif asdict(existing.spec) != asdict(spec):
                 existing.spec = spec
                 self.store.update(existing)
-        for gang in self.store.list(PodGang.KIND, namespace=ns, labels=comp_labels):
+        for gang in self.store.scan(PodGang.KIND, namespace=ns, labels=comp_labels):
             if gang.metadata.name not in expected:
                 self.store.delete(PodGang.KIND, ns, gang.metadata.name)
 

@@ -1,0 +1,112 @@
+"""Control-plane-at-scale tests (VERDICT r1 #4 / SURVEY §4 takeaway 3).
+
+CI-speed variant of bench.py's bench_controlplane: drive the FULL path —
+apply PCS -> gated pods -> deferred gangs -> scheduler -> bound/ready —
+at a scale where the r1 per-event full-table rescans were quadratic, and
+pin the store's label-index behavior those scans now rely on."""
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.podgang import PodGang, PodGangPhase
+from grove_tpu.api.types import (
+    Container,
+    Pod,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.cluster import Cluster, make_nodes
+from grove_tpu.controller import Harness
+
+
+def wide_pcs(name, replicas, pods_per_clique=4):
+    return PodCliqueSet(
+        metadata=ObjectMeta(name=name),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplateSpec(
+                cliques=[
+                    PodCliqueTemplateSpec(
+                        name="w",
+                        spec=PodCliqueSpec(
+                            replicas=pods_per_clique,
+                            pod_spec=PodSpec(
+                                containers=[
+                                    Container(name="m", resources={"cpu": 1.0})
+                                ]
+                            ),
+                        ),
+                    )
+                ]
+            ),
+        ),
+    )
+
+
+class TestControlPlaneScale:
+    def test_full_path_at_scale_settles_and_binds(self):
+        # 40 replicas x 4 pods on 300 nodes: every pod bound + ready, every
+        # gang Running, in one settle
+        h = Harness(nodes=make_nodes(300, allocatable={"cpu": 32.0,
+                                                       "memory": 128.0,
+                                                       "tpu": 8.0}))
+        h.apply(wide_pcs("scale", 40))
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert len(pods) == 160
+        assert all(p.node_name and p.status.ready for p in pods)
+        gangs = h.store.scan(PodGang.KIND)
+        assert len(gangs) == 40
+        assert all(g.status.phase == PodGangPhase.RUNNING for g in gangs)
+        m = h.cluster.metrics
+        assert m.counter("grove_scheduler_gangs_scheduled_total").total() == 40
+
+    def test_dirty_tracking_keeps_steady_state_cheap(self):
+        # at quiescence, a single pod readiness flip must NOT re-examine the
+        # whole world: reconcile count stays O(1)-ish, and the scheduler
+        # only re-derives phases for the flipped pod's gang
+        h = Harness(nodes=make_nodes(100))
+        h.apply(wide_pcs("steady", 20))
+        h.settle()
+        h.kubelet.crash_pod("default", "steady-5-w-0")
+        before = len(h.store._events)
+        h.settle()
+        churn = len(h.store._events) - before
+        # crash -> pclq breach condition + gang unhealthy + pcs status:
+        # a handful of writes, not hundreds (r1 rescanned everything)
+        assert churn < 15, f"steady-state churn too high: {churn} events"
+
+    def test_label_index_tracks_updates_and_deletes(self):
+        c = Cluster(nodes=make_nodes(2))
+        store = c.store
+
+        def mk(name, labels):
+            p = Pod(metadata=ObjectMeta(name=name, labels=labels),
+                    spec=PodSpec(containers=[Container(name="c")]))
+            return p
+
+        store.create(mk("a", {"grp": "x"}))
+        store.create(mk("b", {"grp": "x"}))
+        store.create(mk("c", {"grp": "y"}))
+        assert {p.metadata.name for p in store.scan(Pod.KIND,
+                                                    labels={"grp": "x"})} == {"a", "b"}
+        # label change on update re-indexes
+        b = store.get(Pod.KIND, "default", "b")
+        b.metadata.labels["grp"] = "y"
+        store.update(b)
+        assert {p.metadata.name for p in store.scan(Pod.KIND,
+                                                    labels={"grp": "y"})} == {"b", "c"}
+        assert [p.metadata.name for p in store.scan(Pod.KIND,
+                                                    labels={"grp": "x"})] == ["a"]
+        # delete drops index entries
+        store.delete(Pod.KIND, "default", "c")
+        assert {p.metadata.name for p in store.scan(Pod.KIND,
+                                                    labels={"grp": "y"})} == {"b"}
+        # unknown label value -> empty, not full scan
+        assert store.scan(Pod.KIND, labels={"grp": "zzz"}) == []
+        # list() uses the same index and still returns copies
+        got = store.list(Pod.KIND, labels={"grp": "y"})
+        got[0].metadata.labels["grp"] = "mutated"
+        assert store.peek(Pod.KIND, "default", "b").metadata.labels["grp"] == "y"
