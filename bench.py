@@ -243,7 +243,11 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
     h.settle()
     warm = time.perf_counter() - t0
     bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
-    assert bound == 2 * replicas * 8, f"controlplane bench: {bound} bound"
+    if bound != 2 * replicas * 8:  # not assert: must survive python -O
+        raise RuntimeError(
+            f"controlplane bench invalid: {bound} pods bound, "
+            f"expected {2 * replicas * 8}"
+        )
     return {
         "controlplane_replicas": replicas,
         "controlplane_settle_seconds": round(warm, 2),

@@ -57,7 +57,9 @@ class Cluster:
         if self.config.authorization.enabled:
             from ..api.authorization import make_authorizer
 
-            self.store.authorizer = make_authorizer(self.config.authorization)
+            self.store.authorizer = make_authorizer(
+                self.config.authorization, store=self.store
+            )
         # Topology sync at startup (clustertopology.go:41): ensure the
         # singleton ClusterTopology exists before any controller runs.
         # Precedence: explicit topology arg > config levels > inventory

@@ -70,10 +70,33 @@ class ControllerManager:
         self._queued: set[tuple[str, Request]] = set()
         self._requeues: list[tuple[float, int, str, Request]] = []
         self._tiebreak = itertools.count()
+        #: bounded per (controller, request): a permanently failing
+        #: reconciler retries forever on the error interval, and unbounded
+        #: growth here would leak across a long simulation
         self.errors: list[tuple[str, Request, str]] = []
+        self.max_errors_per_key = 5
+        self._errors_next_compact = 64
 
     def register(self, controller: Reconciler) -> None:
         self.controllers.append(controller)
+
+    def _record_error_entry(self, cname: str, req: Request, msg: str) -> None:
+        """Append to self.errors, keeping at most max_errors_per_key entries
+        per (controller, request) — newest win. Eviction runs as a periodic
+        O(n) compaction (amortized O(1) per append), so a permanently
+        failing reconciler can't grow the list without bound."""
+        self.errors.append((cname, req, msg))
+        if len(self.errors) >= self._errors_next_compact:
+            kept_counts: dict[tuple[str, Request], int] = {}
+            kept: list[tuple[str, Request, str]] = []
+            for entry in reversed(self.errors):
+                key = (entry[0], entry[1])
+                if kept_counts.get(key, 0) < self.max_errors_per_key:
+                    kept_counts[key] = kept_counts.get(key, 0) + 1
+                    kept.append(entry)
+            kept.reverse()
+            self.errors = kept
+            self._errors_next_compact = max(64, 2 * len(kept))
 
     # -- queue plumbing ----------------------------------------------------
     def _enqueue(self, controller_name: str, request: Request) -> None:
@@ -125,7 +148,7 @@ class ControllerManager:
                 from .errors import to_grove_error
 
                 err = to_grove_error(exc, f"{cname}:{req.namespace}/{req.name}")
-                self.errors.append((cname, req, str(err)))
+                self._record_error_entry(cname, req, str(err))
                 if self.logger is not None:
                     self.logger.error(
                         "reconcile failed", controller=cname,
@@ -141,7 +164,7 @@ class ControllerManager:
                         recorder(req, err)
                 result = Result(requeue_after=self.error_retry_seconds)
             if result.error:
-                self.errors.append((cname, req, result.error))
+                self._record_error_entry(cname, req, result.error)
             if self.logger is not None:
                 self.logger.debug(
                     "reconciled", controller=cname,

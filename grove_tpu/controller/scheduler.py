@@ -92,12 +92,16 @@ class GangScheduler:
 
     def reconcile(self, request: Request) -> Result:
         dirty, self._dirty = self._dirty, set()
+        starved_prev = self._starved
         try:
             return self._reconcile(dirty)
         except Exception:
-            # the manager retries on its error interval; the dirty set must
-            # survive the failed attempt or those gangs are skipped forever
+            # the manager retries on its error interval; the dirty AND
+            # starved sets must survive the failed attempt (_reconcile may
+            # have cleared _starved before raising) or those gangs are
+            # skipped forever
             self._dirty |= dirty
+            self._starved |= starved_prev
             raise
 
     def _reconcile(self, dirty: set[tuple[str, str]]) -> Result:
@@ -122,7 +126,7 @@ class GangScheduler:
         )
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
-            self._update_phases(dirty)
+            self._update_phases(examine)
             return Result()
 
         snapshot = self.cluster.topology_snapshot()
@@ -189,7 +193,10 @@ class GangScheduler:
         }
         if self._starved:
             requeue = self.retry_seconds
-        self._update_phases(dirty | set(backlog_keys))
+        # the full examine set: a previously-starved gang whose pods were
+        # just bound best-effort must get its phase/Ready refresh in THIS
+        # reconcile, not via follow-on pod events (advisor r2)
+        self._update_phases(examine | set(backlog_keys))
         return Result(requeue_after=requeue)
 
     def _update_phases(self, keys: set[tuple[str, str]]) -> None:

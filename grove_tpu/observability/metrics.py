@@ -10,7 +10,6 @@ by bench.py (the driver metric) and tests.
 
 from __future__ import annotations
 
-import bisect
 from dataclasses import dataclass, field
 
 
@@ -50,15 +49,19 @@ class Gauge:
 
 @dataclass
 class Histogram:
-    """Exact-percentile histogram: observations are kept sorted (cheap at
-    control-plane volumes) so p50/p99 are exact, not bucket-interpolated."""
+    """Exact-percentile histogram. observe() is O(1) append; the sort is
+    deferred to the first percentile read after new observations, so
+    per-gang latency observation stays cheap at 10^5-gang scale (reads are
+    rare — bench/render time — writes are the hot path)."""
 
     name: str
     help: str = ""
     _obs: list[float] = field(default_factory=list)
+    _dirty: bool = False
 
     def observe(self, value: float) -> None:
-        bisect.insort(self._obs, value)
+        self._obs.append(value)
+        self._dirty = True
 
     @property
     def count(self) -> int:
@@ -75,6 +78,9 @@ class Histogram:
         """q in [0, 100]; nearest-rank on the sorted observations."""
         if not self._obs:
             return 0.0
+        if self._dirty:
+            self._obs.sort()
+            self._dirty = False
         idx = min(len(self._obs) - 1, max(0, round(q / 100 * (len(self._obs) - 1))))
         return self._obs[int(idx)]
 

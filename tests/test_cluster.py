@@ -226,3 +226,29 @@ class TestLiveTopology:
         assert "t/zone" in snap.level_keys
         zl = snap.level_index("t/zone")
         assert snap.domains_at(zl) == 1  # all four nodes share zone z0
+
+
+class TestManagerErrorBound:
+    def test_permanently_failing_reconciler_bounded_errors(self):
+        """A reconciler that fails forever must not grow manager.errors
+        without bound (advisor r2); last-N-per-key survive compaction."""
+        from grove_tpu.cluster.store import ObjectStore
+        from grove_tpu.controller.runtime import ControllerManager, Request
+
+        class Broken:
+            name = "broken"
+
+            def map_event(self, event):
+                return []
+
+            def reconcile(self, request):
+                raise RuntimeError("boom")
+
+        mgr = ControllerManager(ObjectStore())
+        mgr.register(Broken())
+        for _ in range(500):
+            mgr._enqueue("broken", Request("default", "x"))
+            mgr.run_once()
+        # bounded: at most 2x the per-key allowance after compaction cycles
+        assert len(mgr.errors) <= 2 * mgr.max_errors_per_key + 64
+        assert all(c == "broken" for c, _, _ in mgr.errors)

@@ -284,3 +284,40 @@ class TestAuthorization:
         fresh = h.store.get(PodClique.KIND, "default", "simple1-0-w")
         fresh.spec.replicas = 5
         h.store.update(fresh)
+
+    def test_pod_delete_always_permitted(self):
+        """handler.go:121-135: Pod DELETE is exempt for any actor (drain/
+        eviction agents must not be blocked); Pod UPDATE stays protected."""
+        from grove_tpu.api.types import Pod
+        from grove_tpu.cluster.store import Forbidden
+
+        h = self.harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        pod = h.store.get(Pod.KIND, "default", "simple1-0-w-0")
+        pod.spec.priority_class_name = "tampered"
+        with pytest.raises(Forbidden, match="may not update"):
+            h.store.update(pod)
+        h.store.delete(Pod.KIND, "default", "simple1-0-w-0")  # allowed
+        h.settle()  # reconciler replaces the pod
+        assert h.store.get(Pod.KIND, "default", "simple1-0-w-0") is not None
+
+    def test_disable_protection_via_owning_pcs(self):
+        """Annotating the parent PodCliqueSet opts out the whole tree
+        (reference resolves the annotation from the owning PCS)."""
+        from grove_tpu.api import constants
+        from grove_tpu.api.types import PodClique, PodCliqueSet
+
+        h = self.harness()
+        pcs = simple_pcs(cliques=[clique("w", replicas=2)])
+        pcs.metadata.annotations[
+            constants.ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION
+        ] = "true"
+        h.apply(pcs)
+        h.settle()
+        # child carries no annotation of its own, yet the user may touch it
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert constants.ANNOTATION_DISABLE_MANAGED_RESOURCE_PROTECTION \
+            not in pclq.metadata.annotations
+        pclq.spec.replicas = 5
+        h.store.update(pclq)
