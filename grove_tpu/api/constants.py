@@ -82,3 +82,9 @@ COMPONENT_SYNC_RETRY_INTERVAL_SECONDS = 5.0
 
 # --- Validation budgets (validation/podcliqueset.go:37) ---
 MAX_COMBINED_NAME_LENGTH = 45
+# Pod names double as hostnames, so the WORST-CASE generated name
+# ('<pcs>-<i>-[<sg>-<j>-]<clique>-<k>' with real replica-digit widths) must
+# fit a DNS-1123 label. The reference only budgets the 45-char component sum
+# and reserves a fixed 8/10 chars for indices; counting the generated name
+# exactly closes the gap where huge replica counts overflow the reserve.
+MAX_GENERATED_NAME_LENGTH = 63

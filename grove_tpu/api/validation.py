@@ -39,6 +39,11 @@ def _is_dns_label(name: str) -> bool:
     return bool(name) and len(name) <= 63 and _DNS1123.match(name) is not None
 
 
+def _index_digits(count: int) -> int:
+    """Decimal width of the largest index generated for `count` replicas."""
+    return len(str(max(count - 1, 0)))
+
+
 def _pack_level(tc: TopologyConstraintSpec | None) -> int | None:
     """Narrowest meaningful level index of a constraint (required wins)."""
     if tc is None or tc.pack_constraint is None:
@@ -164,6 +169,22 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
                     f"{path}: combined name '<pcs>-<replica>-{clique.name}' exceeds "
                     f"{constants.MAX_COMBINED_NAME_LENGTH} chars"
                 )
+            # Exact worst-case generated hostname '<pcs>-<i>-<clique>-<k>'
+            # with real index widths (incl. HPA max) must fit a DNS label;
+            # the reference's fixed 8-char index reserve can under-count.
+            max_pods = clique.spec.replicas
+            if clique.spec.scale_config is not None:
+                max_pods = max(max_pods, clique.spec.scale_config.max_replicas)
+            worst = (
+                len(pcs.metadata.name) + 1 + _index_digits(pcs.spec.replicas)
+                + 1 + len(clique.name) + 1 + _index_digits(max_pods)
+            )
+            if worst > constants.MAX_GENERATED_NAME_LENGTH:
+                errs.append(
+                    f"{path}: worst-case generated pod name ({worst} chars) "
+                    f"exceeds {constants.MAX_GENERATED_NAME_LENGTH}; shorten "
+                    "names or reduce replica counts"
+                )
         if clique.spec.replicas < 1:
             errs.append(f"{path}.spec.replicas must be >= 1")
         ma = clique.spec.min_available
@@ -255,12 +276,28 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
                 errs.append(f"{path}: replicas must be within scaleConfig bounds")
         # PCSG pod names are '<pcs>-<i>-<sg>-<j>-<clique>-<k>'; the reference
         # budgets the three name components (validation/podcliqueset.go:548-562).
+        max_sg_replicas = sg.replicas or 1
+        if sg.scale_config is not None:
+            max_sg_replicas = max(max_sg_replicas, sg.scale_config.max_replicas)
         for cn in sg.clique_names:
             combined = len(pcs.metadata.name) + len(sg.name) + len(cn)
             if combined > constants.MAX_COMBINED_NAME_LENGTH:
                 errs.append(
                     f"{path}: combined name '<pcs>-<i>-{sg.name}-<j>-{cn}' exceeds "
                     f"{constants.MAX_COMBINED_NAME_LENGTH} chars"
+                )
+            member = by_name.get(cn)
+            worst = (
+                len(pcs.metadata.name) + 1 + _index_digits(pcs.spec.replicas)
+                + 1 + len(sg.name) + 1 + _index_digits(max_sg_replicas)
+                + 1 + len(cn) + 1
+                + _index_digits(member.spec.replicas if member else 1)
+            )
+            if worst > constants.MAX_GENERATED_NAME_LENGTH:
+                errs.append(
+                    f"{path}: worst-case generated pod name ({worst} chars) "
+                    f"exceeds {constants.MAX_GENERATED_NAME_LENGTH}; shorten "
+                    "names or reduce replica counts"
                 )
         # No per-clique HPA inside a PCSG (the PCSG is the scale unit).
         for cn in sg.clique_names:

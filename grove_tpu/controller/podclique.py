@@ -162,8 +162,9 @@ class PodCliqueReconciler:
         free_indices = [i for i in range(pclq.spec.replicas + len(active) + count)
                         if i not in used][:count]
         pcs = self._owner_pcs(pclq)
+        sg_num_pods = self._pcsg_template_num_pods(pclq, pcs)
         for idx in free_indices:
-            pod = self._build_pod(pclq, pcs, idx)
+            pod = self._build_pod(pclq, pcs, idx, sg_num_pods)
             self.store.create(pod)
         if free_indices:
             self.recorder.normal(
@@ -172,7 +173,28 @@ class PodCliqueReconciler:
                 f"created {len(free_indices)} pod(s) (scheduling gated)",
             )
 
-    def _build_pod(self, pclq: PodClique, pcs: PodCliqueSet | None, idx: int) -> Pod:
+    def _pcsg_template_num_pods(
+        self, pclq: PodClique, pcs: PodCliqueSet | None
+    ) -> int | None:
+        """Total pods in one PCSG replica template: sum of member-clique
+        replicas (pcsg/components/podclique/podclique.go:214-228). None when
+        the clique is not PCSG-owned. Constant per clique, so computed once
+        per create batch, not per pod."""
+        if pcs is None or not pclq.metadata.labels.get(constants.LABEL_PCSG):
+            return None
+        tmpl = self._template_name(pclq)
+        by_name = {c.name: c for c in pcs.spec.template.cliques}
+        for sg in pcs.spec.template.pod_clique_scaling_group_configs:
+            if tmpl in sg.clique_names:
+                return sum(
+                    by_name[cn].spec.replicas
+                    for cn in sg.clique_names
+                    if cn in by_name
+                )
+        return None
+
+    def _build_pod(self, pclq: PodClique, pcs: PodCliqueSet | None, idx: int,
+                   sg_num_pods: int | None = None) -> Pod:
         ns = pclq.metadata.namespace
         pod_name = naming.pod_name(pclq.metadata.name, idx)
         pcs_name = pclq.metadata.labels.get(constants.LABEL_PART_OF, "")
@@ -211,6 +233,10 @@ class PodCliqueReconciler:
             env[constants.ENV_PCSG_INDEX] = pclq.metadata.labels.get(
                 constants.LABEL_PCSG_REPLICA_INDEX, "0"
             )
+            # total pods in one PCSG replica template — lets a sharded
+            # workload size its world from env alone
+            if sg_num_pods is not None:
+                env[constants.ENV_PCSG_TEMPLATE_NUM_PODS] = str(sg_num_pods)
         for container in spec.containers:
             container.env.update(env)
         return Pod(
@@ -378,6 +404,7 @@ class PodCliqueReconciler:
         status.observed_generation = fresh.metadata.generation
         status.selector = f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
         status.current_pod_template_hash = stable_hash(fresh.spec.pod_spec)
+        self._track_rollout(fresh, status, pods)
         min_avail = fresh.spec.min_available or fresh.spec.replicas
         now = self.store.clock.now()
         scheduled_enough = status.scheduled_replicas >= min_avail
@@ -411,6 +438,60 @@ class PodCliqueReconciler:
         clear_status_errors(self.store, status, now)
         if asdict(status) != before:
             self.store.update_status(fresh)
+
+    def _track_rollout(self, pclq: PodClique, status, pods: list[Pod]) -> None:
+        """Per-clique rolling-update status parity (podclique.go:104-137):
+        updated_replicas counts pods on the current template; while outdated
+        pods exist, rolling_update_progress records which pods are done and
+        which one the pod-at-a-time rollout (_rolling_replace) targets next,
+        and flips completed once the last pod matches."""
+        from ..api.types import PodCliqueRollingUpdateProgress
+
+        current = status.current_pod_template_hash
+        updated = sorted(
+            p.metadata.name
+            for p in pods
+            if p.metadata.labels.get(constants.LABEL_POD_TEMPLATE_HASH) == current
+        )
+        status.updated_replicas = len(updated)
+        outdated = [
+            p
+            for p in pods
+            if p.metadata.labels.get(constants.LABEL_POD_TEMPLATE_HASH) != current
+        ]
+        if outdated:
+            prog = status.rolling_update_progress
+            if prog is None or prog.completed:
+                prog = status.rolling_update_progress = (
+                    PodCliqueRollingUpdateProgress()
+                )
+            # mirror _rolling_replace's actual decision: not-ready outdated
+            # pods are all replaced immediately (report the lowest index);
+            # a ready victim (highest index) only while EVERY pod is ready;
+            # otherwise the rollout is paused and no victim is in flight
+            not_ready = [p for p in outdated if not p.status.ready]
+            if not_ready:
+                victim = min(not_ready, key=_pod_index)
+            elif all(p.status.ready for p in pods):
+                victim = max(outdated, key=_pod_index)
+            else:
+                victim = None  # paused: waiting for a replacement to ready
+            prog.updated_pods = updated
+            prog.current_pod = victim.metadata.name if victim else None
+            prog.completed = False
+        else:
+            prog = status.rolling_update_progress
+            if prog is not None and not prog.completed:
+                prog.updated_pods = updated
+                prog.current_pod = None
+                # the last victim's replacement must exist (and be current)
+                # before the rollout counts as complete — mid-replacement the
+                # clique is below its replica complement
+                prog.completed = len(updated) >= pclq.spec.replicas
+
+
+def _pod_index(p: Pod) -> int:
+    return int(p.metadata.labels.get(constants.LABEL_POD_INDEX, 0))
 
 
 def _is_scheduled(gang: PodGang) -> bool:

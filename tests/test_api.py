@@ -333,3 +333,40 @@ class TestReviewFixes:
         pcs2 = make_pcs(name="a" * 20, cliques=[clique("b" * 26)])
         with pytest.raises(api.ValidationError, match="exceeds"):
             admit(pcs2)
+
+    def test_exact_generated_name_budget_counts_index_digits(self):
+        # Boundary: combined components = 45 (passes the reference formula)
+        # but replica-digit widths push the worst-case generated hostname
+        # '<pcs>-<i>-<clique>-<k>' past a 63-char DNS label -> rejected.
+        c = clique("b" * 25)
+        c.spec.replicas = 2
+        c.spec.scale_config = api.AutoScalingConfig(
+            min_replicas=1, max_replicas=10**12, target_utilization=0.5
+        )
+        pcs = make_pcs(name="a" * 20, cliques=[c], replicas=10**4)
+        # 20 + 1 + 4 + 1 + 25 + 1 + 12 = 64 > 63
+        with pytest.raises(api.ValidationError, match="worst-case generated"):
+            admit(pcs)
+        # Same shapes with modest scale bounds fit: accepted
+        c.spec.scale_config.max_replicas = 100
+        pcs_ok = make_pcs(name="a" * 20, cliques=[c], replicas=10**4)
+        admit(pcs_ok)  # 20+1+4+1+25+1+2 = 54 <= 63
+
+    def test_exact_generated_name_budget_pcsg(self):
+        # PCSG hostnames carry two extra components; huge HPA bounds on the
+        # group overflow the DNS label even when the 45 budget holds.
+        member = clique("c" * 15)
+        member.spec.replicas = 4
+        sg = api.PodCliqueScalingGroupConfig(
+            name="s" * 10, clique_names=[member.name], replicas=2,
+            min_available=1,
+            scale_config=api.AutoScalingConfig(
+                min_replicas=1, max_replicas=10**12, target_utilization=0.5
+            ),
+        )
+        pcs = make_pcs(name="a" * 20, cliques=[member], sgs=[sg])
+        # 20+1+1+1+10+1+12+1+15+1+1 = 64 > 63
+        with pytest.raises(api.ValidationError, match="worst-case generated"):
+            admit(pcs)
+        sg.scale_config.max_replicas = 100
+        admit(make_pcs(name="a" * 20, cliques=[member], sgs=[sg]))

@@ -160,6 +160,21 @@ class TestScalingGroupEndToEnd:
         }
         assert (scaled.metadata.labels[constants.LABEL_BASE_PODGANG] == "dis-0")
 
+    def test_pcsg_env_wiring(self, harness):
+        """PCSG-owned pods carry the group env trio, incl. the template pod
+        count (pcsg/components/podclique/podclique.go:214-228,303-330)."""
+        harness.apply(self.pcs())
+        harness.settle()
+        pod = harness.store.get(Pod.KIND, "default", "dis-0-workers-1-prefill-0")
+        env = pod.spec.containers[0].env
+        assert env[constants.ENV_PCSG_NAME] == "dis-0-workers"
+        assert env[constants.ENV_PCSG_INDEX] == "1"
+        # prefill(2) + decode(2) pods per PCSG replica template
+        assert env[constants.ENV_PCSG_TEMPLATE_NUM_PODS] == "4"
+        # standalone pods carry no PCSG env
+        router = harness.store.get(Pod.KIND, "default", "dis-0-router-0")
+        assert constants.ENV_PCSG_NAME not in router.spec.containers[0].env
+
     def test_all_pods_bound_and_pcsg_status(self, harness):
         harness.apply(self.pcs())
         harness.settle()

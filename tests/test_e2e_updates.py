@@ -96,6 +96,39 @@ class TestRU_RollingUpdates:
         pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
         assert pcs.status.rolling_update_progress.completed
 
+    def test_ru1b_pclq_rollout_status_parity(self):
+        """PodCliqueStatus.updated_replicas + rolling_update_progress are
+        written by the pod-at-a-time rollout (podclique.go:104-137): the
+        progress appears mid-flight with a current_pod, then completes."""
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        h.settle()
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert pclq.status.updated_replicas == 3  # fresh pods match template
+        bump_image(h)
+        saw_inflight = False
+        for _ in range(64):
+            progressed = h.manager.run_once()
+            h.kubelet.tick()
+            pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+            prog = pclq.status.rolling_update_progress
+            if prog is not None and not prog.completed:
+                saw_inflight = True
+                # current_pod is set while a victim awaits replacement; None
+                # only in the gap where the replacement pod is being created
+                assert pclq.status.updated_replicas == len(prog.updated_pods)
+            if progressed == 0:
+                pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+                p = pcs.status.rolling_update_progress
+                if p is not None and p.completed:
+                    break
+        assert saw_inflight, "rollout progress never surfaced mid-flight"
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert pclq.status.updated_replicas == 3
+        prog = pclq.status.rolling_update_progress
+        assert prog is not None and prog.completed and prog.current_pod is None
+        assert len(prog.updated_pods) == 3
+
     def test_ru4_pcsg_rolls_replica_at_a_time(self):
         h = Harness(nodes=make_nodes(16))
         h.apply(simple_pcs(
