@@ -430,5 +430,11 @@ class Node:
     # allocatable resource name -> capacity
     allocatable: dict[str, float] = field(default_factory=dict)
     unschedulable: bool = False  # cordon (E2E fault model of the reference)
+    # Taint keys (NoSchedule semantics): a pod may only land here if every
+    # key appears in its PodSpec.tolerations. The reference embeds full
+    # corev1.PodSpec whose taints/tolerations the delegated scheduler
+    # honors (operator/api/core/v1alpha1/podclique.go:60-63); grove_tpu owns
+    # the scheduler, so the solve paths enforce them directly.
+    taints: list[str] = field(default_factory=list)
 
     KIND = "Node"

@@ -224,8 +224,10 @@ def _device_score(
     dom_level,       # i32 [D]
     anc_ids,         # i32 [D, L+1] ancestor chains (padded with D)
     total_demand,    # f32 [G, R]
-    u_max_pod,       # f32 [U, R] UNIQUE max-pod demand rows
-    max_pod_inverse, # i32 [G] gang -> unique row
+    u_sig_demand,    # f32 [U, R] UNIQUE signature max-pod demand rows
+    u_sig_mask,      # i32 [U] eligibility-mask row per signature
+    elig_masks,      # f32 [M, N] node-eligibility masks (row 0 = all ones)
+    sig_idx,         # i32 [G, S] gang -> its signature rows (dummy-padded)
     required_level,  # i32 [G]
     preferred_level, # i32 [G]
     valid,           # bool [G]
@@ -237,14 +239,17 @@ def _device_score(
 ):
     m = membership_matrix(gdom, num_domains)
     dom_free = m.T @ free                                   # [D, R]
-    # Node-granularity proxy: #nodes able to host the gang's largest pod.
+    # Node-granularity proxy: per signature (= unique max-pod demand ×
+    # node-eligibility mask pair), #nodes per domain that fit AND are
+    # eligible; a gang's count is the MIN over its signatures, so a domain
+    # is only scored when every selector class has somewhere to land.
     # Gangs come from few pod templates, so the [G, N] fit matrix collapses
     # to its U unique rows (U << G) before the MXU product — the dominant
     # FLOP term of the whole device phase scales with U, not G.
     node_fits = jnp.all(
-        free[None, :, :] + 1e-6 >= u_max_pod[:, None, :], axis=-1
-    ).astype(jnp.float32)                                   # [U, N]
-    cnt_fit = (node_fits @ m)[max_pod_inverse]              # [G, D]
+        free[None, :, :] + 1e-6 >= u_sig_demand[:, None, :], axis=-1
+    ).astype(jnp.float32) * elig_masks[u_sig_mask]          # [U, N]
+    cnt_fit = (node_fits @ m)[sig_idx].min(axis=1)          # [G, D]
     value = value_from_aggregates(
         dom_free, cnt_fit, dom_level, total_demand, required_level,
         preferred_level, valid, cap_scale,

@@ -88,15 +88,21 @@ def _best_fit_decreasing(
     free: np.ndarray,
     cap_scale: np.ndarray,
     assign: np.ndarray,
+    pod_elig: Optional[list] = None,
 ) -> bool:
     """Place pods (largest-first) on the tightest node that fits; mutates
     free and assign in place. Returns False (partial mutation possible —
-    callers restore the affected rows) when any pod doesn't fit."""
+    callers restore the affected rows) when any pod doesn't fit.
+
+    pod_elig: SolverGang.pod_elig — per-pod bool [N] node-eligibility
+    masks (node_selector/tolerations); None entries are unconstrained."""
     if len(pod_idx) == 0:
         return True
     order = np.argsort(-_dominant_share(demand[pod_idx], cap_scale), kind="stable")
     for p in pod_idx[order]:
         fits = np.all(free[node_idx] + _EPS >= demand[p], axis=1)
+        if pod_elig is not None and pod_elig[p] is not None:
+            fits &= pod_elig[p][node_idx]
         if not fits.any():
             return False
         cand = node_idx[fits]
@@ -175,7 +181,8 @@ def _place_unit(
                             assign, domain_level):
             return False
     return _best_fit_decreasing(
-        unit.pods, gang.demand, node_idx, free, cap_scale, assign
+        unit.pods, gang.demand, node_idx, free, cap_scale, assign,
+        gang.pod_elig,
     )
 
 

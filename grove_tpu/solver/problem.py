@@ -48,6 +48,12 @@ class SolverGang:
     # pack level is UNRESOLVED_LEVEL); both solve paths report it unplaced
     # with this reason instead of scheduling it unconstrained.
     unschedulable_reason: Optional[str] = None
+    # Per-pod node-eligibility masks (node_selector + taint tolerations):
+    # None = every pod unconstrained; else len-P list whose entries are
+    # shared read-only bool [N] arrays from TopologySnapshot.eligibility
+    # (or None for an individually unconstrained pod). Hard filter —
+    # enforced exactly by fit.py and priced into the device score.
+    pod_elig: Optional[list] = None
 
     @property
     def num_pods(self) -> int:
@@ -93,6 +99,7 @@ def encode_podgangs(
     snapshot: TopologySnapshot,
     pod_demand: Callable[[str, str], Optional[np.ndarray]],
     priority_of: Callable[[PodGang], float] = lambda pg: 0.0,
+    pod_scheduling: Optional[Callable[[str, str], Optional[tuple]]] = None,
 ) -> list[SolverGang]:
     """Flatten PodGang CRs into SolverGangs.
 
@@ -102,14 +109,23 @@ def encode_podgangs(
     all member pods exist, reference podgang/syncflow.go:435-502, so a
     missing pod means a stale gang).
 
+    pod_scheduling(namespace, name) -> (node_selector dict, tolerations
+    list) supplies the pod's hard node filters; when absent all pods are
+    unconstrained. A pod needs a mask when it carries a selector OR the
+    cluster carries any taint (untolerated taints repel selector-less pods
+    too).
+
     Only the first min_replicas pod references of each PodGroup are encoded:
     those form the all-or-nothing gang; pods beyond the threshold are
     scheduled best-effort by later solve rounds once the gang is placed.
     """
+    has_taints = snapshot.has_taints
     gangs: list[SolverGang] = []
     for pg in podgangs:
         demands: list[np.ndarray] = []
         pod_names: list[str] = []
+        pod_elig: list = []
+        any_elig = False
         group_ids: list[int] = []
         group_names: list[str] = []
         group_req: list[int] = []
@@ -140,6 +156,15 @@ def encode_podgangs(
                 demands.append(np.asarray(d, dtype=np.float32))
                 pod_names.append(ref.name)
                 group_ids.append(gi)
+                mask = None
+                if pod_scheduling is not None:
+                    sched = pod_scheduling(ref.namespace, ref.name)
+                    if sched is not None:
+                        selector, tolerations = sched
+                        if selector or has_taints:
+                            mask = snapshot.eligibility(selector, tolerations)
+                            any_elig = True
+                pod_elig.append(mask)
             if stale:
                 break
         if stale or not demands:
@@ -173,6 +198,7 @@ def encode_podgangs(
                 priority=priority_of(pg),
                 constraint_groups=cgroups,
                 unschedulable_reason=reason,
+                pod_elig=pod_elig if any_elig else None,
             )
         )
     return gangs

@@ -73,11 +73,53 @@ class TopologySnapshot:
     capacity: np.ndarray                  # float32 [N, R] allocatable
     free: np.ndarray                      # float32 [N, R] allocatable - used
     schedulable: np.ndarray               # bool [N]
+    node_labels: list[dict] = field(default_factory=list, repr=False)
+    node_taints: list[list] = field(default_factory=list, repr=False)
     _memberships: dict[int, np.ndarray] = field(default_factory=dict, repr=False)
+    _elig_cache: dict = field(default_factory=dict, repr=False)
 
     @property
     def num_levels(self) -> int:
         return int(self.domain_ids.shape[0])
+
+    @property
+    def has_taints(self) -> bool:
+        """True when any node carries a taint — then even selector-less pods
+        are constrained (they must avoid tainted nodes they don't tolerate)."""
+        return any(self.node_taints)
+
+    def eligibility(
+        self, node_selector: dict[str, str], tolerations: list[str]
+    ) -> np.ndarray:
+        """bool [N] mask: node i is eligible iff its labels satisfy every
+        node_selector entry and every taint key on it is tolerated.
+
+        The reference embeds full corev1.PodSpec whose selectors/taints the
+        delegated scheduler honors (operator/api/core/v1alpha1/podclique.go:
+        60-63); grove_tpu owns the scheduler, so this mask is the hard
+        filter both solve paths enforce. Masks are cached per (selector,
+        tolerations) signature — pods come from few templates, so the cache
+        stays tiny and shared references keep per-gang memory O(1).
+        """
+        key = (
+            tuple(sorted(node_selector.items())),
+            tuple(sorted(set(tolerations))),
+        )
+        mask = self._elig_cache.get(key)
+        if mask is None:
+            tol = set(tolerations)
+            mask = np.ones(self.num_nodes, dtype=bool)
+            sel = node_selector.items()
+            for i in range(self.num_nodes):
+                labels = self.node_labels[i] if i < len(self.node_labels) else {}
+                taints = self.node_taints[i] if i < len(self.node_taints) else ()
+                if any(labels.get(k) != v for k, v in sel) or any(
+                    t not in tol for t in taints
+                ):
+                    mask[i] = False
+            mask.setflags(write=False)  # shared across gangs
+            self._elig_cache[key] = mask
+        return mask
 
     @property
     def num_nodes(self) -> int:
@@ -194,4 +236,6 @@ def encode_topology(
         capacity=capacity,
         free=free,
         schedulable=schedulable,
+        node_labels=[node.metadata.labels for node in nodes],
+        node_taints=[list(node.taints) for node in nodes],
     )
