@@ -148,6 +148,21 @@ class Cluster:
 
         return fn
 
+    def pod_scheduling_fn(self):
+        """pod_scheduling callable for encode_podgangs: the pod's hard node
+        filters (node_selector, tolerations). The reference embeds full
+        corev1.PodSpec whose selectors/taints the delegated scheduler honors
+        (operator/api/core/v1alpha1/podclique.go:60-63); grove_tpu owns the
+        scheduler, so these flow into the solve paths as eligibility masks."""
+
+        def fn(namespace: str, name: str):
+            pod = self.store.peek(Pod.KIND, namespace, name)  # read-only
+            if pod is None:
+                return None
+            return pod.spec.node_selector, pod.spec.tolerations
+
+        return fn
+
 
 def _infer_levels(nodes: list[Node]):
     """Derive topology levels from the label keys the inventory carries."""

@@ -38,7 +38,11 @@ from ..observability.events import (
     REASON_PODGANG_UNSCHEDULABLE,
 )
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
-from ..solver.problem import UNRESOLVED_LEVEL, _resolve_level
+from ..solver.problem import (
+    UNRESOLVED_LEVEL,
+    _resolve_level,
+    pod_eligibility_mask,
+)
 from .runtime import Request, Result
 
 _SINGLETON_REQ = Request("", "schedule")
@@ -133,6 +137,7 @@ class GangScheduler:
         engine = self.engine_cls(snapshot, **self._engine_kwargs)
         free = snapshot.free.copy()
         demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
+        sched_fn = self.cluster.pod_scheduling_fn()
 
         requeue: Optional[float] = None
         if backlog_keys:
@@ -142,7 +147,8 @@ class GangScheduler:
                 for ns, name in backlog_keys
             ]
             solver_gangs = encode_podgangs(
-                backlog, snapshot, demand_fn, priority_of=self._priority_of
+                backlog, snapshot, demand_fn, priority_of=self._priority_of,
+                pod_scheduling=sched_fn,
             )
             result = engine.solve(solver_gangs, free=free)
             self.log.debug(
@@ -182,7 +188,9 @@ class GangScheduler:
                     )
                 requeue = self.retry_seconds
 
-        self._bind_best_effort(dirty_scheduled, snapshot, free, demand_fn, engine)
+        self._bind_best_effort(
+            dirty_scheduled, snapshot, free, demand_fn, sched_fn, engine
+        )
         # Gangs STILL carrying unbound referenced pods wait for capacity:
         # keep them under examination and retry on the timer (freed capacity
         # may arrive via deletions/node adds that never touch their pods).
@@ -281,11 +289,14 @@ class GangScheduler:
             f"(score {placement.placement_score:.3f})",
         )
 
-    def _bind_best_effort(self, scheduled_gangs, snapshot, free, demand_fn, engine):
+    def _bind_best_effort(
+        self, scheduled_gangs, snapshot, free, demand_fn, sched_fn, engine
+    ):
         """Pods referenced beyond MinReplicas (or replacements for evicted
         min-pods) of already-scheduled gangs bind as singletons against the
         residual free capacity."""
         singles: list[SolverGang] = []
+        has_taints = snapshot.has_taints
         for gang in scheduled_gangs:
             for group in gang.spec.pod_groups:
                 for ref in group.pod_references:
@@ -303,6 +314,9 @@ class GangScheduler:
                     req, pref = _resolve_level(group.topology_constraint, snapshot)
                     if req == UNRESOLVED_LEVEL:
                         continue  # hard level missing: hold the pod, don't weaken
+                    mask = pod_eligibility_mask(
+                        snapshot, sched_fn(ref.namespace, ref.name), has_taints
+                    )
                     singles.append(
                         SolverGang(
                             name=f"single/{ref.name}",
@@ -315,6 +329,7 @@ class GangScheduler:
                             group_preferred_level=np.array([-1], np.int32),
                             required_level=req,
                             preferred_level=pref,
+                            pod_elig=None if mask is None else [mask],
                         )
                     )
         if not singles:

@@ -27,9 +27,14 @@ def solve_serial_native(
     gangs: list[SolverGang],
     free: np.ndarray | None = None,
 ) -> SolveResult | None:
-    """Returns None when the native library is unavailable."""
+    """Returns None when the native library is unavailable or any gang is
+    outside the C++ subset (constraint groups, group preferences, per-pod
+    eligibility masks) — callers then fall back to the Python serial path,
+    the semantic reference."""
     lib = load_library()
     if lib is None:
+        return None
+    if any(not gang_native_compatible(g) for g in gangs):
         return None
     t0 = time.perf_counter()
     order = sorted(gangs, key=gang_sort_key)
@@ -181,5 +186,11 @@ def repair_native(
 
 
 def gang_native_compatible(gang: SolverGang) -> bool:
-    """The C++ paths implement required group constraints only."""
-    return not gang.constraint_groups and (gang.group_preferred_level < 0).all()
+    """The C++ paths implement required group constraints only, and know
+    nothing of per-pod node-eligibility masks (node_selector/tolerations) —
+    such gangs take the Python repair path, the semantic reference."""
+    return (
+        not gang.constraint_groups
+        and (gang.group_preferred_level < 0).all()
+        and gang.pod_elig is None
+    )

@@ -75,8 +75,10 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             P(),                 # dom_level   [D]
             P(),                 # anc_ids     [D, L+1]
             P("gangs", None),    # total_demand[G, R]
-            P(),                 # u_max_pod   [U, R] (unique rows, replicated)
-            P("gangs"),          # max_pod_inverse [G]
+            P(),                 # u_sig_demand [U, R] (unique rows, replicated)
+            P(),                 # u_sig_mask  [U]
+            P(None, "nodes"),    # elig_masks  [M, N]
+            P("gangs", None),    # sig_idx     [G, S]
             P("gangs"),          # required_level [G]
             P("gangs"),          # preferred_level[G]
             P("gangs"),          # valid       [G]
@@ -89,16 +91,17 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
         # asserted instead by test_sharded_matches_single_device.
         check_vma=False,
     )
-    def fn(free, gdom, dom_level, anc_ids, total_demand, u_max_pod,
-           max_pod_inverse, required_level, preferred_level, valid, cap_scale):
+    def fn(free, gdom, dom_level, anc_ids, total_demand, u_sig_demand,
+           u_sig_mask, elig_masks, sig_idx, required_level, preferred_level,
+           valid, cap_scale):
         m = membership_matrix(gdom, num_domains)             # [Nl, D]
         dom_free = jax.lax.psum(m.T @ free, "nodes")         # [D, R]
         node_fits = jnp.all(
-            free[None, :, :] + 1e-6 >= u_max_pod[:, None, :], axis=-1
-        ).astype(jnp.float32)                                # [U, Nl]
+            free[None, :, :] + 1e-6 >= u_sig_demand[:, None, :], axis=-1
+        ).astype(jnp.float32) * elig_masks[u_sig_mask]       # [U, Nl]
         cnt_fit = jax.lax.psum(node_fits @ m, "nodes")[
-            max_pod_inverse
-        ]                                                    # [Gl, D]
+            sig_idx
+        ].min(axis=1)                                        # [Gl, D]
         value_l = value_from_aggregates(
             dom_free, cnt_fit, dom_level, total_demand, required_level,
             preferred_level, valid, cap_scale,
@@ -154,7 +157,7 @@ class ShardedPlacementEngine(PlacementEngine):
             gdom, ((0, 0), (0, pad)), constant_values=self.space.num_domains
         )
 
-    def _device_phase(self, dev_free, total_demand, max_pod, required_level,
+    def _device_phase(self, dev_free, total_demand, sig, required_level,
                       preferred_level, valid, cap_scale):
         nodes_axis = self.mesh.shape["nodes"]
         gangs_axis = self.mesh.shape["gangs"]
@@ -164,7 +167,7 @@ class ShardedPlacementEngine(PlacementEngine):
             return self._pad_nodes(a, 0, gangs_axis)
 
         g = total_demand.shape[0]
-        u_max_pod, inverse = self._unique_max_pods(max_pod)
+        u_sig_demand, u_sig_mask, elig_masks, sig_idx = sig
         # Hand numpy arrays straight to the jitted shard_map fn: jit places
         # them per in_specs onto the MESH's devices. An eager jnp.asarray
         # here would commit them to the default backend instead — under the
@@ -175,8 +178,13 @@ class ShardedPlacementEngine(PlacementEngine):
             self.space.dom_level,
             self.space.anc_ids,
             pad_g(total_demand),
-            u_max_pod,
-            pad_g(inverse),
+            u_sig_demand,
+            u_sig_mask,
+            # dummy node columns get mask 0 (ineligible); they carry zero
+            # free capacity anyway, but a zero-demand signature row would
+            # otherwise count them as fitting
+            self._pad_nodes(elig_masks, 1, nodes_axis),
+            pad_g(sig_idx),
             pad_g(required_level),
             pad_g(preferred_level),
             pad_g(valid),

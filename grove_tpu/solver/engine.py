@@ -313,16 +313,15 @@ class PlacementEngine:
         g_pad = _bucket(len(order), minimum=self.bucket_min)
         r = len(snapshot.resource_names)
         total_demand = np.zeros((g_pad, r), dtype=np.float32)
-        max_pod = np.zeros((g_pad, r), dtype=np.float32)
         required_level = np.full((g_pad,), -1, dtype=np.int32)
         preferred_level = np.full((g_pad,), -1, dtype=np.int32)
         valid = np.zeros((g_pad,), dtype=bool)
         for i, g in enumerate(order):
             total_demand[i] = g.total_demand()
-            max_pod[i] = g.max_pod_demand()
             required_level[i] = g.required_level
             preferred_level[i] = g.preferred_level
             valid[i] = True
+        sig = self._gang_signatures(order, g_pad, snapshot.num_nodes, r)
 
         dev_free = np.where(
             snapshot.schedulable[:, None], free, 0.0
@@ -333,7 +332,7 @@ class PlacementEngine:
         result.stats["encode_seconds"] = time.perf_counter() - t0
         t_dev = time.perf_counter()
         top_val, top_dom = self._device_phase(
-            dev_free, total_demand, max_pod, required_level,
+            dev_free, total_demand, sig, required_level,
             preferred_level, valid, cap_scale,
         )
         result.stats["device_seconds"] = time.perf_counter() - t_dev
@@ -418,28 +417,89 @@ class PlacementEngine:
         return placed_map, fallbacks
 
     @staticmethod
-    def _unique_max_pods(max_pod: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        """Collapse per-gang max-pod rows to unique rows + inverse, padded
-        to a small power-of-two bucket to keep jit cache keys stable."""
-        u, inverse = np.unique(max_pod, axis=0, return_inverse=True)
-        u_pad = _bucket(u.shape[0], minimum=4)
-        if u.shape[0] < u_pad:
-            u = np.vstack([u, np.zeros((u_pad - u.shape[0], u.shape[1]), u.dtype)])
-        return u.astype(np.float32), inverse.astype(np.int32)
+    def _gang_signatures(
+        order: list[SolverGang], g_pad: int, num_nodes: int, num_res: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Collapse gangs to their eligibility SIGNATURES for the device fit
+        proxy. A signature is a (max-pod demand row, node-eligibility mask)
+        pair: pods of one gang are grouped by their eligibility mask
+        (pod_elig entries; None = unconstrained), each group contributing
+        the elementwise max demand of its pods. Signatures are deduped
+        GLOBALLY (gangs come from few pod templates, so U stays small) and
+        every array is padded to a power-of-two bucket so jit caches a few
+        shapes, not many.
 
-    def _device_phase(self, dev_free, total_demand, max_pod, required_level,
+        Returns (u_sig_demand [U, R], u_sig_mask [U] -> mask row,
+        elig_masks [M, N] float32 with row 0 all-ones, sig_idx [G, S] each
+        gang's signature rows, padded by repeating its first signature so
+        the device-side min over S is unaffected).
+        """
+        mask_rows: list[np.ndarray] = [np.ones(num_nodes, np.float32)]
+        mask_row_of: dict[int, int] = {}   # id(shared mask) -> row
+        sig_of: dict[tuple, int] = {}      # (demand bytes, mask row) -> sig
+        sig_demand: list[np.ndarray] = []
+        sig_mask: list[int] = []
+        gang_sigs: list[list[int]] = []
+        for g in order:
+            by_mask: dict[int, np.ndarray] = {}
+            if g.pod_elig is None:
+                by_mask[0] = g.max_pod_demand()
+            else:
+                for p in range(g.num_pods):
+                    m = g.pod_elig[p]
+                    if m is None:
+                        row = 0
+                    else:
+                        row = mask_row_of.get(id(m))
+                        if row is None:
+                            row = len(mask_rows)
+                            mask_row_of[id(m)] = row
+                            mask_rows.append(m.astype(np.float32))
+                    d = g.demand[p]
+                    cur = by_mask.get(row)
+                    by_mask[row] = d if cur is None else np.maximum(cur, d)
+            sigs = []
+            for row, dem in by_mask.items():
+                dem = np.ascontiguousarray(dem, dtype=np.float32)
+                key = (dem.tobytes(), row)
+                sid = sig_of.get(key)
+                if sid is None:
+                    sid = len(sig_demand)
+                    sig_of[key] = sid
+                    sig_demand.append(dem)
+                    sig_mask.append(row)
+                sigs.append(sid)
+            gang_sigs.append(sigs)
+        s_pad = _bucket(max(len(s) for s in gang_sigs), minimum=1)
+        sig_idx = np.zeros((g_pad, s_pad), np.int32)
+        for i, sigs in enumerate(gang_sigs):
+            sig_idx[i] = sigs + [sigs[0]] * (s_pad - len(sigs))
+        u_pad = _bucket(len(sig_demand), minimum=4)
+        u_sig_demand = np.zeros((u_pad, num_res), np.float32)
+        u_sig_demand[: len(sig_demand)] = np.stack(sig_demand)
+        u_sig_mask = np.zeros((u_pad,), np.int32)
+        u_sig_mask[: len(sig_mask)] = sig_mask
+        m_pad = _bucket(len(mask_rows), minimum=1)
+        elig_masks = np.zeros((m_pad, num_nodes), np.float32)
+        elig_masks[: len(mask_rows)] = np.stack(mask_rows)
+        return u_sig_demand, u_sig_mask, elig_masks, sig_idx
+
+    def _device_phase(self, dev_free, total_demand, sig, required_level,
                       preferred_level, valid, cap_scale):
         """Single-device scoring; ShardedPlacementEngine overrides this with
-        the mesh-SPMD version (grove_tpu/parallel/sharded.py)."""
-        u_max_pod, inverse = self._unique_max_pods(max_pod)
+        the mesh-SPMD version (grove_tpu/parallel/sharded.py). `sig` is the
+        _gang_signatures tuple."""
+        u_sig_demand, u_sig_mask, elig_masks, sig_idx = sig
         packed = _device_score(
             jnp.asarray(dev_free),
             jnp.asarray(self.space.gdom),
             jnp.asarray(self.space.dom_level),
             jnp.asarray(self.space.anc_ids),
             jnp.asarray(total_demand),
-            jnp.asarray(u_max_pod),
-            jnp.asarray(inverse),
+            jnp.asarray(u_sig_demand),
+            jnp.asarray(u_sig_mask),
+            jnp.asarray(elig_masks),
+            jnp.asarray(sig_idx),
             jnp.asarray(required_level),
             jnp.asarray(preferred_level),
             jnp.asarray(valid),

@@ -94,6 +94,31 @@ def _resolve_level(
     return req, pref
 
 
+def pod_eligibility_mask(
+    snapshot: TopologySnapshot,
+    scheduling: Optional[tuple],
+    has_taints: bool,
+) -> Optional[np.ndarray]:
+    """(node_selector, tolerations) -> shared eligibility mask, or None when
+    the pod is effectively unconstrained: no selector and no cluster taints,
+    or a computed mask that excludes nothing (e.g. every taint tolerated).
+    Returning None for all-True masks keeps unconstrained backlogs on the
+    fast paths (native C++ repair, single-signature device scoring).
+
+    The single mask-derivation point for both the backlog encode and the
+    scheduler's best-effort singles — eligibility semantics must not
+    diverge between them."""
+    if scheduling is None:
+        return None
+    selector, tolerations = scheduling
+    if not selector and not has_taints:
+        return None
+    mask = snapshot.eligibility(selector, tolerations)
+    if mask.all():
+        return None
+    return mask
+
+
 def encode_podgangs(
     podgangs: list[PodGang],
     snapshot: TopologySnapshot,
@@ -158,12 +183,13 @@ def encode_podgangs(
                 group_ids.append(gi)
                 mask = None
                 if pod_scheduling is not None:
-                    sched = pod_scheduling(ref.namespace, ref.name)
-                    if sched is not None:
-                        selector, tolerations = sched
-                        if selector or has_taints:
-                            mask = snapshot.eligibility(selector, tolerations)
-                            any_elig = True
+                    mask = pod_eligibility_mask(
+                        snapshot,
+                        pod_scheduling(ref.namespace, ref.name),
+                        has_taints,
+                    )
+                    if mask is not None:
+                        any_elig = True
                 pod_elig.append(mask)
             if stale:
                 break

@@ -187,3 +187,58 @@ class TestScalingGroupEndToEnd:
         assert pcsg.status.replicas == 3
         assert pcsg.status.scheduled_replicas == 3
         assert pcsg.status.available_replicas == 3
+
+
+class TestNodeSelectorEndToEnd:
+    """node_selector/tolerations enforced through the full control plane
+    (reference: the delegated scheduler honors the embedded corev1.PodSpec,
+    operator/api/core/v1alpha1/podclique.go:60-63)."""
+
+    def harness_with_accel(self, accel_count=4, total=8):
+        nodes = make_nodes(total, racks_per_block=2, hosts_per_rack=4)
+        accel = set()
+        for n in nodes[:accel_count]:
+            n.metadata.labels["accel"] = "v5"
+            accel.add(n.metadata.name)
+        return Harness(nodes=nodes), accel
+
+    def selector_pcs(self, selector, cpu=1.0):
+        cl = clique("fe", replicas=2, cpu=cpu)
+        cl.spec.pod_spec.node_selector = dict(selector)
+        return simple_pcs(cliques=[cl, clique("be", replicas=1, cpu=cpu)])
+
+    def test_selector_pods_land_on_matching_nodes(self):
+        harness, accel = self.harness_with_accel()
+        harness.apply(self.selector_pcs({"accel": "v5"}))
+        harness.settle()
+        pods = harness.store.list(Pod.KIND)
+        assert all(p.node_name for p in pods)
+        for p in pods:
+            if p.spec.node_selector:
+                assert p.node_name in accel, p.metadata.name
+
+    def test_impossible_selector_holds_the_whole_gang(self):
+        harness, _ = self.harness_with_accel(accel_count=0)
+        harness.apply(self.selector_pcs({"accel": "v5"}))
+        harness.settle()
+        # all-or-nothing: the selector-bound clique cannot land anywhere,
+        # so NO pod of the gang binds and the gang reports Unschedulable
+        pods = harness.store.list(Pod.KIND)
+        assert pods and all(not p.node_name for p in pods)
+        gang = harness.store.list(PodGang.KIND)[0]
+        cond = get_condition(gang.status.conditions, "Scheduled")
+        assert cond is not None and cond.status == "False"
+        assert cond.reason == "Unschedulable"
+
+    def test_tainted_nodes_repel_untolerated_pods(self):
+        nodes = make_nodes(8, racks_per_block=2, hosts_per_rack=4)
+        for n in nodes[:6]:
+            n.taints = ["reserved"]
+        harness = Harness(nodes=nodes)
+        harness.apply(simple_pcs())
+        harness.settle()
+        pods = harness.store.list(Pod.KIND)
+        untainted = {n.metadata.name for n in nodes[6:]}
+        assert all(p.node_name in untainted for p in pods), [
+            (p.metadata.name, p.node_name) for p in pods
+        ]

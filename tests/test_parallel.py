@@ -105,3 +105,27 @@ class TestPadDomainAbsorption:
                 sharded.placed[name].node_indices,
                 single.placed[name].node_indices,
             )
+
+
+class TestShardedEligibility:
+    def test_sharded_enforces_selectors_like_single(self, mesh):
+        from test_solver import constrained_gang, snap_with_accel_labels
+
+        snap = snap_with_accel_labels()
+        gangs = [
+            constrained_gang("sel", pods=2, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"}),
+            constrained_gang("held", pods=3, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"}),
+            gang("zz-free", pods=2, cpu=2.0),
+        ]
+        sharded = ShardedPlacementEngine(snap, mesh).solve(gangs)
+        single = PlacementEngine(snap).solve(gangs)
+        assert set(sharded.placed) == set(single.placed) == {"sel", "zz-free"}
+        assert "held" in sharded.unplaced
+        assert set(sharded.placed["sel"].node_indices.tolist()) <= {2, 3}
+        for name in sharded.placed:
+            np.testing.assert_array_equal(
+                sharded.placed[name].node_indices,
+                single.placed[name].node_indices,
+            )

@@ -313,3 +313,164 @@ class TestValueNarrownessDominance:
             )
         )
         assert value[0].argmax() == 2, value
+
+
+def snap_with_accel_labels(cpu=8.0):
+    """2 blocks x 2 hosts; block b1's nodes carry accel=v5. Shared with
+    tests/test_parallel.py's sharded eligibility test."""
+    nodes = []
+    for b in range(2):
+        for h in range(2):
+            labels = {"t/block": f"b{b}", "t/rack": "r0"}
+            if b == 1:
+                labels["accel"] = "v5"
+            nodes.append(make_node(f"n{b}{h}", labels, cpu=cpu))
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="block", key="t/block"),
+            TopologyLevel(domain="rack", key="t/rack"),
+        ]
+    )
+    return encode_topology(ct, nodes)
+
+
+def constrained_gang(name, pods, cpu, snap, selector, tolerations=()):
+    g = gang(name, pods=pods, cpu=cpu)
+    mask = snap.eligibility(dict(selector), list(tolerations))
+    g.pod_elig = [mask] * pods
+    return g
+
+
+class TestNodeEligibility:
+    """node_selector + taint/toleration enforcement in both solve paths.
+
+    The reference embeds full corev1.PodSpec whose selectors/taints the
+    delegated scheduler honors (operator/api/core/v1alpha1/podclique.go:
+    60-63); grove_tpu owns the scheduler, so the solve paths must enforce
+    them as hard filters — a constrained gang is HELD, never misplaced.
+    """
+
+    def snap_with_labels(self, cpu=8.0):
+        return snap_with_accel_labels(cpu=cpu)
+
+    def constrained(self, name, pods, cpu, snap, selector, tolerations=()):
+        return constrained_gang(name, pods, cpu, snap, selector, tolerations)
+
+    def test_eligibility_mask(self):
+        snap = self.snap_with_labels()
+        mask = snap.eligibility({"accel": "v5"}, [])
+        np.testing.assert_array_equal(mask, [False, False, True, True])
+        # cache returns the same shared read-only array
+        assert snap.eligibility({"accel": "v5"}, []) is mask
+        assert not mask.flags.writeable
+
+    def test_serial_places_only_on_selected_nodes(self):
+        snap = self.snap_with_labels()
+        g = self.constrained("g", pods=2, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"})
+        res = solve_serial(snap, [g])
+        assert "g" in res.placed
+        assert set(res.placed["g"].node_indices.tolist()) <= {2, 3}
+
+    def test_serial_holds_gang_rather_than_misplace(self):
+        snap = self.snap_with_labels()
+        # 3 pods x 6 cpu need 18 cpu on accel nodes (16 available there,
+        # 32 cluster-wide): must be HELD even though unselected nodes fit
+        g = self.constrained("g", pods=3, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"})
+        res = solve_serial(snap, [g])
+        assert res.placed == {}
+        assert "g" in res.unplaced
+
+    def test_engine_matches_serial_on_selectors(self):
+        snap = self.snap_with_labels()
+        gangs = [
+            self.constrained("sel", pods=2, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"}),
+            self.constrained("held", pods=3, cpu=6.0, snap=snap,
+                             selector={"accel": "v5"}),
+            # named to sort AFTER the constrained gangs: tie-break jitter
+            # must not let an unconstrained gang squat on scarce accel
+            # nodes before the selector-bound gang commits
+            gang("zz-free", pods=2, cpu=2.0),
+        ]
+        res = PlacementEngine(snap).solve(gangs)
+        ser = solve_serial(snap, gangs)
+        assert set(res.placed) == set(ser.placed) == {"sel", "zz-free"}
+        assert set(res.placed["sel"].node_indices.tolist()) <= {2, 3}
+        assert "held" in res.unplaced
+
+    def test_taints_repel_untolerated_pods(self):
+        nodes = [
+            make_node("n0", {"t/block": "b0", "t/rack": "r0"}),
+            make_node("n1", {"t/block": "b0", "t/rack": "r0"}),
+        ]
+        nodes[0].taints = ["maintenance"]
+        ct = default_cluster_topology(
+            [
+                TopologyLevel(domain="block", key="t/block"),
+                TopologyLevel(domain="rack", key="t/rack"),
+            ]
+        )
+        snap = encode_topology(ct, nodes)
+        assert snap.has_taints
+        # untolerated: only n1 eligible -> 2x6cpu gang held
+        g1 = self.constrained("plain", pods=2, cpu=6.0, snap=snap,
+                              selector={})
+        # tolerated: both nodes usable -> placed
+        g2 = self.constrained("tol", pods=2, cpu=6.0, snap=snap,
+                              selector={}, tolerations=["maintenance"])
+        for solve in (solve_serial, lambda s, gs: PlacementEngine(s).solve(gs)):
+            res = solve(snap, [g1])
+            assert "plain" in res.unplaced, solve
+            res = solve(snap, [g2])
+            assert "tol" in res.placed, solve
+
+    def test_mixed_eligibility_within_one_gang(self):
+        snap = self.snap_with_labels()
+        g = gang("mix", pods=3, cpu=5.0)
+        mask = snap.eligibility({"accel": "v5"}, [])
+        # one pod pinned to accel nodes, two unconstrained
+        g.pod_elig = [mask, None, None]
+        res = PlacementEngine(snap).solve([g])
+        assert "mix" in res.placed
+        pinned = res.placed["mix"].node_indices[0]
+        assert pinned in (2, 3)
+
+    def test_native_repair_rejects_elig_gangs(self):
+        from grove_tpu.native.serial_native import gang_native_compatible
+
+        snap = self.snap_with_labels()
+        g = self.constrained("g", pods=1, cpu=1.0, snap=snap,
+                             selector={"accel": "v5"})
+        assert not gang_native_compatible(g)
+        assert gang_native_compatible(gang("plain", pods=1))
+
+    def test_all_true_mask_treated_as_unconstrained(self):
+        """A mask that excludes nothing must resolve to None so fully
+        tolerating/unselective pods keep the fast paths (native repair,
+        single-signature scoring) even in a tainted cluster."""
+        from grove_tpu.solver.problem import pod_eligibility_mask
+
+        snap = self.snap_with_labels()
+        assert pod_eligibility_mask(snap, None, True) is None
+        assert pod_eligibility_mask(snap, ({}, []), False) is None
+        assert pod_eligibility_mask(snap, ({"accel": "v5"}, []), True) is not None
+
+        nodes = [
+            make_node("n0", {"t/block": "b0", "t/rack": "r0"}),
+            make_node("n1", {"t/block": "b0", "t/rack": "r0"}),
+        ]
+        nodes[0].taints = ["maintenance"]
+        ct = default_cluster_topology(
+            [TopologyLevel(domain="block", key="t/block"),
+             TopologyLevel(domain="rack", key="t/rack")]
+        )
+        tsnap = encode_topology(ct, nodes)
+        # tolerates every taint -> effectively unconstrained
+        assert pod_eligibility_mask(
+            tsnap, ({}, ["maintenance"]), tsnap.has_taints
+        ) is None
+        # untolerated taint -> real mask
+        mask = pod_eligibility_mask(tsnap, ({}, []), tsnap.has_taints)
+        np.testing.assert_array_equal(mask, [False, True])
