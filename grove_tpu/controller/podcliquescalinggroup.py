@@ -83,7 +83,11 @@ class PCSGReconciler:
         rolling update points at THIS PCSG's PCS replica
         (reconcilespec.go:70-117)."""
         from ..api.types import PCSGRollingUpdateProgress
-        from .updates import clique_template_hashes, clique_updated
+        from .updates import (
+            clique_template_hashes,
+            clique_updated,
+            prune_vanished_replicas,
+        )
 
         pcs = self._owner_pcs(pcsg)
         if pcs is None:
@@ -124,6 +128,7 @@ class PCSGReconciler:
             return
         target = prog.target_generation_hash
         hashes = clique_template_hashes(pcs)
+        prune_vanished_replicas(prog, pcsg.spec.replicas)
         if prog.current_replica_index is not None:
             j = prog.current_replica_index
             pclqs = self._replica_pclqs(pcsg, j)

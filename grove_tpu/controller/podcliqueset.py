@@ -174,13 +174,14 @@ class PodCliqueSetReconciler:
         Advances current_replica_index as replicas finish (detected by hash
         propagation, updates.clique_updated); on completion stamps the new
         generation hash."""
-        from .updates import pick_next_replica
+        from . import updates
 
         status = pcs.status
         prog = status.rolling_update_progress
         if prog is None or prog.completed:
             return
         before = asdict(status)
+        updates.prune_vanished_replicas(prog, pcs.spec.replicas)
         if prog.current_replica_index is not None and self._replica_updated(
             pcs, prog.current_replica_index
         ):
@@ -196,7 +197,7 @@ class PodCliqueSetReconciler:
                 prog.completed = True
                 status.current_generation_hash = prog.target_generation_hash
             else:
-                prog.current_replica_index = pick_next_replica(
+                prog.current_replica_index = updates.pick_next_replica(
                     self.store, pcs, remaining
                 )
         status.updated_replicas = (

@@ -50,6 +50,24 @@ def clique_updated(store: ObjectStore, pclq: PodClique, target_hash: str) -> boo
     return sum(1 for p in pods if p.status.ready) >= min_avail
 
 
+def prune_vanished_replicas(prog, replicas: int) -> None:
+    """Scale-in x update race bookkeeping (RU12/RU16, reference
+    rolling_updates_test.go): a replica index >= the shrunk spec.replicas
+    can never report updated — its cliques are deleted. Drop the in-flight
+    pointer (else the rollout wedges waiting on a ghost) and prune stale
+    updated indices (else status.updated_replicas exceeds spec.replicas
+    forever once the update completes). Shared by the PCS and PCSG
+    rolling-update orchestrators."""
+    if (
+        prog.current_replica_index is not None
+        and prog.current_replica_index >= replicas
+    ):
+        prog.current_replica_index = None
+    prog.updated_replica_indices = [
+        i for i in prog.updated_replica_indices if i < replicas
+    ]
+
+
 def pick_next_replica(
     store: ObjectStore, pcs: PodCliqueSet, remaining: list[int]
 ) -> int:

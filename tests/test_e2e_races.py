@@ -1,0 +1,558 @@
+"""E2E race matrix: gang scheduling x scaling (GS5-GS12) and rolling
+update x scale-in/out races (RU10-RU21), after the reference's scenarios
+(operator/e2e/tests/gang_scheduling_test.go:329-1187 and
+rolling_updates_test.go). The reference drives capacity with node
+cordons against 1-pod-per-node k3d workers; here 1-cpu nodes give the
+same forcing. Races are driven by interleaving store mutations between
+partial manager.run_once() steps instead of settling between actions.
+"""
+
+from grove_tpu.api import constants
+from grove_tpu.api.types import (
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueSet,
+    PodCliqueScalingGroupConfig,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.controller.common import stable_hash
+
+from test_e2e_basic import clique, simple_pcs
+from test_e2e_updates import bump_image, pod_hashes
+
+RETRY = constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1
+
+
+def wl2(name="wl2", replicas=1, pcsg_replicas=2):
+    """workload2 shape (e2e/yaml/workload2.yaml): standalone pc-a
+    (replicas 2, minAvailable 1) + sg-x{pc-b(1), pc-c(3, minAvailable 1)}
+    x pcsg_replicas with group minAvailable 1 -> 10 pods per PCS replica.
+    Base gang min pods: pc-a-0 + sg-x-0-{pc-b-0, pc-c-0} = 3; each scaled
+    sg-x replica gangs 2 min pods."""
+    return simple_pcs(
+        name=name,
+        replicas=replicas,
+        cliques=[
+            clique("pc-a", replicas=2, min_available=1, cpu=1.0),
+            clique("pc-b", replicas=1, cpu=1.0),
+            clique("pc-c", replicas=3, min_available=1, cpu=1.0),
+        ],
+        sgs=[
+            PodCliqueScalingGroupConfig(
+                name="sg-x", clique_names=["pc-b", "pc-c"],
+                replicas=pcsg_replicas, min_available=1,
+            )
+        ],
+    )
+
+
+def farm(h_nodes: int) -> Harness:
+    """1-cpu nodes (1 pod per node), ALL cordoned: uncordon() meters out
+    capacity exactly like the reference's cordon-based starvation."""
+    h = Harness(
+        nodes=make_nodes(
+            h_nodes, racks_per_block=4, hosts_per_rack=4,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0},
+        )
+    )
+    for i in range(h_nodes):
+        h.cluster.cordon(f"node-{i}")
+    h._next_uncordon = 0
+    return h
+
+
+def uncordon(h: Harness, k: int) -> None:
+    for i in range(h._next_uncordon, h._next_uncordon + k):
+        h.cluster.uncordon(f"node-{i}")
+    h._next_uncordon += k
+    h.settle()
+    h.advance(RETRY)  # starved best-effort pods sit on the retry timer
+
+
+def bound(h: Harness) -> set[str]:
+    return {p.metadata.name for p in h.store.list(Pod.KIND) if p.node_name}
+
+
+def scale_pcsg(h: Harness, fqn: str, replicas: int) -> None:
+    pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", fqn)
+    pcsg.spec.replicas = replicas
+    h.store.update(pcsg)
+
+
+def scale_pcs(h: Harness, name: str, replicas: int) -> None:
+    pcs = h.store.get(PodCliqueSet.KIND, "default", name)
+    pcs.spec.replicas = replicas
+    h.store.update(pcs)
+
+
+class TestGS_MinReplicaScaling:
+    """GS5-GS8: minReplicas x PCSG scaling under capacity starvation."""
+
+    def test_gs5_min_replicas_bind_first_then_rest(self):
+        h = farm(10)
+        h.apply(wl2())
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 10 and not bound(h)
+        uncordon(h, 3)
+        # exactly the base-gang min pods (all-or-nothing at min-replica cut)
+        assert bound(h) == {
+            "wl2-0-pc-a-0", "wl2-0-sg-x-0-pc-b-0", "wl2-0-sg-x-0-pc-c-0",
+        }
+        uncordon(h, 7)
+        assert len(bound(h)) == 10
+        # 1-cpu nodes force the reference's distinct-nodes property
+        nodes_used = {p.node_name for p in h.store.list(Pod.KIND)}
+        assert len(nodes_used) == 10
+
+    def test_gs6_pcsg_scale_out_gangs_new_min_first(self):
+        h = farm(14)
+        h.apply(wl2())
+        h.settle()
+        uncordon(h, 3)
+        assert len(bound(h)) == 3
+        uncordon(h, 7)
+        assert len(bound(h)) == 10
+        scale_pcsg(h, "wl2-0-sg-x", 3)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 14
+        assert len(bound(h)) == 10  # new pods pending: no capacity
+        uncordon(h, 2)
+        # the new scaled gang's min pods bind (sg-x-2: pc-b-0 + pc-c-0)
+        assert bound(h) >= {
+            "wl2-0-sg-x-2-pc-b-0", "wl2-0-sg-x-2-pc-c-0",
+        }
+        assert len(bound(h)) == 12
+        uncordon(h, 2)
+        assert len(bound(h)) == 14
+
+    def test_gs7_scaled_gang_outranks_best_effort_singles(self):
+        """GS7 step 6: with the base gang placed and capacity for 2, the
+        NEXT scaled gang's min pods win over the base gang's best-effort
+        extras (gang all-or-nothing before best-effort singles)."""
+        h = farm(10)
+        h.apply(wl2())
+        h.settle()
+        uncordon(h, 3)
+        assert len(bound(h)) == 3
+        uncordon(h, 2)
+        assert bound(h) >= {
+            "wl2-0-sg-x-1-pc-b-0", "wl2-0-sg-x-1-pc-c-0",
+        }
+        assert len(bound(h)) == 5
+        uncordon(h, 5)
+        assert len(bound(h)) == 10
+
+    def test_gs8_scale_out_while_everything_pending(self):
+        h = farm(14)
+        h.apply(wl2())
+        h.settle()
+        scale_pcsg(h, "wl2-0-sg-x", 3)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 14 and not bound(h)
+        uncordon(h, 3)
+        # base only: scaled-gang pods stay gated until the base schedules
+        assert len(bound(h)) == 3
+        uncordon(h, 4)
+        # both scaled gangs (sg-x-1, sg-x-2) bind their 2 min pods each
+        assert len(bound(h)) == 7
+        uncordon(h, 7)
+        assert len(bound(h)) == 14
+
+
+class TestGS_PCSScaling:
+    """GS9-GS12: PCS replica scaling x minReplicas under starvation."""
+
+    def test_gs9_pcs_scale_out_second_replica_mins_first(self):
+        h = farm(20)
+        h.apply(wl2())
+        h.settle()
+        uncordon(h, 3)
+        uncordon(h, 7)
+        assert len(bound(h)) == 10
+        scale_pcs(h, "wl2", 2)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 20
+        uncordon(h, 3)
+        assert bound(h) >= {
+            "wl2-1-pc-a-0", "wl2-1-sg-x-0-pc-b-0", "wl2-1-sg-x-0-pc-c-0",
+        }
+        assert len(bound(h)) == 13
+        uncordon(h, 7)
+        assert len(bound(h)) == 20
+
+    def test_gs10_early_pcs_scale_both_bases_bind_together(self):
+        h = farm(20)
+        h.apply(wl2())
+        h.settle()
+        scale_pcs(h, "wl2", 2)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 20 and not bound(h)
+        uncordon(h, 6)
+        # both base gangs' min pods (3 each)
+        assert len(bound(h)) == 6
+        uncordon(h, 4)
+        # both sg-x-1 scaled gangs (2 each)
+        assert len(bound(h)) == 10
+        uncordon(h, 10)
+        assert len(bound(h)) == 20
+
+    def test_gs11_interleaved_pcs_and_pcsg_scaling(self):
+        h = farm(28)
+        h.apply(wl2())
+        h.settle()
+        uncordon(h, 3)
+        uncordon(h, 7)
+        assert len(bound(h)) == 10
+        scale_pcsg(h, "wl2-0-sg-x", 3)
+        h.settle()
+        uncordon(h, 2)
+        assert len(bound(h)) == 12
+        uncordon(h, 2)
+        assert len(bound(h)) == 14
+        scale_pcs(h, "wl2", 2)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 24  # replica 1 keeps template sg-x=2
+        uncordon(h, 3)
+        assert len(bound(h)) == 17
+        uncordon(h, 7)
+        assert len(bound(h)) == 24
+        scale_pcsg(h, "wl2-1-sg-x", 3)
+        h.settle()
+        uncordon(h, 2)
+        assert len(bound(h)) == 26
+        uncordon(h, 2)
+        assert len(bound(h)) == 28
+
+    def test_gs12_complex_everything_scaled_while_pending(self):
+        h = farm(28)
+        h.apply(wl2())
+        h.settle()
+        scale_pcs(h, "wl2", 2)
+        h.settle()
+        scale_pcsg(h, "wl2-0-sg-x", 3)
+        scale_pcsg(h, "wl2-1-sg-x", 3)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 28 and not bound(h)
+        uncordon(h, 6)
+        assert len(bound(h)) == 6  # both bases
+        uncordon(h, 8)
+        assert len(bound(h)) == 14  # 4 scaled gangs x 2 min pods
+        uncordon(h, 14)
+        assert len(bound(h)) == 28
+
+
+class TestRU_UpdateUnderStarvation:
+    def test_ru10_update_pauses_under_insufficient_capacity(self):
+        """RU10 (rolling_updates_test.go:155-262): with all nodes cordoned
+        the rollout may sacrifice at most its single in-flight victim, must
+        then PAUSE (no second deletion while the replacement can't bind),
+        and completes once capacity returns."""
+        h = farm(8)
+        for i in range(8):
+            h.cluster.uncordon(f"node-{i}")
+        h._next_uncordon = 8
+        h.apply(simple_pcs(cliques=[clique("w", replicas=4, min_available=3,
+                                           cpu=1.0)]))
+        h.settle()
+        assert len(bound(h)) == 4
+        for i in range(8):
+            h.cluster.cordon(f"node-{i}")
+        h.settle()
+        original = {p.metadata.name: p.metadata.uid
+                    for p in h.store.list(Pod.KIND)}
+        bump_image(h)
+        h.settle()
+        h.advance(RETRY)
+        h.advance(300.0)
+        pods = {p.metadata.name: p for p in h.store.list(Pod.KIND)}
+        survivors = [n for n, uid in original.items()
+                     if n in pods and pods[n].metadata.uid == uid]
+        # at most ONE original pod replaced; everyone else still running
+        assert len(survivors) >= 3, survivors
+        ready = sum(1 for p in pods.values() if p.status.ready)
+        assert ready >= 3, f"availability collapsed to {ready}"
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert not pcs.status.rolling_update_progress.completed
+        # capacity returns -> rollout resumes and completes
+        for i in range(8):
+            h.cluster.uncordon(f"node-{i}")
+        h.settle()
+        h.advance(RETRY)
+        h.advance(RETRY)
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        assert set(pod_hashes(h).values()) == {target}
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+class TestRU_PCSScaleRaces:
+    def two_replica(self, name="r"):
+        return simple_pcs(name=name, replicas=2,
+                          cliques=[clique("w", replicas=2, cpu=1.0)])
+
+    def test_ru11_pcs_scale_out_during_update(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.two_replica())
+        h.settle()
+        bump_image(h, "r")
+        h.manager.run_once()  # update starts (one replica in flight)
+        scale_pcs(h, "r", 3)
+        h.settle()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        hashes = pod_hashes(h)
+        assert len(hashes) == 6
+        assert set(hashes.values()) == {target}
+        # the scaled-out replica was born on the new template: its pods
+        # were never churned by the update
+        r2 = [p for p in h.store.list(Pod.KIND)
+              if p.metadata.labels[constants.LABEL_PCS_REPLICA_INDEX] == "2"]
+        assert r2 and all(
+            p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH] == target
+            for p in r2
+        )
+
+    def drive_until(self, h, predicate, max_steps=128):
+        for _ in range(max_steps):
+            h.manager.run_once()
+            h.kubelet.tick()
+            if predicate():
+                return True
+        return False
+
+    def test_ru12_pcs_scale_in_while_final_ordinal_updating(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.two_replica())
+        h.settle()
+        bump_image(h, "r")
+
+        def final_ordinal_in_flight():
+            pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+            prog = pcs.status.rolling_update_progress
+            return (prog is not None and not prog.completed
+                    and prog.current_replica_index is not None
+                    and len(prog.updated_replica_indices) == 1)
+
+        assert self.drive_until(h, final_ordinal_in_flight)
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        victim = pcs.status.rolling_update_progress.current_replica_index
+        scale_pcs(h, "r", 1)  # scale in while ordinal `victim` mid-update
+        h.settle()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        prog = pcs.status.rolling_update_progress
+        assert prog.completed, (
+            f"update wedged: current_replica_index={prog.current_replica_index}"
+            f" (victim was {victim}), updated={prog.updated_replica_indices}"
+        )
+        hashes = pod_hashes(h)
+        assert len(hashes) == 2  # one replica left
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        assert set(hashes.values()) == {target}
+        # stale indices from scaled-away replicas must be pruned: status
+        # can never report more updated replicas than exist
+        assert pcs.status.updated_replicas <= pcs.spec.replicas
+
+    def test_ru13_pcs_scale_in_after_update_completes(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.two_replica())
+        h.settle()
+        bump_image(h, "r")
+        h.settle()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        assert pcs.status.rolling_update_progress.completed
+        scale_pcs(h, "r", 1)
+        h.settle()
+        hashes = pod_hashes(h)
+        assert len(hashes) == 2
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        assert pcs.status.rolling_update_progress.completed
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+class TestRU_PCSGScaleRaces:
+    def sg_pcs(self, name="sg", replicas=2):
+        return simple_pcs(
+            name=name,
+            cliques=[clique("w", replicas=2, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=replicas,
+                min_available=1)],
+        )
+
+    def drive_until(self, h, predicate, max_steps=128):
+        for _ in range(max_steps):
+            h.manager.run_once()
+            h.kubelet.tick()
+            if predicate():
+                return True
+        return False
+
+    def pcsg_prog(self, h, name="sg-0-grp"):
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", name)
+        return pcsg.status.rolling_update_progress
+
+    def test_ru14_pcsg_scale_out_during_update(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.sg_pcs())
+        h.settle()
+        bump_image(h, "sg")
+        assert self.drive_until(
+            h, lambda: (p := self.pcsg_prog(h)) is not None
+            and p.current_replica_index is not None
+        )
+        scale_pcsg(h, "sg-0-grp", 3)
+        h.settle()
+        prog = self.pcsg_prog(h)
+        assert prog.completed
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "sg")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        hashes = pod_hashes(h)
+        assert len(hashes) == 6
+        assert set(hashes.values()) == {target}
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "sg")
+        assert pcs.status.rolling_update_progress.completed
+
+    def test_ru15_pcsg_scale_out_before_update(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(self.sg_pcs())
+        h.settle()
+        scale_pcsg(h, "sg-0-grp", 3)
+        h.settle()
+        # new replica born pre-update on the OLD template
+        assert len(h.store.list(Pod.KIND)) == 6
+        bump_image(h, "sg")
+        h.settle()
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "sg")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        hashes = pod_hashes(h)
+        assert len(hashes) == 6
+        assert set(hashes.values()) == {target}
+        prog = self.pcsg_prog(h)
+        assert prog.completed
+        assert sorted(prog.updated_replica_indices) == [0, 1, 2]
+
+    def test_ru16_pcsg_scale_in_while_last_replica_updating(self):
+        h = Harness(nodes=make_nodes(24))
+        h.apply(self.sg_pcs(replicas=3))
+        h.settle()
+        bump_image(h, "sg")
+        assert self.drive_until(
+            h, lambda: (p := self.pcsg_prog(h)) is not None
+            and p.current_replica_index == 2
+        )
+        scale_pcsg(h, "sg-0-grp", 2)  # the updating replica disappears
+        h.settle()
+        prog = self.pcsg_prog(h)
+        assert prog is not None and prog.completed, (
+            f"PCSG update wedged on vanished replica: "
+            f"current={prog.current_replica_index} "
+            f"updated={prog.updated_replica_indices}"
+        )
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "sg")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        hashes = pod_hashes(h)
+        assert len(hashes) == 4
+        assert set(hashes.values()) == {target}
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "sg-0-grp")
+        assert pcsg.status.updated_replicas <= pcsg.spec.replicas
+        assert all(
+            i < pcsg.spec.replicas
+            for i in prog.updated_replica_indices
+        )
+
+    def test_ru17_pcsg_scale_in_before_update(self):
+        h = Harness(nodes=make_nodes(24))
+        h.apply(self.sg_pcs(replicas=3))
+        h.settle()
+        scale_pcsg(h, "sg-0-grp", 2)
+        h.settle()
+        assert len(h.store.list(Pod.KIND)) == 4
+        bump_image(h, "sg")
+        h.settle()
+        prog = self.pcsg_prog(h)
+        assert prog.completed
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "sg")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        assert set(pod_hashes(h).values()) == {target}
+
+
+class TestRU_PodCliqueScaleRaces:
+    """RU18/RU20: standalone-PCLQ scale (the HPA path mutates
+    PodClique.spec.replicas directly) racing its own pod-at-a-time
+    rollout."""
+
+    def drive_until(self, h, predicate, max_steps=128):
+        for _ in range(max_steps):
+            h.manager.run_once()
+            h.kubelet.tick()
+            if predicate():
+                return True
+        return False
+
+    def scale_pclq(self, h, fqn, replicas):
+        pclq = h.store.get(PodClique.KIND, "default", fqn)
+        pclq.spec.replicas = replicas
+        h.store.update(pclq)
+
+    def mid_rollout(self, h, fqn="s-0-w"):
+        pclq = h.store.get(PodClique.KIND, "default", fqn)
+        prog = pclq.status.rolling_update_progress
+        return prog is not None and not prog.completed
+
+    def test_ru18_pclq_scale_out_during_update(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(name="s", cliques=[clique("w", replicas=3,
+                                                     min_available=2,
+                                                     cpu=1.0)]))
+        h.settle()
+        bump_image(h, "s")
+        assert self.drive_until(h, lambda: self.mid_rollout(h))
+        self.scale_pclq(h, "s-0-w", 4)
+        h.settle()
+        h.advance(RETRY)
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 4
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "s")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        assert set(pod_hashes(h).values()) == {target}
+        assert all(p.status.ready for p in pods)
+        pclq = h.store.get(PodClique.KIND, "default", "s-0-w")
+        assert pclq.status.rolling_update_progress.completed
+        assert pclq.status.updated_replicas == 4
+
+    def test_ru20_pclq_scale_in_during_update(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(name="s", cliques=[clique("w", replicas=3,
+                                                     min_available=2,
+                                                     cpu=1.0)]))
+        h.settle()
+        bump_image(h, "s")
+        assert self.drive_until(h, lambda: self.mid_rollout(h))
+        self.scale_pclq(h, "s-0-w", 2)
+        h.settle()
+        h.advance(RETRY)
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 2
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "s")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        assert set(pod_hashes(h).values()) == {target}
+        assert all(p.status.ready for p in pods)
+        pclq = h.store.get(PodClique.KIND, "default", "s-0-w")
+        assert pclq.status.rolling_update_progress.completed
+        assert pclq.status.updated_replicas == 2
