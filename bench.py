@@ -105,10 +105,11 @@ def main() -> int:
     ap.add_argument("--serial-sample", type=int, default=0,
                     help="measure serial baseline on this many gangs and "
                     "extrapolate (0 = run the full backlog serially)")
-    ap.add_argument("--cp-replicas", type=int, default=200,
+    ap.add_argument("--cp-replicas", type=int, default=1000,
                     help="control-plane bench: PCS replicas driven through "
                     "the FULL path (apply -> pods -> gangs -> scheduler -> "
-                    "bound/ready); 0 disables")
+                    "bound/ready) at the same scale as the solver stress "
+                    "config; 0 disables")
     args = ap.parse_args()
     if args.small:
         args.nodes, args.gangs, args.iters = 512, 64, 3

@@ -108,18 +108,20 @@ class SimKubelet:
             elif pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
                 if self._barrier_open(pod, ready_at_tick_start):
                     to_ready.append(key)
+        now = self.store.clock.now()
+
+        def start(status):
+            status.phase = PodPhase.RUNNING
+            status.started_at = now
+
+        def ready(status):
+            status.ready = True
+            status.ever_started = True
+
         for ns, name in to_run:
-            pod = self.store.get(Pod.KIND, ns, name)
-            pod.status.phase = PodPhase.RUNNING
-            pod.status.started_at = self.store.clock.now()
-            self.store.update_status(pod)
-            changes += 1
+            changes += self.store.patch_status(Pod.KIND, ns, name, start)
         for ns, name in to_ready:
-            pod = self.store.get(Pod.KIND, ns, name)
-            pod.status.ready = True
-            pod.status.ever_started = True
-            self.store.update_status(pod)
-            changes += 1
+            changes += self.store.patch_status(Pod.KIND, ns, name, ready)
         return changes
 
     def run_to_quiesce(self, max_ticks: int = 64) -> None:

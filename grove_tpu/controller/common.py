@@ -18,12 +18,37 @@ from ..api.meta import ObjectMeta, OwnerReference
 from ..api.types import Pod, PodCliqueSet, PodPhase
 
 
+#: identity-keyed memo for stable_hash. The MVCC store shares spec objects
+#: across object versions and never mutates stored objects in place, so
+#: hashing the same (peeked) spec object repeatedly is the common case at
+#: control-plane scale. Entries hold a strong reference to the keyed object
+#: so its id() cannot be recycled while the entry lives; the cache is
+#: cleared when it grows past a bound.
+_HASH_MEMO: dict[int, tuple[Any, str]] = {}
+
+
 def stable_hash(obj: Any) -> str:
     """Deterministic short hash of a dataclass/dict tree (FNV-of-SpecHash
-    equivalent of the reference's ComputeHash)."""
-    data = asdict(obj) if hasattr(obj, "__dataclass_fields__") else obj
+    equivalent of the reference's ComputeHash). NOTE: memoized by object
+    identity — do not mutate an object between stable_hash calls and
+    expect a fresh hash; hash a fresh clone instead (store reads already
+    behave this way)."""
+    cacheable = hasattr(obj, "__dataclass_fields__")
+    if cacheable:
+        key = id(obj)
+        hit = _HASH_MEMO.get(key)
+        if hit is not None and hit[0] is obj:
+            return hit[1]
+    data = asdict(obj) if cacheable else obj
     payload = json.dumps(data, sort_keys=True, default=str)
-    return hashlib.sha1(payload.encode()).hexdigest()[:10]
+    digest = hashlib.sha1(payload.encode()).hexdigest()[:10]
+    # plain dicts (e.g. pcs_generation_hash's per-call aggregate) are built
+    # fresh every call — caching them would only pin garbage
+    if cacheable:
+        if len(_HASH_MEMO) > 8192:
+            _HASH_MEMO.clear()
+        _HASH_MEMO[key] = (obj, digest)
+    return digest
 
 
 def pcs_generation_hash(pcs: PodCliqueSet) -> str:

@@ -17,7 +17,6 @@ and never crashed still counts as healthy (:176-225).
 
 from __future__ import annotations
 
-import copy
 
 from ..api import constants, naming
 from ..api.meta import get_condition, set_condition
@@ -29,7 +28,7 @@ from ..api.types import (
     PodCliqueSet,
     PodPhase,
 )
-from ..cluster.store import Event, ObjectStore
+from ..cluster.store import Event, ObjectStore, clone
 from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
 from ..observability.events import EventRecorder, REASON_CREATE_SUCCESSFUL
 from .errors import GroveError, clear_status_errors, record_status_error
@@ -60,22 +59,35 @@ class PodCliqueReconciler:
             if pclq:
                 return [Request(event.namespace, pclq)]
         if event.kind == PodGang.KIND:
-            # gang creation/scheduling unblocks gate removal for every
-            # clique of the same PodCliqueSet (register.go:49-120)
-            owner = event.obj.metadata.labels.get(constants.LABEL_PART_OF)
-            if owner:
-                return [
-                    Request(event.namespace, p.metadata.name)
-                    for p in self.store.scan(  # names only: no-copy scan
-                        KIND,
-                        namespace=event.namespace,
-                        labels={constants.LABEL_PART_OF: owner},
-                    )
-                ]
+            # Gang creation/scheduling unblocks gate removal
+            # (register.go:49-120) — but only for cliques the gang actually
+            # references: its PodGroups are named after them, plus the
+            # scaled cliques holding this gang as their base. Mapping to
+            # every clique of the PCS (the r2 shape) turned each gang
+            # status write into an O(cliques) reconcile fan-out — the
+            # control-plane bottleneck at 1000-replica scale.
+            reqs = [
+                Request(event.namespace, group.name)
+                for group in event.obj.spec.pod_groups
+            ]
+            base_of = event.obj.metadata.name
+            reqs.extend(
+                Request(event.namespace, p.metadata.name)
+                for p in self.store.scan(  # names only: no-copy scan
+                    KIND,
+                    namespace=event.namespace,
+                    labels={constants.LABEL_BASE_PODGANG: base_of},
+                )
+            )
+            return reqs
         return []
 
     def reconcile(self, request: Request) -> Result:
-        pclq = self.store.get(KIND, request.namespace, request.name)
+        # peek: this reconciler never mutates the PodClique object itself —
+        # every write goes through a dedicated store call (pod CRUD,
+        # finalizers, patch_status) — and the per-reconcile get() clone of
+        # the whole clique dominated settle at 10^3-clique scale
+        pclq = self.store.peek(KIND, request.namespace, request.name)
         if pclq is None:
             return Result()
         if pclq.metadata.deletion_timestamp is not None:
@@ -214,7 +226,7 @@ class PodCliqueReconciler:
             annotations[constants.ANNOTATION_WAIT_FOR] = ",".join(
                 f"{fqn}:{minav}" for fqn, minav in deps
             )
-        spec = copy.deepcopy(pclq.spec.pod_spec)
+        spec = clone(pclq.spec.pod_spec)
         spec.scheduling_gates = [constants.PODGANG_PENDING_CREATION_GATE]
         spec.hostname = pod_name
         spec.subdomain = naming.headless_service_name(pcs_name, int(replica))
@@ -381,63 +393,71 @@ class PodCliqueReconciler:
                 base = self.store.peek(PodGang.KIND, ns, base_name)
                 if base is None or not _is_scheduled(base):
                     continue  # scaled gang waits for base (:306-345)
-            fresh = self.store.get(Pod.KIND, ns, pod.metadata.name)
-            fresh.spec.scheduling_gates = []
-            self.store.update(fresh)
+            self.store.ungate_pod(ns, pod.metadata.name)
 
     # -- status flow (reconcilestatus.go) ----------------------------------
     def _reconcile_status(self, pclq: PodClique) -> None:
-        from dataclasses import asdict
-
-        fresh = self.store.get(KIND, pclq.metadata.namespace, pclq.metadata.name)
+        """Reads live state (peeks); the write goes through patch_status —
+        the status flow runs on every reconcile for every clique, so the
+        full-object get() clone here dominated settle at 10^3-clique
+        scale."""
+        fresh = self.store.peek(
+            KIND, pclq.metadata.namespace, pclq.metadata.name
+        )
         if fresh is None:
             return
-        status = fresh.status
-        before = asdict(status)
         pods = [p for p in self._owned_pods(fresh) if is_pod_active(p)]
-        status.replicas = len(pods)
-        status.ready_replicas = sum(1 for p in pods if p.status.ready)
-        status.scheduled_replicas = sum(1 for p in pods if p.node_name)
-        status.schedule_gated_replicas = sum(
-            1 for p in pods if p.spec.scheduling_gates
-        )
-        status.observed_generation = fresh.metadata.generation
-        status.selector = f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
-        status.current_pod_template_hash = stable_hash(fresh.spec.pod_spec)
-        self._track_rollout(fresh, status, pods)
+        ready = sum(1 for p in pods if p.status.ready)
+        scheduled = sum(1 for p in pods if p.node_name)
+        gated = sum(1 for p in pods if p.spec.scheduling_gates)
+        template_hash = stable_hash(fresh.spec.pod_spec)
         min_avail = fresh.spec.min_available or fresh.spec.replicas
         now = self.store.clock.now()
-        scheduled_enough = status.scheduled_replicas >= min_avail
-        set_condition(
-            status.conditions,
-            constants.CONDITION_PODCLIQUE_SCHEDULED,
-            "True" if scheduled_enough else "False",
-            reason=(
-                constants.REASON_SUFFICIENT_SCHEDULED_PODS
-                if scheduled_enough
-                else constants.REASON_INSUFFICIENT_SCHEDULED_PODS
-            ),
-            now=now,
-        )
+        scheduled_enough = scheduled >= min_avail
         # Breach only counts once the gang actually scheduled — an
         # unschedulable fresh workload must not tick toward termination
         # (gangterminate guards on PodCliqueScheduled in the reference).
         healthy = sum(1 for p in pods if is_pod_healthy(p))
         breached = scheduled_enough and healthy < min_avail
-        set_condition(
-            status.conditions,
-            constants.CONDITION_MIN_AVAILABLE_BREACHED,
-            "True" if breached else "False",
-            reason=(
-                constants.REASON_INSUFFICIENT_READY_PODS
-                if breached
-                else constants.REASON_SUFFICIENT_READY_PODS
-            ),
-            now=now,
+
+        def mutate(status):
+            status.replicas = len(pods)
+            status.ready_replicas = ready
+            status.scheduled_replicas = scheduled
+            status.schedule_gated_replicas = gated
+            status.observed_generation = fresh.metadata.generation
+            status.selector = (
+                f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
+            )
+            status.current_pod_template_hash = template_hash
+            self._track_rollout(fresh, status, pods)
+            set_condition(
+                status.conditions,
+                constants.CONDITION_PODCLIQUE_SCHEDULED,
+                "True" if scheduled_enough else "False",
+                reason=(
+                    constants.REASON_SUFFICIENT_SCHEDULED_PODS
+                    if scheduled_enough
+                    else constants.REASON_INSUFFICIENT_SCHEDULED_PODS
+                ),
+                now=now,
+            )
+            set_condition(
+                status.conditions,
+                constants.CONDITION_MIN_AVAILABLE_BREACHED,
+                "True" if breached else "False",
+                reason=(
+                    constants.REASON_INSUFFICIENT_READY_PODS
+                    if breached
+                    else constants.REASON_SUFFICIENT_READY_PODS
+                ),
+                now=now,
+            )
+            clear_status_errors(self.store, status, now)
+
+        self.store.patch_status(
+            KIND, fresh.metadata.namespace, fresh.metadata.name, mutate
         )
-        clear_status_errors(self.store, status, now)
-        if asdict(status) != before:
-            self.store.update_status(fresh)
 
     def _track_rollout(self, pclq: PodClique, status, pods: list[Pod]) -> None:
         """Per-clique rolling-update status parity (podclique.go:104-137):

@@ -13,8 +13,6 @@ minAvailable replicas are healthy (reconcilestatus.go:83-207).
 
 from __future__ import annotations
 
-import copy
-from dataclasses import asdict
 
 from ..api import constants, naming
 from ..api.meta import get_condition, set_condition
@@ -23,7 +21,7 @@ from ..api.types import (
     PodCliqueScalingGroup,
     PodCliqueSet,
 )
-from ..cluster.store import Event, ObjectStore
+from ..cluster.store import Event, ObjectStore, clone
 from .common import base_labels, new_meta
 from .errors import GroveError, clear_status_errors, record_status_error
 from .runtime import Request, Result
@@ -102,7 +100,7 @@ class PCSGReconciler:
             and pcs_prog.current_replica_index == my_pcs_replica
         )
         status = pcsg.status
-        before = asdict(status)
+        before = clone(status)
         prog = status.rolling_update_progress
         if prog is None or (
             pcs_prog is not None
@@ -117,9 +115,8 @@ class PCSGReconciler:
             if not points_at_me:
                 if prog is not None and not prog.completed:
                     status.rolling_update_progress = None
-                    if asdict(status) != before:
+                    if status != before:
                         self.store.update_status(pcsg)
-                        pcsg.status = status
                 return
             prog = status.rolling_update_progress = PCSGRollingUpdateProgress(
                 target_generation_hash=pcs_prog.target_generation_hash
@@ -158,9 +155,8 @@ class PCSGReconciler:
             else:
                 prog.current_replica_index = min(remaining)
         status.updated_replicas = len(prog.updated_replica_indices)
-        if asdict(status) != before:
+        if status != before:
             self.store.update_status(pcsg)
-            pcsg.status = status
 
     def _replica_pclqs(self, pcsg: PodCliqueScalingGroup, j: int) -> list[PodClique]:
         return [
@@ -221,9 +217,9 @@ class PCSGReconciler:
             existing = self.store.get(PodClique.KIND, ns, pclq_name)
             if existing is not None:
                 if j == updating_replica and template is not None:
-                    new_spec = copy.deepcopy(template.spec)
+                    new_spec = clone(template.spec)
                     new_spec.replicas = existing.spec.replicas
-                    if asdict(existing.spec) != asdict(new_spec):
+                    if existing.spec != new_spec:
                         existing.spec = new_spec
                         self.store.update(existing)
                 continue
@@ -249,7 +245,7 @@ class PCSGReconciler:
             self.store.create(
                 PodClique(
                     metadata=new_meta(pclq_name, ns, pcsg, labels),
-                    spec=copy.deepcopy(template.spec),
+                    spec=clone(template.spec),
                 )
             )
         # scale-in: drop highest replica indices (components/podclique/
@@ -263,7 +259,7 @@ class PCSGReconciler:
         if fresh is None:
             return
         status = fresh.status
-        before = asdict(status)
+        before = clone(status)
         pclqs = self._owned_pclqs(fresh)
         by_replica: dict[int, list[PodClique]] = {}
         for pclq in pclqs:
@@ -303,7 +299,7 @@ class PCSGReconciler:
             now=now,
         )
         clear_status_errors(self.store, status, now)
-        if asdict(status) != before:
+        if status != before:
             self.store.update_status(fresh)
 
 

@@ -18,7 +18,6 @@ until every expected pod exists and carries the gang label
 
 from __future__ import annotations
 
-from dataclasses import asdict
 from typing import Optional
 
 from ..api import constants, naming
@@ -53,7 +52,7 @@ from ..api.types import (
     PodCliqueSpec,
     TopologyConstraintSpec,
 )
-from ..cluster.store import Event, ObjectStore
+from ..cluster.store import Event, ObjectStore, clone
 from ..observability.events import (
     EventRecorder,
     REASON_GANG_TERMINATED,
@@ -154,7 +153,7 @@ class PodCliqueSetReconciler:
 
         new_hash = pcs_generation_hash(pcs)
         status = pcs.status
-        before = asdict(status)
+        before = clone(status)
         if status.current_generation_hash == "":
             status.current_generation_hash = new_hash
         elif status.current_generation_hash != new_hash:
@@ -165,9 +164,8 @@ class PodCliqueSetReconciler:
                     target_generation_hash=new_hash,
                 )
         status.observed_generation = pcs.metadata.generation
-        if asdict(status) != before:
+        if status != before:
             self.store.update_status(pcs)
-            pcs.status = status
 
     def _sync_rolling_update(self, pcs: PodCliqueSet) -> None:
         """One-replica-at-a-time orchestration (rollingupdate.go:40-73).
@@ -180,7 +178,7 @@ class PodCliqueSetReconciler:
         prog = status.rolling_update_progress
         if prog is None or prog.completed:
             return
-        before = asdict(status)
+        before = clone(status)
         updates.prune_vanished_replicas(prog, pcs.spec.replicas)
         if prog.current_replica_index is not None and self._replica_updated(
             pcs, prog.current_replica_index
@@ -204,9 +202,8 @@ class PodCliqueSetReconciler:
             pcs.spec.replicas if prog.completed
             else len(prog.updated_replica_indices)
         )
-        if asdict(status) != before:
+        if status != before:
             self.store.update_status(pcs)
-            pcs.status = status
 
     def _replica_updated(self, pcs: PodCliqueSet, replica: int) -> bool:
         """All standalone + PCSG-owned cliques of the replica carry the
@@ -447,7 +444,7 @@ class PodCliqueSetReconciler:
                 if i == updating_replica:
                     new_spec = _copy_spec(spec)
                     new_spec.replicas = existing.spec.replicas
-                    if asdict(existing.spec) != asdict(new_spec):
+                    if existing.spec != new_spec:
                         existing.spec = new_spec
                         self.store.update(existing)
                 continue
@@ -557,7 +554,7 @@ class PodCliqueSetReconciler:
                 self.store.create(
                     PodGang(metadata=new_meta(gang_name, ns, pcs, labels), spec=spec)
                 )
-            elif asdict(existing.spec) != asdict(spec):
+            elif existing.spec != spec:
                 existing.spec = spec
                 self.store.update(existing)
         for gang in self.store.scan(PodGang.KIND, namespace=ns, labels=comp_labels):
@@ -677,7 +674,7 @@ class PodCliqueSetReconciler:
         if fresh is None:
             return
         status = fresh.status
-        before = asdict(status)
+        before = clone(status)
         status.replicas = fresh.spec.replicas
         available = 0
         for i in range(fresh.spec.replicas):
@@ -697,7 +694,7 @@ class PodCliqueSetReconciler:
         )
         status.selector = f"{constants.LABEL_PART_OF}={name}"
         clear_status_errors(self.store, status, self.store.clock.now())
-        if asdict(status) != before:
+        if status != before:
             self.store.update_status(fresh)
 
     def _missing_levels(self, pcs: PodCliqueSet) -> list[str]:
@@ -766,6 +763,4 @@ def _translate(
 
 
 def _copy_spec(spec: PodCliqueSpec) -> PodCliqueSpec:
-    import copy
-
-    return copy.deepcopy(spec)
+    return clone(spec)

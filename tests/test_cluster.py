@@ -88,8 +88,10 @@ class TestStore:
         assert pcs.metadata.generation == 2
         # status write never bumps generation
         pcs.status.replicas = 3
-        pcs = c.store.update_status(pcs)
+        c.store.update_status(pcs)
+        pcs = c.store.get("PodCliqueSet", "default", "web")
         assert pcs.metadata.generation == 2
+        assert pcs.status.replicas == 3
 
     def test_finalizer_gated_delete(self):
         c = Cluster()
