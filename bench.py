@@ -105,6 +105,11 @@ def main() -> int:
     ap.add_argument("--serial-sample", type=int, default=0,
                     help="measure serial baseline on this many gangs and "
                     "extrapolate (0 = run the full backlog serially)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the measured engine as ShardedPlacementEngine "
+                    "over a mesh of ALL visible devices (1-device mesh on a "
+                    "single chip; virtual CPU mesh under "
+                    "xla_force_host_platform_device_count)")
     ap.add_argument("--cp-replicas", type=int, default=1000,
                     help="control-plane bench: PCS replicas driven through "
                     "the FULL path (apply -> pods -> gangs -> scheduler -> "
@@ -125,11 +130,22 @@ def main() -> int:
     # re-derived (SURVEY §5 / VERDICT r1 #4).
     from grove_tpu.observability import MetricsRegistry
 
-    warm = PlacementEngine(snapshot)
+    if args.sharded:
+        from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
+
+        mesh = make_solver_mesh()
+
+        def mk_engine(**kw):
+            return ShardedPlacementEngine(snapshot, mesh, **kw)
+    else:
+        def mk_engine(**kw):
+            return PlacementEngine(snapshot, **kw)
+
+    warm = mk_engine()
     warm.solve(gangs)  # warm-up: compile + caches (not recorded)
 
     registry = MetricsRegistry()
-    engine = PlacementEngine(snapshot, metrics=registry)
+    engine = mk_engine(metrics=registry)
     # Each iteration is one "bind the whole backlog" event.
     placed = 0
     for _ in range(args.iters):
@@ -183,6 +199,8 @@ def main() -> int:
         "mean_placement_score": round(score, 4),
         "repair_fallbacks": fallbacks,
         "backend": __import__("jax").default_backend(),
+        "engine": "sharded" if args.sharded else "single",
+        **({"mesh": dict(mesh.shape)} if args.sharded else {}),
         **cp,
     }
     print(json.dumps(out))

@@ -27,19 +27,22 @@ from ..api.types import Pod, PodCliqueSet, PodPhase
 _HASH_MEMO: dict[int, tuple[Any, str]] = {}
 
 
-def stable_hash(obj: Any) -> str:
+def stable_hash(obj: Any, memo: bool = True) -> str:
     """Deterministic short hash of a dataclass/dict tree (FNV-of-SpecHash
     equivalent of the reference's ComputeHash). NOTE: memoized by object
     identity — do not mutate an object between stable_hash calls and
     expect a fresh hash; hash a fresh clone instead (store reads already
-    behave this way)."""
-    cacheable = hasattr(obj, "__dataclass_fields__")
+    behave this way). Pass memo=False when hashing a freshly-cloned object
+    (e.g. a get() result): its id never recurs, so caching it only pins
+    garbage and churns the hot entries out."""
+    is_dc = hasattr(obj, "__dataclass_fields__")
+    cacheable = memo and is_dc
     if cacheable:
         key = id(obj)
         hit = _HASH_MEMO.get(key)
         if hit is not None and hit[0] is obj:
             return hit[1]
-    data = asdict(obj) if cacheable else obj
+    data = asdict(obj) if is_dc else obj
     payload = json.dumps(data, sort_keys=True, default=str)
     digest = hashlib.sha1(payload.encode()).hexdigest()[:10]
     # plain dicts (e.g. pcs_generation_hash's per-call aggregate) are built

@@ -20,9 +20,12 @@ from .common import is_pod_active, stable_hash
 
 
 def clique_template_hashes(pcs: PodCliqueSet) -> dict[str, str]:
-    """clique template name -> target pod-template hash."""
+    """clique template name -> target pod-template hash. memo=False: the
+    reconcilers pass a get()-cloned PCS, whose template objects are fresh
+    every call — caching them would only pollute the identity memo."""
     return {
-        c.name: stable_hash(c.spec.pod_spec) for c in pcs.spec.template.cliques
+        c.name: stable_hash(c.spec.pod_spec, memo=False)
+        for c in pcs.spec.template.cliques
     }
 
 

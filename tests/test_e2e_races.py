@@ -81,6 +81,17 @@ def scale_pcsg(h: Harness, fqn: str, replicas: int) -> None:
     h.store.update(pcsg)
 
 
+def drive_until(h, predicate, max_steps=128):
+    """Step the manager+kubelet until predicate() holds (races are driven
+    between partial steps, never via full settles)."""
+    for _ in range(max_steps):
+        h.manager.run_once()
+        h.kubelet.tick()
+        if predicate():
+            return True
+    return False
+
+
 def scale_pcs(h: Harness, name: str, replicas: int) -> None:
     pcs = h.store.get(PodCliqueSet.KIND, "default", name)
     pcs.spec.replicas = replicas
@@ -317,14 +328,6 @@ class TestRU_PCSScaleRaces:
             for p in r2
         )
 
-    def drive_until(self, h, predicate, max_steps=128):
-        for _ in range(max_steps):
-            h.manager.run_once()
-            h.kubelet.tick()
-            if predicate():
-                return True
-        return False
-
     def test_ru12_pcs_scale_in_while_final_ordinal_updating(self):
         h = Harness(nodes=make_nodes(16))
         h.apply(self.two_replica())
@@ -338,7 +341,7 @@ class TestRU_PCSScaleRaces:
                     and prog.current_replica_index is not None
                     and len(prog.updated_replica_indices) == 1)
 
-        assert self.drive_until(h, final_ordinal_in_flight)
+        assert drive_until(h, final_ordinal_in_flight)
         pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
         victim = pcs.status.rolling_update_progress.current_replica_index
         scale_pcs(h, "r", 1)  # scale in while ordinal `victim` mid-update
@@ -384,14 +387,6 @@ class TestRU_PCSGScaleRaces:
                 min_available=1)],
         )
 
-    def drive_until(self, h, predicate, max_steps=128):
-        for _ in range(max_steps):
-            h.manager.run_once()
-            h.kubelet.tick()
-            if predicate():
-                return True
-        return False
-
     def pcsg_prog(self, h, name="sg-0-grp"):
         pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", name)
         return pcsg.status.rolling_update_progress
@@ -401,7 +396,7 @@ class TestRU_PCSGScaleRaces:
         h.apply(self.sg_pcs())
         h.settle()
         bump_image(h, "sg")
-        assert self.drive_until(
+        assert drive_until(
             h, lambda: (p := self.pcsg_prog(h)) is not None
             and p.current_replica_index is not None
         )
@@ -445,7 +440,7 @@ class TestRU_PCSGScaleRaces:
         h.apply(self.sg_pcs(replicas=3))
         h.settle()
         bump_image(h, "sg")
-        assert self.drive_until(
+        assert drive_until(
             h, lambda: (p := self.pcsg_prog(h)) is not None
             and p.current_replica_index == 2
         )
@@ -493,14 +488,6 @@ class TestRU_PodCliqueScaleRaces:
     PodClique.spec.replicas directly) racing its own pod-at-a-time
     rollout."""
 
-    def drive_until(self, h, predicate, max_steps=128):
-        for _ in range(max_steps):
-            h.manager.run_once()
-            h.kubelet.tick()
-            if predicate():
-                return True
-        return False
-
     def scale_pclq(self, h, fqn, replicas):
         pclq = h.store.get(PodClique.KIND, "default", fqn)
         pclq.spec.replicas = replicas
@@ -518,7 +505,7 @@ class TestRU_PodCliqueScaleRaces:
                                                      cpu=1.0)]))
         h.settle()
         bump_image(h, "s")
-        assert self.drive_until(h, lambda: self.mid_rollout(h))
+        assert drive_until(h, lambda: self.mid_rollout(h))
         self.scale_pclq(h, "s-0-w", 4)
         h.settle()
         h.advance(RETRY)
@@ -541,7 +528,7 @@ class TestRU_PodCliqueScaleRaces:
                                                      cpu=1.0)]))
         h.settle()
         bump_image(h, "s")
-        assert self.drive_until(h, lambda: self.mid_rollout(h))
+        assert drive_until(h, lambda: self.mid_rollout(h))
         self.scale_pclq(h, "s-0-w", 2)
         h.settle()
         h.advance(RETRY)
