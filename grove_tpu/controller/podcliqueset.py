@@ -643,6 +643,16 @@ class PodCliqueSetReconciler:
                                 sg.topology_constraint, levels
                             ),
                             priority_class_name=tmpl.priority_class_name,
+                            # Reservation-reuse hint (podgang.go:66-72 — the
+                            # reference declares the field but never sets
+                            # it). Recreated gangs keep their name (gang
+                            # termination rebuilds the same replica), so the
+                            # predecessor whose reservation may be reused is
+                            # the prior same-named gang; the scheduler
+                            # remembers its placement and tries it first.
+                            reuse_reservation_ref=NamespacedName(
+                                namespace=ns, name=scaled_name
+                            ),
                         ),
                         {constants.LABEL_BASE_PODGANG: base_name},
                     )
@@ -653,6 +663,9 @@ class PodCliqueSetReconciler:
                     topology_constraint=_translate(tmpl.topology_constraint, levels),
                     topology_constraint_group_configs=cgroups,
                     priority_class_name=tmpl.priority_class_name,
+                    reuse_reservation_ref=NamespacedName(
+                        namespace=ns, name=base_name
+                    ),
                 ),
                 {},
             )

@@ -77,6 +77,18 @@ class GangScheduler:
         #: on a retry timer, so freed capacity (node add, other workload
         #: deleted) reaches them without a direct event for their pods
         self._starved: set[tuple[str, str]] = set()
+        #: reservation memory, (namespace, gang name) -> node names of the
+        #: last successful bind. Entries OUTLIVE gang deletion on purpose:
+        #: a successor gang naming its predecessor in
+        #: spec.reuse_reservation_ref (podgang.go:66-72) gets its prior
+        #: placement tried before general search — placement-stable gang
+        #: rebuilds, less topology churn
+        self._reservations: dict[tuple[str, str], tuple[str, ...]] = {}
+        #: (namespace, pod name) -> node the pod occupied when deleted.
+        #: Replacement pods reuse hole-filled names, so a rolling update's
+        #: replacement binds back onto the node its predecessor vacated
+        #: when it still fits (pod-level reservation reuse)
+        self._vacated: dict[tuple[str, str], str] = {}
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == PodGang.KIND:
@@ -88,6 +100,12 @@ class GangScheduler:
             gang = event.obj.metadata.labels.get(constants.LABEL_PODGANG)
             if gang:
                 self._dirty.add((event.namespace, gang))
+            if event.type == "Deleted" and event.obj.node_name:
+                if len(self._vacated) > 100_000:
+                    self._vacated.clear()
+                self._vacated[(event.namespace, event.name)] = (
+                    event.obj.node_name
+                )
             return [_SINGLETON_REQ]
         if event.kind == Node.KIND or event.kind == ClusterTopology.KIND:
             # capacity/encoding shift: retry the backlog (scan finds it)
@@ -150,13 +168,16 @@ class GangScheduler:
                 backlog, snapshot, demand_fn, priority_of=self._priority_of,
                 pod_scheduling=sched_fn,
             )
+            by_name = {g.metadata.name: g for g in backlog}
+            solver_gangs = self._try_reserved(
+                solver_gangs, by_name, snapshot, free
+            )
             result = engine.solve(solver_gangs, free=free)
             self.log.debug(
                 "backlog solved", gangs=len(backlog),
                 placed=result.num_placed, unplaced=len(result.unplaced),
                 wall_seconds=round(result.wall_seconds, 4),
             )
-            by_name = {g.metadata.name: g for g in backlog}
             for name, placement in result.placed.items():
                 self._bind(by_name[name], placement)
             for name, reason in result.unplaced.items():
@@ -253,11 +274,83 @@ class GangScheduler:
                 return float(pc.value)
         return 0.0
 
+    # -- reservation reuse (podgang.go:66-72; exceeds the reference, which
+    # declares the field but never consumes it) ------------------------------
+    def _try_reserved(self, solver_gangs, by_name, snapshot, free):
+        """Before general search, try to place gangs that name a
+        predecessor in reuse_reservation_ref onto that predecessor's
+        remembered nodes (exact fit semantics, mutating free on success).
+        Returns the gangs the general solve still has to handle.
+
+        The pre-pass walks gangs in the solvers' exact priority order and
+        STOPS at the first gang it cannot reserve-place: reservations are a
+        priority-prefix optimization, so a reserved gang can never consume
+        capacity ahead of a higher-priority gang that the general solve
+        would have served first (no priority inversion)."""
+        from ..solver.fit import place_gang_in_domain, placement_score_for_nodes
+        from ..solver.result import GangPlacement
+        from ..solver.serial import gang_sort_key
+
+        order = sorted(solver_gangs, key=gang_sort_key)
+        node_index = {n: i for i, n in enumerate(snapshot.node_names)}
+        for pos, sg in enumerate(order):
+            pg = by_name.get(sg.name)
+            ref = pg.spec.reuse_reservation_ref if pg is not None else None
+            reserved = (
+                self._reservations.get((ref.namespace, ref.name))
+                if ref is not None and not sg.unschedulable_reason
+                else None
+            )
+            if not reserved:
+                return order[pos:]
+            idx = np.asarray(
+                [
+                    node_index[n]
+                    for n in reserved
+                    if n in node_index
+                    and snapshot.schedulable[node_index[n]]
+                ],
+                dtype=np.int64,
+            )
+            # the gang-level REQUIRED pack constraint stays exact: the
+            # reserved nodes must all sit in one domain at that level (a
+            # re-encoded topology can scatter a once-valid reservation)
+            level = sg.required_level
+            if level >= 0 and len(idx):
+                ids = snapshot.domain_ids[level, idx]
+                if not (ids == ids[0]).all():
+                    return order[pos:]
+            assign = (
+                place_gang_in_domain(sg, snapshot, free, idx, level)
+                if len(idx)
+                else None
+            )
+            if assign is None:
+                return order[pos:]  # reservation gone/too small: general
+            self._bind(
+                pg,
+                GangPlacement(
+                    gang=sg,
+                    pod_to_node={
+                        sg.pod_names[i]: snapshot.node_names[assign[i]]
+                        for i in range(sg.num_pods)
+                    },
+                    node_indices=assign,
+                    placement_score=placement_score_for_nodes(snapshot, assign),
+                ),
+            )
+        return []
+
     # -- binding ------------------------------------------------------------
     def _bind(self, gang: PodGang, placement) -> None:
         ns = gang.metadata.namespace
         for pod_name, node_name in placement.pod_to_node.items():
             self.store.bind_pod(ns, pod_name, node_name)
+        if len(self._reservations) > 100_000:
+            self._reservations.clear()
+        self._reservations[(ns, gang.metadata.name)] = tuple(
+            sorted(set(placement.pod_to_node.values()))
+        )
         gang.status.placement_score = placement.placement_score
         gang.status.phase = PodGangPhase.STARTING
         set_condition(
@@ -288,9 +381,13 @@ class GangScheduler:
     ):
         """Pods referenced beyond MinReplicas (or replacements for evicted
         min-pods) of already-scheduled gangs bind as singletons against the
-        residual free capacity."""
+        residual free capacity. A replacement pod (same hole-filled name as
+        a recently deleted one) first tries the exact node its predecessor
+        vacated — pod-level reservation reuse keeps rolling updates
+        placement-stable."""
         singles: list[SolverGang] = []
         has_taints = snapshot.has_taints
+        node_index = {n: i for i, n in enumerate(snapshot.node_names)}
         for gang in scheduled_gangs:
             for group in gang.spec.pod_groups:
                 for ref in group.pod_references:
@@ -311,6 +408,22 @@ class GangScheduler:
                     mask = pod_eligibility_mask(
                         snapshot, sched_fn(ref.namespace, ref.name), has_taints
                     )
+                    key = (ref.namespace, ref.name)
+                    prior = self._vacated.get(key)
+                    if prior is not None:
+                        i = node_index.get(prior)
+                        if (
+                            i is not None
+                            and snapshot.schedulable[i]
+                            and (free[i] + 1e-9 >= demand).all()
+                            and (mask is None or mask[i])
+                            and self.store.bind_pod(
+                                ref.namespace, ref.name, prior
+                            )
+                        ):
+                            free[i] -= demand
+                            del self._vacated[key]
+                            continue
                     singles.append(
                         SolverGang(
                             name=f"single/{ref.name}",

@@ -240,3 +240,113 @@ class TestAS_Autoscaling:
         h.autoscale()  # no observe() calls at all
         pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
         assert pcsg.spec.replicas == 2
+
+
+class TestRR_ReservationReuse:
+    """Reservation reuse (podgang.go:66-72 — the reference declares
+    ReuseReservationRef but never consumes it; grove_tpu sets AND honors
+    it): updates and gang rebuilds return pods to their prior nodes when
+    capacity allows, minimizing topology churn."""
+
+    def one_cpu_nodes(self, n):
+        return make_nodes(n, racks_per_block=2, hosts_per_rack=4,
+                          allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0})
+
+    def placements(self, h):
+        return {p.metadata.name: p.node_name for p in h.store.list(Pod.KIND)}
+
+    def test_rr1_update_replacements_return_to_prior_nodes(self):
+        h = Harness(nodes=self.one_cpu_nodes(8))
+        # confine initial placement to the high nodes, then open the low
+        # ones: naive re-placement of replacements would prefer fresh
+        # low-index nodes, so staying put proves the reuse path
+        for i in range(4):
+            h.cluster.cordon(f"node-{i}")
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=1.0)]))
+        h.settle()
+        before = self.placements(h)
+        assert all(before.values())
+        for i in range(4):
+            h.cluster.uncordon(f"node-{i}")
+        h.settle()
+        bump_image(h)
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        after = self.placements(h)
+        assert after == before, f"{before} -> {after}"
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.rolling_update_progress.completed
+
+    def test_rr2_gang_rebuild_returns_to_reserved_nodes(self):
+        from grove_tpu.api.podgang import PodGang
+
+        h = Harness(nodes=self.one_cpu_nodes(8))
+        for i in range(4):
+            h.cluster.cordon(f"node-{i}")  # rack 0 off: placement in rack 1
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        ref = gang.spec.reuse_reservation_ref
+        assert ref is not None and ref.name == "simple1-0"
+        before = self.placements(h)
+        for i in range(4):
+            h.cluster.uncordon(f"node-{i}")
+        h.settle()
+        # crash -> breach -> gang termination -> full replica rebuild
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        h.advance(61.0)
+        h.settle()
+        after = self.placements(h)
+        assert set(after) == set(before)
+        assert after == before, (
+            f"rebuilt gang abandoned its reservation: {before} -> {after}"
+        )
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_rr3_reservation_never_inverts_priority(self):
+        """The reserve pre-pass is a priority-prefix: a reserved
+        low-priority gang must NOT bind ahead of a higher-priority gang
+        without a reservation (both fall through to the priority-ordered
+        general solve)."""
+        import numpy as np
+
+        from grove_tpu.api.meta import NamespacedName, ObjectMeta
+        from grove_tpu.api.podgang import PodGang, PodGangSpec
+        from grove_tpu.solver import SolverGang
+
+        h = Harness(nodes=self.one_cpu_nodes(4))
+        sched = h.scheduler
+        snapshot = h.cluster.topology_snapshot()
+        free = snapshot.free.copy()
+
+        def sg(name, priority):
+            return SolverGang(
+                name=name, namespace="default",
+                demand=np.asarray([[1.0, 0.0, 0.0]], np.float32),
+                pod_names=[f"{name}-p0"],
+                group_ids=np.zeros(1, np.int32), group_names=["g0"],
+                group_required_level=np.array([-1], np.int32),
+                group_preferred_level=np.array([-1], np.int32),
+                priority=priority,
+            )
+
+        def pg(name, ref=None):
+            g = PodGang(metadata=ObjectMeta(name=name, namespace="default"))
+            if ref:
+                g.spec = PodGangSpec(reuse_reservation_ref=NamespacedName(
+                    namespace="default", name=ref))
+            return g
+
+        sched._reservations[("default", "lo")] = ("node-0",)
+        by_name = {"hi": pg("hi"), "lo": pg("lo", ref="lo")}
+        before = free.copy()
+        remaining = sched._try_reserved(
+            [sg("lo", 0.0), sg("hi", 10.0)], by_name, snapshot, free
+        )
+        # hi (no reservation) is first in priority order -> pre-pass stops
+        # immediately; NOTHING binds and free capacity is untouched
+        assert [g.name for g in remaining] == ["hi", "lo"]
+        np.testing.assert_allclose(free, before)
