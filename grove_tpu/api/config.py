@@ -54,6 +54,10 @@ class SolverConfig:
     commit_chunk: int = 32         # gangs per commit-scan step
     gang_bucket_minimum: int = 8   # smallest padded backlog bucket
     native_repair: bool = True     # use the C++ exact-commit path
+    # Priority preemption (the reclaim the reference outsources to KAI):
+    # capacity-starved higher-priority gangs may evict lower-priority
+    # SCALED gangs (never base gangs) and re-solve.
+    preemption_enabled: bool = True
 
 
 @dataclass
@@ -204,6 +208,8 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             )
     if not isinstance(sv.native_repair, bool):
         errs.append("config.solver.native_repair: must be a bool")
+    if not isinstance(sv.preemption_enabled, bool):
+        errs.append("config.solver.preemption_enabled: must be a bool")
 
     if not _num(cfg.autoscaler.tolerance) or not (0 <= cfg.autoscaler.tolerance < 1):
         errs.append("config.autoscaler.tolerance: must be in [0, 1)")

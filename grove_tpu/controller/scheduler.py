@@ -89,6 +89,12 @@ class GangScheduler:
         #: replacement binds back onto the node its predecessor vacated
         #: when it still fits (pod-level reservation reuse)
         self._vacated: dict[tuple[str, str], str] = {}
+        self.preemption_enabled = cfg.solver.preemption_enabled
+        #: gangs an eviction round already ran for — one preemption attempt
+        #: per stay in the backlog (cleared when the gang schedules or
+        #: leaves), so topology-infeasible preemptors cannot thrash the
+        #: same victims every retry tick
+        self._preempted_for: set[tuple[str, str]] = set()
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == PodGang.KIND:
@@ -143,6 +149,11 @@ class GangScheduler:
                     dirty_scheduled.append(gang)
             elif self._gang_ready_to_schedule(gang):
                 backlog_keys.append(key)
+        # one preemption attempt per BACKLOG STAY: a gang that left the
+        # backlog (deleted, or scheduled elsewhere, or pods gone) gets a
+        # fresh attempt on return — and the set cannot leak across gang
+        # churn
+        self._preempted_for &= set(backlog_keys)
         needs_solve = bool(backlog_keys) or any(
             self._has_unbound_referenced_pod(g) for g in dirty_scheduled
         )
@@ -164,13 +175,14 @@ class GangScheduler:
                 self.store.get(PodGang.KIND, ns, name)
                 for ns, name in backlog_keys
             ]
-            solver_gangs = encode_podgangs(
+            encoded = encode_podgangs(
                 backlog, snapshot, demand_fn, priority_of=self._priority_of,
                 pod_scheduling=sched_fn,
             )
+            solver_by_name = {g.name: g for g in encoded}
             by_name = {g.metadata.name: g for g in backlog}
             solver_gangs = self._try_reserved(
-                solver_gangs, by_name, snapshot, free
+                encoded, by_name, snapshot, free
             )
             result = engine.solve(solver_gangs, free=free)
             self.log.debug(
@@ -206,6 +218,11 @@ class GangScheduler:
                         gang, REASON_PODGANG_UNSCHEDULABLE, reason
                     )
                 requeue = self.retry_seconds
+            if self.preemption_enabled and result.unplaced:
+                self._preempt(
+                    result, by_name, solver_by_name, snapshot, free,
+                    demand_fn,
+                )
 
         self._bind_best_effort(
             dirty_scheduled, snapshot, free, demand_fn, sched_fn, engine
@@ -341,6 +358,174 @@ class GangScheduler:
             )
         return []
 
+    # -- priority preemption (the reclaim the reference outsources to KAI;
+    # SURVEY §2: Grove hands PodGangs to an external scheduler that owns
+    # reclaim between priority queues — grove_tpu owns the scheduler, so it
+    # owns reclaim) ----------------------------------------------------------
+    def _preempt(
+        self, result, by_name, solver_by_name, snapshot, free, demand_fn
+    ) -> bool:
+        """Evict lower-priority SCALED gangs to make room for
+        capacity-starved higher-priority gangs. BASE gangs are never
+        victims: evicting one would collapse a workload below its gang
+        minimum, while a scaled gang is by definition capacity beyond
+        minAvailable.
+
+        Disruption-minimizing accounting: a victim pod's capacity counts
+        only if the preemptor could actually use its node (eligibility
+        masks honored; attributed to the node's domain at the preemptor's
+        REQUIRED pack level), and eviction happens only once residual free
+        + freed capacity covers the preemptor's demand within one such
+        domain — victims that cannot help are never disturbed. Preemptors
+        claim the eviction budget in priority order; one attempt per
+        preemptor per backlog stay (no thrash when the preemptor stays
+        infeasible for deeper reasons)."""
+        evictable: list[tuple[float, str, PodGang]] = []
+        for gang in self.store.scan(PodGang.KIND):
+            if gang.metadata.deletion_timestamp is not None:
+                continue
+            if not gang.metadata.labels.get(constants.LABEL_BASE_PODGANG):
+                continue  # only SCALED gangs are reclaim victims
+            if not _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+                continue
+            evictable.append(
+                (self._priority_of(gang), gang.metadata.name, gang)
+            )
+        if not evictable:
+            return False
+        evictable.sort(key=lambda t: (t[0], t[1]))  # cheapest victims first
+        node_index = {n: i for i, n in enumerate(snapshot.node_names)}
+        sched_free = np.where(snapshot.schedulable[:, None], free, 0.0)
+        evicted_any = False
+        starved = [
+            (name, reason)
+            for name, reason in result.unplaced.items()
+            if reason == "no feasible domain" and name in by_name
+        ]  # unresolved-topology holds are not capacity problems
+        starved.sort(
+            key=lambda kv: (-self._priority_of(by_name[kv[0]]), kv[0])
+        )
+        for name, _reason in starved:
+            pg, sg = by_name.get(name), solver_by_name.get(name)
+            if pg is None or sg is None:
+                continue
+            key = (pg.metadata.namespace, name)
+            if key in self._preempted_for:
+                continue
+            prio = self._priority_of(pg)
+            need = sg.total_demand()
+            # nodes the preemptor could run on at all
+            if sg.pod_elig is None or any(m is None for m in sg.pod_elig):
+                usable = np.ones(snapshot.num_nodes, dtype=bool)
+            else:
+                usable = np.zeros(snapshot.num_nodes, dtype=bool)
+                for m in sg.pod_elig:
+                    usable |= m
+            # capacity buckets: one per domain at the preemptor's required
+            # level (freed capacity in the wrong rack cannot satisfy a
+            # rack-packed gang); level -1 = one global bucket
+            level = sg.required_level
+            dom_of = (
+                snapshot.domain_ids[level]
+                if level >= 0
+                else np.zeros(snapshot.num_nodes, dtype=np.int32)
+            )
+            avail: dict[int, np.ndarray] = {}
+            for dom in np.unique(dom_of):
+                sel = (dom_of == dom) & usable
+                avail[int(dom)] = sched_free[sel].sum(axis=0)
+            freed: dict[int, np.ndarray] = {}
+            chosen: list[PodGang] = []
+            satisfied = False
+            for vprio, vname, victim in evictable:
+                if vprio >= prio:
+                    break  # sorted: no cheaper victims remain
+                contrib: dict[int, np.ndarray] = {}
+                for group in victim.spec.pod_groups:
+                    for ref in group.pod_references:
+                        pod = self.store.peek(
+                            Pod.KIND, ref.namespace, ref.name
+                        )
+                        if pod is None or not pod.node_name:
+                            continue
+                        i = node_index.get(pod.node_name)
+                        if i is None or not usable[i]:
+                            continue
+                        d = demand_fn(ref.namespace, ref.name)
+                        if d is None:
+                            continue
+                        dom = int(dom_of[i])
+                        cur = contrib.get(dom)
+                        contrib[dom] = d if cur is None else cur + d
+                if not contrib:
+                    continue  # victim frees nothing the preemptor can use
+                chosen.append(victim)
+                for dom, vec in contrib.items():
+                    cur = freed.get(dom)
+                    freed[dom] = vec if cur is None else cur + vec
+                if any(
+                    (avail[dom] + vec + 1e-9 >= need).all()
+                    for dom, vec in freed.items()
+                ):
+                    satisfied = True
+                    break
+            if not chosen or not satisfied:
+                continue  # no victim set makes the preemptor feasible
+            self._preempted_for.add(key)
+            chosen_names = {v.metadata.name for v in chosen}
+            evictable = [
+                t for t in evictable if t[1] not in chosen_names
+            ]
+            for victim in chosen:
+                self._evict(victim, preemptor=name)
+            evicted_any = True
+        return evicted_any
+
+    def _evict(self, gang: PodGang, preemptor: str) -> None:
+        """Preemption eviction: mark DisruptionTarget (the same signal the
+        gang-termination path raises before disruption, podgang.go:156-169),
+        drop the Scheduled condition so the gang re-queues as a whole at
+        its own priority, and delete its bound pods to release capacity
+        (the owning clique recreates them)."""
+        ns = gang.metadata.namespace
+        now = self.store.clock.now()
+        msg = f"preempted by higher-priority gang {preemptor}"
+
+        def mutate(status):
+            status.phase = PodGangPhase.PENDING
+            status.placement_score = None
+            set_condition(
+                status.conditions,
+                PodGangConditionType.DISRUPTION_TARGET.value,
+                "True",
+                reason="Preempted",
+                message=msg,
+                now=now,
+            )
+            set_condition(
+                status.conditions,
+                PodGangConditionType.SCHEDULED.value,
+                "False",
+                reason="Preempted",
+                message=msg,
+                now=now,
+            )
+
+        self.store.patch_status(PodGang.KIND, ns, gang.metadata.name, mutate)
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
+                if pod is not None and pod.metadata.deletion_timestamp is None:
+                    self.store.delete(Pod.KIND, ref.namespace, ref.name)
+        # the victim must re-queue through the general solve, not snipe its
+        # old nodes back from the preemptor via reservation reuse
+        self._reservations.pop((ns, gang.metadata.name), None)
+        self.metrics.counter(
+            "grove_scheduler_preemptions_total",
+            "scaled gangs evicted for higher-priority gangs",
+        ).inc()
+        self.recorder.warning(gang, "Preempted", msg)
+
     # -- binding ------------------------------------------------------------
     def _bind(self, gang: PodGang, placement) -> None:
         ns = gang.metadata.namespace
@@ -351,6 +536,7 @@ class GangScheduler:
         self._reservations[(ns, gang.metadata.name)] = tuple(
             sorted(set(placement.pod_to_node.values()))
         )
+        self._preempted_for.discard((ns, gang.metadata.name))
         gang.status.placement_score = placement.placement_score
         gang.status.phase = PodGangPhase.STARTING
         set_condition(
@@ -360,6 +546,19 @@ class GangScheduler:
             reason="Placed",
             now=self.store.clock.now(),
         )
+        if get_condition(
+            gang.status.conditions,
+            PodGangConditionType.DISRUPTION_TARGET.value,
+        ) is not None:
+            # a previously-preempted (or disruption-marked) gang that
+            # re-places is no longer a disruption target
+            set_condition(
+                gang.status.conditions,
+                PodGangConditionType.DISRUPTION_TARGET.value,
+                "False",
+                reason="Placed",
+                now=self.store.clock.now(),
+            )
         self.store.update_status(gang)
         self.metrics.counter(
             "grove_scheduler_gangs_scheduled_total", "gangs bound to nodes"

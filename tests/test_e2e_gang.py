@@ -300,3 +300,195 @@ class TestGS_PriorityClass:
                           value=7.0, global_default=True)
         )
         assert prio(gang_with(None)) == 7.0
+
+
+class TestPP_PriorityPreemption:
+    """Priority preemption (exceeds the reference, which outsources
+    reclaim to KAI): capacity-starved higher-priority gangs evict
+    lower-priority SCALED gangs — never base gangs."""
+
+    def full_cluster(self):
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        # 4 one-cpu nodes, fully packed by a low-priority PCS:
+        # base gang (grp-0: 2 pods) + scaled gang (grp-1: 2 pods)
+        h = Harness(nodes=make_nodes(
+            4, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}))
+        low = simple_pcs(
+            name="low",
+            cliques=[clique("w", replicas=2, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1)],
+        )
+        h.apply(low)
+        h.settle()
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+        return h
+
+    def high_pcs(self, pods=2):
+        hi = simple_pcs(name="hi", cliques=[clique("w", replicas=pods,
+                                                   cpu=1.0)])
+        hi.spec.template.priority_class_name = "gold"
+        return hi
+
+    def seed_gold(self, h):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+
+        h.store.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=1000.0))
+
+    def test_pp1_high_priority_evicts_scaled_gang_never_base(self):
+        h = self.full_cluster()
+        self.seed_gold(h)
+        h.apply(self.high_pcs(pods=2))
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        # the high-priority gang is placed...
+        hi_pods = h.store.list(Pod.KIND, labels={constants.LABEL_PART_OF: "hi"})
+        assert len(hi_pods) == 2 and all(p.node_name for p in hi_pods)
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert cond(hi_gang, PodGangConditionType.SCHEDULED.value).status == "True"
+        # ...the low-priority BASE gang is untouched...
+        base = h.store.get(PodGang.KIND, "default", "low-0")
+        assert cond(base, PodGangConditionType.SCHEDULED.value).status == "True"
+        base_pods = [
+            p for p in h.store.list(Pod.KIND,
+                                    labels={constants.LABEL_PART_OF: "low"})
+            if p.metadata.labels.get(constants.LABEL_PODGANG) == "low-0"
+        ]
+        assert base_pods and all(p.node_name for p in base_pods)
+        # ...and the SCALED gang was the victim: DisruptionTarget marked,
+        # unscheduled, waiting for capacity
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        dt = cond(scaled, PodGangConditionType.DISRUPTION_TARGET.value)
+        assert dt is not None and dt.status == "True" and dt.reason == "Preempted"
+        sched = cond(scaled, PodGangConditionType.SCHEDULED.value)
+        assert sched.status == "False"
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 1
+
+    def test_pp2_victim_returns_when_capacity_appears(self):
+        h = self.full_cluster()
+        self.seed_gold(h)
+        h.apply(self.high_pcs(pods=2))
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        # new capacity arrives -> the evicted scaled gang re-places and its
+        # DisruptionTarget clears
+        for node in make_nodes(2, name_prefix="extra",
+                               allocatable={"cpu": 1.0, "memory": 8.0,
+                                            "tpu": 0.0}):
+            h.store.create(node)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        assert cond(scaled, PodGangConditionType.SCHEDULED.value).status == "True"
+        dt = cond(scaled, PodGangConditionType.DISRUPTION_TARGET.value)
+        assert dt is not None and dt.status == "False"
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+
+    def test_pp3_no_eviction_when_victims_cannot_free_enough(self):
+        h = self.full_cluster()
+        self.seed_gold(h)
+        # needs 4 cpu; evicting the only scaled gang frees 2 -> pointless
+        # disruption must NOT happen
+        h.apply(self.high_pcs(pods=4))
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        assert cond(scaled, PodGangConditionType.SCHEDULED.value).status == "True"
+        dt = cond(scaled, PodGangConditionType.DISRUPTION_TARGET.value)
+        assert dt is None or dt.status != "True"
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 0
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert cond(hi_gang, PodGangConditionType.SCHEDULED.value).status == "False"
+
+    def test_pp4_equal_priority_never_preempts(self):
+        h = self.full_cluster()
+        hi = simple_pcs(name="hi", cliques=[clique("w", replicas=2, cpu=1.0)])
+        h.apply(hi)  # same (zero) priority as "low"
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 0
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        assert cond(scaled, PodGangConditionType.SCHEDULED.value).status == "True"
+
+    def test_pp5_residual_free_counts_toward_feasibility(self):
+        """Freed victim capacity PLUS residual free capacity makes the
+        preemptor feasible: 1 free cpu + 1 evicted cpu covers a 2-cpu
+        gang (review finding: freed-alone accounting refused this)."""
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        h = Harness(nodes=make_nodes(
+            4, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}))
+        low = simple_pcs(
+            name="low",
+            cliques=[clique("w", replicas=1, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1)],
+        )
+        h.apply(low)  # base 1 + scaled 1 -> 3 nodes used... (w replicas=1)
+        h.settle()
+        used = sum(1 for p in h.store.list(Pod.KIND) if p.node_name)
+        assert used == 2  # base gang pod + scaled gang pod; 2 cpu free? no: 4-2=2
+        # fill one more node with a second scaled replica
+        pcsg = h.store.get("PodCliqueScalingGroup", "default", "low-0-grp")
+        pcsg.spec.replicas = 3
+        h.store.update(pcsg)
+        h.settle()
+        assert sum(1 for p in h.store.list(Pod.KIND) if p.node_name) == 3
+        self.seed_gold(h)
+        h.apply(self.high_pcs(pods=2))  # needs 2; 1 free + 1 evictable
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert cond(hi_gang, PodGangConditionType.SCHEDULED.value).status == "True"
+        # exactly ONE scaled gang evicted (not both)
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 1
+
+    def test_pp6_no_eviction_of_victims_preemptor_cannot_use(self):
+        """A selector-pinned preemptor must not destroy scaled gangs whose
+        nodes it could never run on (review finding: eligibility-blind
+        freed accounting evicted them anyway)."""
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        nodes = make_nodes(4, racks_per_block=2, hosts_per_rack=2,
+                           allocatable={"cpu": 1.0, "memory": 8.0,
+                                        "tpu": 0.0})
+        for n in nodes[:2]:
+            n.metadata.labels["pool"] = "a"
+        h = Harness(nodes=nodes)
+        # pool a fully used by a base gang (unevictable); pool b holds a
+        # low-priority scaled gang
+        occupier = simple_pcs(name="occ",
+                              cliques=[clique("w", replicas=2, cpu=1.0)])
+        occupier.spec.template.cliques[0].spec.pod_spec.node_selector = {
+            "pool": "a"}
+        h.apply(occupier)
+        low = simple_pcs(
+            name="low",
+            cliques=[clique("w", replicas=1, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1)],
+        )
+        h.apply(low)
+        h.settle()
+        self.seed_gold(h)
+        hi = self.high_pcs(pods=1)
+        hi.spec.template.cliques[0].spec.pod_spec.node_selector = {"pool": "a"}
+        h.apply(hi)
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        # pool b's scaled gang untouched; preemptor waits
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 0
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        assert cond(scaled, PodGangConditionType.SCHEDULED.value).status == "True"
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert cond(hi_gang, PodGangConditionType.SCHEDULED.value).status == "False"
