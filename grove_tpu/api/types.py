@@ -52,6 +52,11 @@ class PodSpec:
     hostname: str = ""
     subdomain: str = ""
     tolerations: list[str] = field(default_factory=list)
+    # The identity the pod's startup-barrier watcher authenticates with —
+    # set by the pod component to the PCS's ServiceAccount, whose
+    # Role/RoleBinding grant pods list/watch (components/satokensecret/,
+    # initc/internal/wait.go:76-90)
+    service_account_name: str = ""
 
     def total_requests(self) -> dict[str, float]:
         out: dict[str, float] = {}

@@ -47,6 +47,8 @@ class SimKubelet:
         # keyed by pod UID: a replacement pod reusing a hole-filled NAME
         # must start clean, exactly like a fresh pod in a real cluster
         self._crashed: set[str] = set()
+        #: namespace -> {sa: granted rules}, rebuilt lazily per tick
+        self._authz_cache: dict[str, dict[str, set[str]]] = {}
 
     def crash_pod(self, namespace: str, name: str) -> None:
         """Container crash: pod stays bound/Running but NotReady until
@@ -83,6 +85,7 @@ class SimKubelet:
         whole startsAfter chain would cascade to ready within one tick,
         which no real cluster does (informer propagation delay)."""
         changes = 0
+        self._authz_cache.clear()
         # no-copy scans: decisions read live state; mutations re-fetch a
         # real copy below (list()'s defensive copies of every pod per tick
         # dominated settle wall-clock at control-plane scale)
@@ -131,8 +134,22 @@ class SimKubelet:
 
     def _barrier_open(self, pod, ready_set: set[tuple[str, str]]) -> bool:
         """initc equivalent: all parent cliques have >= min ready pods (as
-        of tick start)."""
+        of tick start). The watch runs AS the pod's ServiceAccount
+        identity (the token secret the reference mounts for grove-initc,
+        initc/internal/wait.go:76-90): without a RoleBinding granting
+        pods watch, the barrier cannot observe its parents and stays
+        closed — RBAC is enforced, not decorative."""
         spec = pod.metadata.annotations.get(constants.ANNOTATION_WAIT_FOR, "")
+        if not spec:
+            return True
+        ns = pod.metadata.namespace
+        sa = pod.spec.service_account_name
+        if sa:
+            grants = self._authz_cache.get(ns)
+            if grants is None:
+                grants = self._authz_cache[ns] = self.store.read_grants(ns)
+            if "pods:watch" not in grants.get(sa, ()):
+                return False  # Forbidden: cannot observe parents
         for pclq_fqn, min_available in parse_wait_for(spec):
             ready = sum(
                 1

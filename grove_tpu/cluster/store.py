@@ -208,6 +208,46 @@ class ObjectStore:
         if self.authorizer is not None:
             self.authorizer(self.actor, verb, obj)
 
+    def authorize_read(
+        self, actor: str, verb: str, resource: str, namespace: str
+    ) -> None:
+        """RBAC read check for service-account identities (the token the
+        reference's startup-barrier watcher authenticates with,
+        initc/internal/wait.go:76-90). A `system:serviceaccount:<ns>:<sa>`
+        actor needs a RoleBinding in `namespace` to a Role whose rules
+        include `<resource>:<verb>`; raises Forbidden otherwise. Non-SA
+        actors (operator, tests at the kubectl boundary, GC) are not
+        constrained by namespace Roles — matching how the reference's
+        operator runs with its own cluster-wide RBAC."""
+        prefix = f"system:serviceaccount:{namespace}:"
+        if not actor.startswith("system:serviceaccount:"):
+            return
+        if not actor.startswith(prefix):
+            raise Forbidden(
+                f"{actor}: cross-namespace access to {namespace} denied"
+            )
+        sa_name = actor[len(prefix):]
+        want = f"{resource}:{verb}"
+        if want not in self.read_grants(namespace).get(sa_name, ()):
+            raise Forbidden(
+                f"{actor} cannot {verb} {resource} in namespace {namespace}: "
+                "no RoleBinding grants it"
+            )
+
+    def read_grants(self, namespace: str) -> dict[str, set[str]]:
+        """service-account name -> union of granted `resource:verb` rules
+        in the namespace (via RoleBindings -> Roles). One call resolves
+        every SA, so per-tick consumers (the kubelet barrier) stay
+        O(#RoleBindings) per namespace instead of re-scanning per SA."""
+        out: dict[str, set[str]] = {}
+        for rb in self.scan("RoleBinding", namespace=namespace):
+            role = self.peek("Role", namespace, rb.role_name)
+            if role is not None:
+                out.setdefault(rb.service_account_name, set()).update(
+                    role.rules
+                )
+        return out
+
     # -- label index --------------------------------------------------------
     def _index_add(self, kind: str, key: tuple[str, str], obj: Any) -> None:
         for lk, lv in obj.metadata.labels.items():

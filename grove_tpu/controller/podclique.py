@@ -230,6 +230,10 @@ class PodCliqueReconciler:
         spec.scheduling_gates = [constants.PODGANG_PENDING_CREATION_GATE]
         spec.hostname = pod_name
         spec.subdomain = naming.headless_service_name(pcs_name, int(replica))
+        if pcs_name and not spec.service_account_name:
+            # the per-PCS identity whose Role grants the startup-barrier
+            # watcher its pod list/watch (components/satokensecret/)
+            spec.service_account_name = f"{pcs_name}-sa"
         env = {
             constants.ENV_PCS_NAME: pcs_name,
             constants.ENV_PCS_INDEX: replica,

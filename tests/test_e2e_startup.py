@@ -95,3 +95,73 @@ class TestStartupOrdering:
             "simple1-0-parent:1"
         )
         assert pod.status.ready
+
+
+class TestRBACEnforcement:
+    """The RBAC trio is consumed, not decorative: the startup barrier's
+    pod watch runs as the pod's ServiceAccount identity, and a missing
+    RoleBinding leaves the watch Forbidden and the barrier closed
+    (reference: grove-initc authenticates its pod watches with the SA
+    token secret, initc/internal/wait.go:76-90)."""
+
+    def ordered_pcs(self):
+        return simple_pcs(
+            cliques=[clique("a"), clique("b", starts_after=["a"])],
+            startup=CliqueStartupType.EXPLICIT,
+        )
+
+    def test_pod_watch_without_role_is_forbidden(self):
+        from grove_tpu.cluster.store import Forbidden
+        import pytest
+
+        h = Harness(nodes=make_nodes(8))
+        h.apply(self.ordered_pcs())
+        h.settle()
+        # the provisioned identity is authorized...
+        h.store.authorize_read(
+            "system:serviceaccount:default:simple1-sa", "watch", "pods",
+            "default",
+        )
+        # ...an unprovisioned one is not, nor cross-namespace access
+        with pytest.raises(Forbidden):
+            h.store.authorize_read(
+                "system:serviceaccount:default:rogue-sa", "watch", "pods",
+                "default",
+            )
+        with pytest.raises(Forbidden):
+            h.store.authorize_read(
+                "system:serviceaccount:other:simple1-sa", "watch", "pods",
+                "default",
+            )
+        # non-SA actors (operator, users) are not constrained by ns roles
+        h.store.authorize_read("user", "watch", "pods", "default")
+
+    def test_missing_rolebinding_keeps_barrier_closed(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(self.ordered_pcs())
+        # let the control plane create+bind everything, but keep the
+        # kubelet from ticking so nothing is ready yet
+        h.manager.settle()
+        # revoke the grant with the operator "offline": only the kubelet
+        # runs, so the self-healing reconciler cannot restore the binding
+        h.store.delete("RoleBinding", "default", "simple1-pod-reader")
+        for _ in range(8):
+            h.kubelet.tick()
+        pods = {p.metadata.name: p for p in h.store.list(Pod.KIND)}
+        a_ready = [p.status.ready for n, p in pods.items() if "-a-" in n]
+        b_ready = [p.status.ready for n, p in pods.items() if "-b-" in n]
+        assert all(a_ready), "independent clique unaffected"
+        assert not any(b_ready), "Forbidden watch must keep the barrier closed"
+        # the operator comes back: RBAC self-heals (sync recreates the
+        # binding) and the barrier opens
+        h.settle()
+        assert h.store.get("RoleBinding", "default",
+                           "simple1-pod-reader") is not None
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_pods_carry_service_account_identity(self):
+        h = Harness(nodes=make_nodes(8))
+        h.apply(self.ordered_pcs())
+        h.settle()
+        for p in h.store.list(Pod.KIND):
+            assert p.spec.service_account_name == "simple1-sa"
