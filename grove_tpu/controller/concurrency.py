@@ -1,0 +1,63 @@
+"""Slow-start task batching (utils/concurrent.go:72-105).
+
+The reference protects the kube-apiserver from write storms by running
+create/delete tasks in exponentially growing batches (1 -> 2 -> 4 -> ...),
+halting at the first batch that errors and skipping the remainder — a
+failing apiserver (or webhook) sees one probe, not N simultaneous writes.
+The store here is in-process and strongly consistent, so the protection is
+about *pacing semantics*, not thread safety: a reconcile that hits a
+failing admission/authorization hook attempts one write, not its whole
+diff, and the manager's retry finds the remainder via the normal
+idempotent diff computation (hole-filling indices for creates, recomputed
+excess for deletes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+#: First batch size, like the reference's slow-start callers.
+INITIAL_BATCH_SIZE = 1
+
+
+@dataclass
+class RunResult:
+    """Aggregated outcome of a slow-start run."""
+
+    succeeded: list[str] = field(default_factory=list)
+    #: (task name, exception) for every task of the failing batch that
+    #: raised; tasks after that batch are skipped, not attempted
+    errors: list[tuple[str, Exception]] = field(default_factory=list)
+    skipped: list[str] = field(default_factory=list)
+
+    @property
+    def has_errors(self) -> bool:
+        return bool(self.errors)
+
+
+def run_with_slow_start(
+    tasks: list[tuple[str, Callable[[], None]]],
+    initial_batch_size: int = INITIAL_BATCH_SIZE,
+) -> RunResult:
+    """Run (name, fn) tasks in exponentially growing batches; halt after
+    the first batch containing an error and mark the rest skipped."""
+    result = RunResult()
+    i = 0
+    batch = max(1, min(initial_batch_size, len(tasks)))
+    while i < len(tasks):
+        failed = False
+        for name, fn in tasks[i : i + batch]:
+            try:
+                fn()
+            except Exception as err:  # collected, batch finishes
+                result.errors.append((name, err))
+                failed = True
+            else:
+                result.succeeded.append(name)
+        i += batch
+        if failed:
+            result.skipped.extend(name for name, _ in tasks[i:])
+            return result
+        batch = min(batch * 2, len(tasks) - i) or 1
+    return result

@@ -30,6 +30,7 @@ from ..api.types import (
 )
 from ..cluster.store import Event, ObjectStore, clone
 from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
+from .concurrency import run_with_slow_start
 from ..observability.events import EventRecorder, REASON_CREATE_SUCCESSFUL
 from .errors import GroveError, clear_status_errors, record_status_error
 from .runtime import Request, Result
@@ -175,14 +176,36 @@ class PodCliqueReconciler:
                         if i not in used][:count]
         pcs = self._owner_pcs(pclq)
         sg_num_pods = self._pcsg_template_num_pods(pclq, pcs)
-        for idx in free_indices:
-            pod = self._build_pod(pclq, pcs, idx, sg_num_pods)
-            self.store.create(pod)
-        if free_indices:
+        # slow-start pacing (utils/concurrent.go:72-105): a failing
+        # admission/authz hook sees one probe create, not the whole diff;
+        # the skipped remainder is recomputed idempotently on retry
+        result = run_with_slow_start(
+            [
+                (
+                    naming.pod_name(pclq.metadata.name, idx),
+                    lambda idx=idx: self.store.create(
+                        self._build_pod(pclq, pcs, idx, sg_num_pods)
+                    ),
+                )
+                for idx in free_indices
+            ]
+        )
+        if result.succeeded:
             self.recorder.normal(
                 pclq,
                 REASON_CREATE_SUCCESSFUL,
-                f"created {len(free_indices)} pod(s) (scheduling gated)",
+                f"created {len(result.succeeded)} pod(s) (scheduling gated)",
+            )
+        if result.has_errors:
+            detail = "; ".join(f"{n}: {e}" for n, e in result.errors)
+            raise GroveError(
+                code="ERR_CREATE_PODS",
+                operation="Sync",
+                message=(
+                    f"{len(result.errors)} create(s) failed ({detail}); "
+                    f"{len(result.skipped)} skipped by slow start"
+                ),
+                cause=result.errors[0][1],
             )
 
     def _pcsg_template_num_pods(
@@ -368,8 +391,28 @@ class PodCliqueReconciler:
                 -int(p.metadata.labels.get(constants.LABEL_POD_INDEX, 0)),
             )
 
-        for pod in sorted(active, key=sort_key)[:count]:
-            self.store.delete(Pod.KIND, pclq.metadata.namespace, pod.metadata.name)
+        result = run_with_slow_start(
+            [
+                (
+                    pod.metadata.name,
+                    lambda name=pod.metadata.name: self.store.delete(
+                        Pod.KIND, pclq.metadata.namespace, name
+                    ),
+                )
+                for pod in sorted(active, key=sort_key)[:count]
+            ]
+        )
+        if result.has_errors:
+            detail = "; ".join(f"{n}: {e}" for n, e in result.errors)
+            raise GroveError(
+                code="ERR_DELETE_PODS",
+                operation="Sync",
+                message=(
+                    f"{len(result.errors)} delete(s) failed ({detail}); "
+                    f"{len(result.skipped)} skipped by slow start"
+                ),
+                cause=result.errors[0][1],
+            )
 
     def _remove_gates(self, pclq: PodClique) -> None:
         """syncflow.go:242-394. Base-gang pods ungate once referenced in

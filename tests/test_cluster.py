@@ -254,3 +254,74 @@ class TestManagerErrorBound:
         # bounded: at most 2x the per-key allowance after compaction cycles
         assert len(mgr.errors) <= 2 * mgr.max_errors_per_key + 64
         assert all(c == "broken" for c, _, _ in mgr.errors)
+
+
+class TestSlowStartBatching:
+    """Slow-start create/delete pacing (utils/concurrent.go:72-105): a
+    failing write path sees one probe, not the whole diff."""
+
+    def test_batches_grow_exponentially(self):
+        from grove_tpu.controller.concurrency import run_with_slow_start
+
+        calls = []
+        tasks = [(f"t{i}", lambda i=i: calls.append(i)) for i in range(11)]
+        result = run_with_slow_start(tasks)
+        assert calls == list(range(11))
+        assert len(result.succeeded) == 11
+        assert not result.has_errors and not result.skipped
+
+    def test_halts_after_failing_batch_and_skips_rest(self):
+        from grove_tpu.controller.concurrency import run_with_slow_start
+
+        calls = []
+
+        def ok(i):
+            calls.append(i)
+
+        def boom(i):
+            calls.append(i)
+            raise RuntimeError("apiserver down")
+
+        # batches: [0], [1,2], [3,4,5,6] — task 4 fails; batch finishes
+        # (5, 6 still attempted), tasks 7..10 are skipped
+        tasks = [(f"t{i}", (lambda i=i: boom(i)) if i == 4 else
+                  (lambda i=i: ok(i))) for i in range(11)]
+        result = run_with_slow_start(tasks)
+        assert calls == [0, 1, 2, 3, 4, 5, 6]
+        assert [n for n, _ in result.errors] == ["t4"]
+        assert result.skipped == ["t7", "t8", "t9", "t10"]
+
+    def test_failing_pod_admission_sees_one_probe_create(self):
+        from grove_tpu.api import constants
+        from grove_tpu.api.types import Pod, PodClique
+        from grove_tpu.cluster import make_nodes
+        from grove_tpu.cluster.store import Admission
+        from grove_tpu.controller import Harness
+
+        h = Harness(nodes=make_nodes(8))
+        attempts = []
+
+        from grove_tpu.api.validation import ValidationError
+
+        def reject(pod):
+            attempts.append(pod.metadata.name)
+            raise ValidationError(["pod quota exhausted"])
+
+        h.store.register_admission("Pod", Admission(validate=reject))
+        from test_e2e_basic import clique as e2e_clique, simple_pcs as e2e_pcs
+
+        h.apply(e2e_pcs(cliques=[e2e_clique("w", replicas=8)]))
+        h.settle()
+        # slow start probes with ONE create per reconcile, never the
+        # whole 8-pod diff (a second reconcile may re-probe once)
+        assert set(attempts) == {"simple1-0-w-0"}, attempts
+        assert len(attempts) <= 3
+        assert len(h.store.list(Pod.KIND)) == 0
+        pclq = h.store.get(PodClique.KIND, "default", "simple1-0-w")
+        assert pclq.status.last_errors
+        assert "skipped by slow start" in pclq.status.last_errors[0].description
+        # quota returns -> retry interval recreates everything
+        h.store.register_admission("Pod", Admission())
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        assert len(h.store.list(Pod.KIND)) == 8
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
