@@ -35,6 +35,25 @@ class RunResult:
     def has_errors(self) -> bool:
         return bool(self.errors)
 
+    def raise_if_errors(self, code: str, verb: str,
+                        operation: str = "Sync") -> None:
+        """Aggregate every error of the failing batch into one GroveError
+        (first exception attached as cause) and raise it."""
+        if not self.errors:
+            return
+        from .errors import GroveError
+
+        detail = "; ".join(f"{n}: {e}" for n, e in self.errors)
+        raise GroveError(
+            code=code,
+            operation=operation,
+            message=(
+                f"{len(self.errors)} {verb}(s) failed ({detail}); "
+                f"{len(self.skipped)} skipped by slow start"
+            ),
+            cause=self.errors[0][1],
+        )
+
 
 def run_with_slow_start(
     tasks: list[tuple[str, Callable[[], None]]],

@@ -41,6 +41,7 @@ class Harness:
                 self.config.controllers.sync_retry_interval_seconds
             ),
             logger=self.cluster.logger.with_name("manager"),
+            metrics=self.cluster.metrics,
         )
         self.manager.register(
             PodCliqueSetReconciler(self.store, config=self.config)

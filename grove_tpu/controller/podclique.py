@@ -196,17 +196,7 @@ class PodCliqueReconciler:
                 REASON_CREATE_SUCCESSFUL,
                 f"created {len(result.succeeded)} pod(s) (scheduling gated)",
             )
-        if result.has_errors:
-            detail = "; ".join(f"{n}: {e}" for n, e in result.errors)
-            raise GroveError(
-                code="ERR_CREATE_PODS",
-                operation="Sync",
-                message=(
-                    f"{len(result.errors)} create(s) failed ({detail}); "
-                    f"{len(result.skipped)} skipped by slow start"
-                ),
-                cause=result.errors[0][1],
-            )
+        result.raise_if_errors("ERR_CREATE_PODS", "create")
 
     def _pcsg_template_num_pods(
         self, pclq: PodClique, pcs: PodCliqueSet | None
@@ -402,17 +392,7 @@ class PodCliqueReconciler:
                 for pod in sorted(active, key=sort_key)[:count]
             ]
         )
-        if result.has_errors:
-            detail = "; ".join(f"{n}: {e}" for n, e in result.errors)
-            raise GroveError(
-                code="ERR_DELETE_PODS",
-                operation="Sync",
-                message=(
-                    f"{len(result.errors)} delete(s) failed ({detail}); "
-                    f"{len(result.skipped)} skipped by slow start"
-                ),
-                cause=result.errors[0][1],
-            )
+        result.raise_if_errors("ERR_DELETE_PODS", "delete")
 
     def _remove_gates(self, pclq: PodClique) -> None:
         """syncflow.go:242-394. Base-gang pods ungate once referenced in

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
@@ -54,8 +55,13 @@ class Reconciler(Protocol):
 
 class ControllerManager:
     def __init__(self, store: ObjectStore, identity: str | None = None,
-                 error_retry_seconds: float = 5.0, logger=None):
+                 error_retry_seconds: float = 5.0, logger=None,
+                 metrics=None):
         self.store = store
+        #: observability.MetricsRegistry; the controller-runtime metrics
+        #: analog (workqueue depth, reconcile totals/errors/duration per
+        #: controller — manager.go exposes these via its metrics server)
+        self.metrics = metrics
         #: the operator's service-account identity: reconciles run
         #: impersonating it so the store's authorization hook can gate
         #: managed-resource mutation to the operator (+ exempt actors).
@@ -132,8 +138,18 @@ class ControllerManager:
         batch, self._queue = self._queue, []
         self._queued -= set(batch)
         by_name = {c.name: c for c in self.controllers}
+        m = self.metrics
+        if m is not None:
+            # set unconditionally: an idle round must read 0, not the last
+            # busy round's stale depth
+            m.gauge(
+                "grove_manager_workqueue_depth",
+                "requests drained into the current reconcile round",
+            ).set(float(len(batch)))
         for cname, req in batch:
             controller = by_name[cname]
+            t0 = time.perf_counter() if m is not None else 0.0
+            failed = False
             try:
                 if self.identity is not None:
                     with self.store.impersonate(self.identity):
@@ -163,6 +179,21 @@ class ControllerManager:
                     else:
                         recorder(req, err)
                 result = Result(requeue_after=self.error_retry_seconds)
+                failed = True
+            if m is not None:
+                m.counter(
+                    "grove_manager_reconcile_total",
+                    "reconciles executed per controller",
+                ).inc(controller=cname)
+                if failed or result.error:
+                    m.counter(
+                        "grove_manager_reconcile_errors_total",
+                        "failed reconciles per controller",
+                    ).inc(controller=cname)
+                m.histogram(
+                    "grove_manager_reconcile_duration_seconds",
+                    "wall seconds per reconcile",
+                ).observe(time.perf_counter() - t0, controller=cname)
             if result.error:
                 self._record_error_entry(cname, req, result.error)
             if self.logger is not None:

@@ -49,40 +49,48 @@ class Gauge:
 
 @dataclass
 class Histogram:
-    """Exact-percentile histogram. observe() is O(1) append; the sort is
-    deferred to the first percentile read after new observations, so
-    per-gang latency observation stays cheap at 10^5-gang scale (reads are
-    rare — bench/render time — writes are the hot path)."""
+    """Exact-percentile histogram with label support. observe() is O(1)
+    append; the sort is deferred to the first percentile read after new
+    observations, so per-gang latency observation stays cheap at
+    10^5-gang scale (reads are rare — bench/render time — writes are the
+    hot path). Label-less usage reads/writes the () series."""
 
     name: str
     help: str = ""
-    _obs: list[float] = field(default_factory=list)
-    _dirty: bool = False
+    _series: dict[tuple, list[float]] = field(default_factory=dict)
+    _dirty: set = field(default_factory=set)
 
-    def observe(self, value: float) -> None:
-        self._obs.append(value)
-        self._dirty = True
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        self._series.setdefault(key, []).append(value)
+        self._dirty.add(key)
+
+    def _obs_for(self, labels: dict[str, str] | None) -> list[float]:
+        return self._series.get(_label_key(labels), [])
 
     @property
     def count(self) -> int:
-        return len(self._obs)
+        return sum(len(o) for o in self._series.values())
 
     @property
     def sum(self) -> float:
-        return float(sum(self._obs))
+        return float(sum(sum(o) for o in self._series.values()))
 
     def mean(self) -> float:
-        return self.sum / self.count if self._obs else 0.0
+        return self.sum / self.count if self.count else 0.0
 
-    def percentile(self, q: float) -> float:
-        """q in [0, 100]; nearest-rank on the sorted observations."""
-        if not self._obs:
+    def percentile(self, q: float, **labels: str) -> float:
+        """q in [0, 100]; nearest-rank on the sorted observations of one
+        label series (the () series when unlabeled)."""
+        key = _label_key(labels)
+        obs = self._series.get(key)
+        if not obs:
             return 0.0
-        if self._dirty:
-            self._obs.sort()
-            self._dirty = False
-        idx = min(len(self._obs) - 1, max(0, round(q / 100 * (len(self._obs) - 1))))
-        return self._obs[int(idx)]
+        if key in self._dirty:
+            obs.sort()
+            self._dirty.discard(key)
+        idx = min(len(obs) - 1, max(0, round(q / 100 * (len(obs) - 1))))
+        return obs[int(idx)]
 
 
 class MetricsRegistry:
@@ -127,12 +135,19 @@ class MetricsRegistry:
                     lines.append(f"{name}{_fmt_labels(key)} {v}")
             else:
                 lines.append(f"# TYPE {name} summary")
-                for q in (50, 90, 99):
+                for key in sorted(m._series):
+                    labels = dict(key)
+                    for q in (50, 90, 99):
+                        qk = _fmt_labels(
+                            tuple(sorted({**labels,
+                                          "quantile": f"0.{q}"}.items()))
+                        )
+                        lines.append(f"{name}{qk} {m.percentile(q, **labels)}")
+                    obs = m._series[key]
                     lines.append(
-                        f'{name}{{quantile="0.{q}"}} {m.percentile(q)}'
+                        f"{name}_sum{_fmt_labels(key)} {float(sum(obs))}"
                     )
-                lines.append(f"{name}_sum {m.sum}")
-                lines.append(f"{name}_count {m.count}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {len(obs)}")
         return "\n".join(lines) + ("\n" if lines else "")
 
 

@@ -543,3 +543,56 @@ class TestRU_PodCliqueScaleRaces:
         pclq = h.store.get(PodClique.KIND, "default", "s-0-w")
         assert pclq.status.rolling_update_progress.completed
         assert pclq.status.updated_replicas == 2
+
+
+class TestRU_PodCliqueScaleBeforeUpdate:
+    """RU19/RU21: standalone-PCLQ scale BEFORE the update starts; the
+    resized clique then rolls to the new template exactly once."""
+
+    def apply_s(self, h):
+        h.apply(simple_pcs(name="s", cliques=[clique("w", replicas=3,
+                                                     min_available=2,
+                                                     cpu=1.0)]))
+        h.settle()
+
+    def scale_pclq(self, h, replicas):
+        pclq = h.store.get(PodClique.KIND, "default", "s-0-w")
+        pclq.spec.replicas = replicas
+        h.store.update(pclq)
+        h.settle()
+        h.advance(RETRY)
+
+    def finish(self, h, expect_pods):
+        h.settle()
+        h.advance(RETRY)
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == expect_pods
+        target = stable_hash(
+            h.store.get(PodCliqueSet.KIND, "default", "s")
+            .spec.template.cliques[0].spec.pod_spec
+        )
+        assert set(pod_hashes(h).values()) == {target}
+        assert all(p.status.ready for p in pods)
+        pclq = h.store.get(PodClique.KIND, "default", "s-0-w")
+        assert pclq.status.rolling_update_progress.completed
+        assert pclq.status.updated_replicas == expect_pods
+
+    def test_ru19_pclq_scale_out_before_update(self):
+        h = Harness(nodes=make_nodes(16))
+        self.apply_s(h)
+        self.scale_pclq(h, 5)
+        assert len(h.store.list(Pod.KIND)) == 5
+        before_uids = {p.metadata.uid for p in h.store.list(Pod.KIND)}
+        bump_image(h, "s")
+        self.finish(h, expect_pods=5)
+        # every pod was replaced exactly once (all new uids)
+        after_uids = {p.metadata.uid for p in h.store.list(Pod.KIND)}
+        assert not (before_uids & after_uids)
+
+    def test_ru21_pclq_scale_in_before_update(self):
+        h = Harness(nodes=make_nodes(16))
+        self.apply_s(h)
+        self.scale_pclq(h, 2)
+        assert len(h.store.list(Pod.KIND)) == 2
+        bump_image(h, "s")
+        self.finish(h, expect_pods=2)
