@@ -175,6 +175,7 @@ class ObjectStore:
         self._objs: dict[str, dict[tuple[str, str], Any]] = {}
         self._admission: dict[str, Admission] = {}
         self._events: list[Event] = []
+        self._kind_serial: dict[str, int] = {}
         self._seq = itertools.count(1)
         self._uid = itertools.count(1)
         #: authorize(actor, verb, obj) -> None | raise Forbidden. None =
@@ -286,9 +287,11 @@ class ObjectStore:
         """Append a watch event. The store is MVCC — every write REPLACES
         the stored object with a new version and never mutates old versions
         — so events reference versions directly; no snapshot copies."""
+        seq = next(self._seq)
+        self._kind_serial[obj.KIND] = seq
         self._events.append(
             Event(
-                seq=next(self._seq),
+                seq=seq,
                 type=type_,
                 kind=obj.KIND,
                 namespace=obj.metadata.namespace,
@@ -297,6 +300,13 @@ class ObjectStore:
                 old=old,
             )
         )
+
+    def kind_serial(self, kind: str) -> int:
+        """Monotonic change marker: the seq of the last write touching
+        this kind (0 = never written). Cheap cache key for derived state
+        that only depends on one kind (e.g. the topology encoding on
+        Node + ClusterTopology)."""
+        return self._kind_serial.get(kind, 0)
 
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Any | None:

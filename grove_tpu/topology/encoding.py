@@ -218,14 +218,11 @@ def encode_topology(
     free = np.zeros_like(capacity)
     schedulable = np.ones((n,), dtype=bool)
     for ni, node in enumerate(nodes):
-        used = usage.get(node.metadata.name, {})
         for ri, r in enumerate(resource_names):
-            cap = float(node.allocatable.get(r, 0.0))
-            capacity[ni, ri] = cap
-            free[ni, ri] = cap - float(used.get(r, 0.0))
+            capacity[ni, ri] = float(node.allocatable.get(r, 0.0))
         schedulable[ni] = not node.unschedulable and node.metadata.deletion_timestamp is None
 
-    return TopologySnapshot(
+    snapshot = TopologySnapshot(
         level_keys=level_keys,
         level_domains=level_domains,
         domain_ids=domain_ids,
@@ -239,3 +236,29 @@ def encode_topology(
         node_labels=[node.metadata.labels for node in nodes],
         node_taints=[list(node.taints) for node in nodes],
     )
+    apply_usage(snapshot, usage)
+    return snapshot
+
+
+def apply_usage(
+    snapshot: TopologySnapshot, usage: dict[str, dict[str, float]]
+) -> None:
+    """Refresh snapshot.free = capacity - usage in place. The ONE home of
+    the free-capacity accounting: the fresh encode above and the cluster's
+    cached-snapshot refresh (cluster.py topology_snapshot) both call it,
+    so usage semantics cannot silently diverge between cache hit and
+    miss. Also bounds the snapshot's eligibility-mask cache, which lives
+    as long as the (cached) snapshot does."""
+    np.copyto(snapshot.free, snapshot.capacity)
+    if usage:
+        res_index = {r: i for i, r in enumerate(snapshot.resource_names)}
+        for node_name, used in usage.items():
+            ni = snapshot.node_index.get(node_name)
+            if ni is None:
+                continue
+            for r, amount in used.items():
+                ri = res_index.get(r)
+                if ri is not None:
+                    snapshot.free[ni, ri] -= amount
+    if len(snapshot._elig_cache) > 1024:
+        snapshot._elig_cache.clear()
