@@ -350,3 +350,77 @@ class TestRR_ReservationReuse:
         # immediately; NOTHING binds and free capacity is untouched
         assert [g.name for g in remaining] == ["hi", "lo"]
         np.testing.assert_allclose(free, before)
+
+
+class TestOR_OperatorRestart:
+    """Checkpoint/resume analog (SURVEY §5): all orchestration progress
+    lives in CR status, so a fresh operator process (new Harness over the
+    same store) resumes mid-flight work — the reference's operator
+    restarts rely on exactly this (rolling-update progress in status,
+    podcliqueset.go:96-118; breach clocks in condition timestamps)."""
+
+    def restart(self, h):
+        """A brand-new manager/controllers/scheduler over the same cluster
+        state — the operator process replaced mid-flight."""
+        return Harness(cluster=h.cluster)
+
+    def test_restart_mid_rolling_update_resumes(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(name="r", replicas=2,
+                           cliques=[clique("w", replicas=2, cpu=1.0)]))
+        h.settle()
+        bump_image(h, "r")
+        # drive partway: first replica mid-update
+        for _ in range(6):
+            h.manager.run_once()
+            h.kubelet.tick()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "r")
+        prog = pcs.status.rolling_update_progress
+        assert prog is not None and not prog.completed
+        h2 = self.restart(h)
+        h2.settle()
+        pcs = h2.store.get(PodCliqueSet.KIND, "default", "r")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        assert set(pod_hashes(h2).values()) == {target}
+        assert all(p.status.ready for p in h2.store.list(Pod.KIND))
+
+    def test_restart_mid_termination_delay_keeps_breach_clock(self):
+        h = Harness(nodes=make_nodes(8))
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.termination_delay = 60.0
+        h.apply(pcs)
+        h.settle()
+        h.kubelet.crash_pod("default", "simple1-0-w-0")
+        h.settle()
+        h.advance(40.0)  # 40s into the 60s delay
+        old_uid = h.store.get(Pod.KIND, "default",
+                              "simple1-0-w-0").metadata.uid
+        h2 = self.restart(h)
+        # the breach clock came from the persisted condition timestamp,
+        # not operator memory: 21 more seconds completes the 60s delay
+        # (the kubelet, like the node fleet, is cluster state and survives
+        # the operator restart by construction)
+        h2.settle()
+        assert h2.store.get(Pod.KIND, "default",
+                            "simple1-0-w-0").metadata.uid == old_uid
+        h2.advance(21.0)
+        h2.settle()
+        new_pod = h2.store.get(Pod.KIND, "default", "simple1-0-w-0")
+        assert new_pod is not None and new_pod.metadata.uid != old_uid
+        assert all(p.status.ready for p in h2.store.list(Pod.KIND))
+
+    def test_restart_with_pending_backlog_schedules(self):
+        h = Harness(nodes=make_nodes(2, allocatable={"cpu": 1.0,
+                                                     "memory": 8.0,
+                                                     "tpu": 0.0}))
+        h.cluster.cordon("node-0")
+        h.cluster.cordon("node-1")
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)]))
+        h.settle()
+        assert all(not p.node_name for p in h.store.list(Pod.KIND))
+        h2 = self.restart(h)
+        h2.cluster.uncordon("node-0")
+        h2.cluster.uncordon("node-1")
+        h2.settle()
+        assert all(p.node_name for p in h2.store.list(Pod.KIND))
