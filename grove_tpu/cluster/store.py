@@ -22,7 +22,7 @@ import copy
 import dataclasses
 import itertools
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 from ..api.meta import ObjectMeta
@@ -175,6 +175,7 @@ class ObjectStore:
         self._objs: dict[str, dict[tuple[str, str], Any]] = {}
         self._admission: dict[str, Admission] = {}
         self._events: list[Event] = []
+        self._compacted_seq = 0  # compaction horizon (see compact_events)
         self._kind_serial: dict[str, int] = {}
         self._seq = itertools.count(1)
         self._uid = itertools.count(1)
@@ -276,12 +277,59 @@ class ObjectStore:
 
     # -- event log ---------------------------------------------------------
     def events_since(self, seq: int) -> list[Event]:
-        """All events with Event.seq > seq (the watch 'resume' contract)."""
+        """All events with Event.seq > seq (the watch 'resume' contract).
+        Asking for history older than the compaction horizon raises — a
+        silent gap would make a consumer miss writes (the apiserver answers
+        the same situation with 410 Gone)."""
+        if seq < self._compacted_seq:
+            raise StoreError(
+                f"events before seq {self._compacted_seq} were compacted "
+                f"(requested since {seq})"
+            )
         return [e for e in self._events if e.seq > seq]
+
+    def compact_events(self, before_seq: int) -> int:
+        """Drop events with seq <= before_seq (long simulations otherwise
+        grow the append-only log without bound — the real apiserver keeps
+        only a bounded watch window the same way). Callers must pass a seq
+        every consumer has already drained past; later events_since() calls
+        below the horizon raise (and the caller relists, see relist()).
+        Returns the number of events dropped."""
+        # clamp: an overshooting horizon must not outrun the actually
+        # emitted seqs, or last_seq rewinds and valid future cursors get
+        # poisoned
+        before_seq = min(before_seq, self.last_seq)
+        before = len(self._events)
+        self._events = [e for e in self._events if e.seq > before_seq]
+        dropped = before - len(self._events)
+        if dropped:
+            self._compacted_seq = max(self._compacted_seq, before_seq)
+        return dropped
+
+    def relist(self) -> tuple[list[Event], int]:
+        """Initial-LIST analog: synthetic Added events for every live
+        object (NOT appended to the log) + the seq to resume the watch
+        from. A consumer whose cursor fell behind the compaction horizon
+        recovers exactly like an informer after 410 Gone: relist, then
+        watch from the head."""
+        head = self.last_seq
+        events = [
+            Event(
+                seq=head,
+                type="Added",
+                kind=kind,
+                namespace=obj.metadata.namespace,
+                name=obj.metadata.name,
+                obj=obj,
+            )
+            for kind, bucket in self._objs.items()
+            for obj in bucket.values()
+        ]
+        return events, head
 
     @property
     def last_seq(self) -> int:
-        return self._events[-1].seq if self._events else 0
+        return self._events[-1].seq if self._events else self._compacted_seq
 
     def _emit(self, type_: str, obj: Any, old: Any = None) -> None:
         """Append a watch event. The store is MVCC — every write REPLACES

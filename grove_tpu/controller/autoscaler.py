@@ -19,7 +19,7 @@ from typing import Optional
 
 from ..api import constants
 from ..api.auxiliary import HorizontalPodAutoscaler
-from ..api.types import Pod, PodClique, PodCliqueScalingGroup
+from ..api.types import Pod, PodCliqueScalingGroup
 from ..cluster.cluster import Cluster
 from ..cluster.store import Event
 from .runtime import Request, Result

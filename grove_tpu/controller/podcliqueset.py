@@ -43,7 +43,6 @@ from ..api.podgang import (
 )
 from ..api.types import (
     ClusterTopology,
-    LastOperation,
     Pod,
     PodClique,
     PodCliqueScalingGroup,

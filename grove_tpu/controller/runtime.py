@@ -21,7 +21,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from ..cluster.store import Event, ObjectStore
@@ -112,9 +112,19 @@ class ControllerManager:
             self._queue.append(key)
 
     def _drain_events(self) -> None:
-        events = self.store.events_since(self._cursor)
-        if events:
-            self._cursor = events[-1].seq
+        from ..cluster.store import StoreError
+
+        try:
+            events = self.store.events_since(self._cursor)
+        except StoreError:
+            # cursor fell behind the compaction horizon (a fresh manager
+            # over a long-lived compacted store): relist like an informer
+            # after 410 Gone — synthetic Added events for every live
+            # object, then watch from the head
+            events, self._cursor = self.store.relist()
+        else:
+            if events:
+                self._cursor = events[-1].seq
         for event in events:
             for controller in self.controllers:
                 for req in controller.map_event(event):
@@ -128,6 +138,13 @@ class ControllerManager:
 
     def next_requeue_at(self) -> Optional[float]:
         return self._requeues[0][0] if self._requeues else None
+
+    def compact_processed_events(self) -> int:
+        """Drop store events this manager has already drained. Safe when
+        the manager is the only event consumer (the production shape);
+        long-running simulations call this periodically to bound the
+        event log. Tests that inspect historical events simply don't."""
+        return self.store.compact_events(self._cursor)
 
     # -- the loop ----------------------------------------------------------
     def run_once(self) -> int:

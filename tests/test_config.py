@@ -10,7 +10,6 @@ import pytest
 
 from grove_tpu.api import ValidationError
 from grove_tpu.api.config import (
-    OperatorConfig,
     load_operator_config,
     validate_operator_config,
 )

@@ -6,8 +6,6 @@ insufficient capacity, scale-out gangs, minAvailable semantics, breach ->
 TerminationDelay -> gang termination -> recovery.
 """
 
-import pytest
-
 from grove_tpu.api import constants
 from grove_tpu.api.meta import get_condition
 from grove_tpu.api.podgang import PodGang, PodGangConditionType

@@ -424,3 +424,25 @@ class TestOR_OperatorRestart:
         h2.cluster.uncordon("node-1")
         h2.settle()
         assert all(p.node_name for p in h2.store.list(Pod.KIND))
+
+    def test_restart_after_event_compaction_relists(self):
+        """A fresh manager whose cursor fell behind the compaction
+        horizon recovers via the informer relist path (410 Gone analog):
+        synthetic Added events rediscover every object and the mid-flight
+        update completes."""
+        h = Harness(nodes=make_nodes(8))
+        h.apply(simple_pcs(name="r", cliques=[clique("w", replicas=3,
+                                                     cpu=1.0)]))
+        h.settle()
+        bump_image(h, "r")
+        for _ in range(4):
+            h.manager.run_once()
+            h.kubelet.tick()
+        h.manager.compact_processed_events()  # history gone mid-flight
+        h2 = self.restart(h)  # new cursor=0 < compaction horizon
+        h2.settle()
+        pcs = h2.store.get(PodCliqueSet.KIND, "default", "r")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        assert set(pod_hashes(h2).values()) == {target}
+        assert all(p.status.ready for p in h2.store.list(Pod.KIND))

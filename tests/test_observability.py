@@ -252,8 +252,6 @@ class TestManagerMetrics:
     the reference's controllers; grove_tpu feeds its own registry)."""
 
     def test_reconcile_metrics_flow(self):
-        import sys, os
-        sys.path.insert(0, os.path.dirname(__file__))
         from test_e2e_basic import clique, simple_pcs
 
         from grove_tpu.cluster import make_nodes
@@ -286,8 +284,6 @@ class TestManagerMetrics:
         from grove_tpu.cluster import make_nodes
         from grove_tpu.cluster.store import Admission
         from grove_tpu.controller import Harness
-        import sys, os
-        sys.path.insert(0, os.path.dirname(__file__))
         from test_e2e_basic import clique, simple_pcs
 
         h = Harness(nodes=make_nodes(4))
