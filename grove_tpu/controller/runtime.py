@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
-from ..cluster.store import Event, ObjectStore
+from ..cluster.store import Event, ObjectStore, StoreError
 
 
 @dataclass(frozen=True)
@@ -112,8 +112,6 @@ class ControllerManager:
             self._queue.append(key)
 
     def _drain_events(self) -> None:
-        from ..cluster.store import StoreError
-
         try:
             events = self.store.events_since(self._cursor)
         except StoreError:

@@ -360,6 +360,7 @@ class TestEventCompaction:
         h.settle()
         assert all(p.node_name and p.status.ready
                    for p in h.store.list(Pod.KIND))
-        # compacting everything after settle leaves an empty log
-        h.manager.compact_processed_events()
-        assert h.store.events_since(h.store.last_seq) == []
+        # compacting everything after settle leaves an empty log: the
+        # second settle produced fresh events, so the compact drops them
+        assert h.manager.compact_processed_events() > 0
+        assert len(h.store._events) == 0

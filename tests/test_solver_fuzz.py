@@ -1,0 +1,173 @@
+"""Property tests for the placement engine: on RANDOM problems, every
+placement the engine (and the serial baseline, and the sharded engine)
+returns must satisfy the hard-feasibility contract exactly —
+  - cumulative node capacity is never exceeded,
+  - a gang's required pack level puts all its pods in ONE domain there,
+  - per-group and constraint-group required levels hold,
+  - node eligibility (selectors/taints) is never violated,
+  - results are deterministic for a seed.
+The scenario suites check specific shapes; this sweeps the space.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Node, TopologyLevel
+from grove_tpu.solver import PlacementEngine, SolverGang, solve_serial
+from grove_tpu.topology import default_cluster_topology, encode_topology
+
+SEEDS = range(8)
+
+
+def random_problem(seed: int):
+    rng = np.random.default_rng(seed)
+    blocks = int(rng.integers(2, 4))
+    racks = int(rng.integers(1, 4))
+    hosts = int(rng.integers(2, 5))
+    nodes = []
+    i = 0
+    for b in range(blocks):
+        for r in range(racks):
+            for h in range(hosts):
+                labels = {"t/block": f"b{b}", "t/rack": f"b{b}r{r}"}
+                if rng.random() < 0.3:
+                    labels["accel"] = "v5"
+                node = Node(
+                    metadata=ObjectMeta(name=f"n{i}", labels=labels),
+                    allocatable={
+                        "cpu": float(rng.integers(4, 17)),
+                        "memory": float(rng.integers(16, 65)),
+                        "tpu": float(rng.integers(0, 9)),
+                    },
+                )
+                if rng.random() < 0.15:
+                    node.taints = ["reserved"]
+                if rng.random() < 0.1:
+                    node.unschedulable = True
+                nodes.append(node)
+                i += 1
+    ct = default_cluster_topology([
+        TopologyLevel(domain="block", key="t/block"),
+        TopologyLevel(domain="rack", key="t/rack"),
+    ])
+    snap = encode_topology(ct, nodes)
+
+    gangs = []
+    for gi in range(int(rng.integers(6, 20))):
+        num_groups = int(rng.integers(1, 3))
+        demand, gids, greq, gpref = [], [], [], []
+        pod_elig = []
+        any_elig = False
+        for grp in range(num_groups):
+            pods = int(rng.integers(1, 5))
+            sel = rng.random() < 0.25
+            tol = rng.random() < 0.5
+            for _ in range(pods):
+                demand.append([
+                    float(rng.integers(1, 5)),
+                    float(rng.integers(1, 9)),
+                    float(rng.integers(0, 3)),
+                ])
+                gids.append(grp)
+                if sel or snap.has_taints:
+                    mask = snap.eligibility(
+                        {"accel": "v5"} if sel else {},
+                        ["reserved"] if tol else [],
+                    )
+                    if mask.all():
+                        pod_elig.append(None)
+                    else:
+                        pod_elig.append(mask)
+                        any_elig = True
+                else:
+                    pod_elig.append(None)
+            greq.append(int(rng.integers(-1, 2)))
+            gpref.append(-1)
+        required = int(rng.integers(-1, 2))
+        gangs.append(SolverGang(
+            name=f"g{gi:03d}",
+            namespace="fuzz",
+            demand=np.asarray(demand, np.float32),
+            pod_names=[f"g{gi:03d}-p{j}" for j in range(len(demand))],
+            group_ids=np.asarray(gids, np.int32),
+            group_names=[f"grp{j}" for j in range(num_groups)],
+            group_required_level=np.asarray(greq, np.int32),
+            group_preferred_level=np.asarray(gpref, np.int32),
+            required_level=required,
+            preferred_level=int(rng.integers(-1, 3)),
+            priority=float(rng.integers(0, 3)),
+            pod_elig=pod_elig if any_elig else None,
+        ))
+    return snap, gangs
+
+
+def assert_result_valid(snap, gangs, result):
+    by_name = {g.name: g for g in gangs}
+    free = snap.free.copy()
+    for name, placement in result.placed.items():
+        gang = by_name[name]
+        assign = placement.node_indices
+        assert len(assign) == gang.num_pods
+        for p in range(gang.num_pods):
+            ni = int(assign[p])
+            assert snap.schedulable[ni], f"{name} pod {p} on cordoned node"
+            if gang.pod_elig is not None and gang.pod_elig[p] is not None:
+                assert gang.pod_elig[p][ni], f"{name} pod {p} ineligible node"
+            free[ni] -= gang.demand[p]
+        # gang-level required pack
+        if gang.required_level >= 0:
+            ids = snap.domain_ids[gang.required_level, assign]
+            assert (ids == ids[0]).all(), f"{name} breaks gang pack level"
+        # per-group required pack
+        for grp in range(len(gang.group_names)):
+            lvl = int(gang.group_required_level[grp])
+            if lvl >= 0:
+                sel = gang.group_ids == grp
+                ids = snap.domain_ids[lvl, assign[sel]]
+                assert (ids == ids[0]).all(), f"{name}/{grp} breaks group pack"
+        for members, req, _pref in gang.constraint_groups:
+            if req >= 0:
+                sel = np.isin(gang.group_ids, members)
+                ids = snap.domain_ids[req, assign[sel]]
+                assert (ids == ids[0]).all(), f"{name} breaks constraint group"
+    assert (free >= -1e-4).all(), "cumulative capacity exceeded"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_engine_placements_satisfy_hard_contract(seed):
+    snap, gangs = random_problem(seed)
+    result = PlacementEngine(snap).solve(gangs)
+    assert_result_valid(snap, gangs, result)
+    assert len(result.placed) + len(result.unplaced) == len(gangs)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_serial_placements_satisfy_hard_contract(seed):
+    snap, gangs = random_problem(seed)
+    result = solve_serial(snap, gangs)
+    assert_result_valid(snap, gangs, result)
+
+
+@pytest.mark.parametrize("seed", (0, 3, 6))
+def test_engine_deterministic_per_seed(seed):
+    snap, gangs = random_problem(seed)
+    r1 = PlacementEngine(snap).solve(gangs)
+    r2 = PlacementEngine(snap).solve(gangs)
+    assert set(r1.placed) == set(r2.placed)
+    for name in r1.placed:
+        np.testing.assert_array_equal(
+            r1.placed[name].node_indices, r2.placed[name].node_indices
+        )
+
+
+@pytest.mark.parametrize("seed", (1, 4))
+def test_sharded_engine_satisfies_hard_contract(seed):
+    from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
+
+    snap, gangs = random_problem(seed)
+    mesh = make_solver_mesh()
+    result = ShardedPlacementEngine(snap, mesh).solve(gangs)
+    assert_result_valid(snap, gangs, result)
+    single = PlacementEngine(snap).solve(gangs)
+    assert set(result.placed) == set(single.placed)
