@@ -2,10 +2,13 @@
 
 Same problem encoding as the Python serial path; the caller pre-sorts
 gangs by (priority desc, name) exactly like serial.solve_serial so both
-baselines walk gangs in the identical order. Group preferred levels and
-constraint groups are approximated as unconstrained here (the C++ baseline
-implements one nesting level of REQUIRED group constraints); the Python
-paths remain the semantic reference.
+baselines walk gangs in the identical order. Per-pod node-eligibility
+masks (node_selector/tolerations) are enforced exactly: unique mask rows
+ship once, each pod carries a row index. The C++ subset is gated by
+gang_native_compatible: required group constraints (one nesting level)
+and eligibility masks are implemented; backlogs carrying constraint
+groups or group PREFERRED levels return None and the callers fall back
+to the Python paths, the semantic reference.
 """
 
 from __future__ import annotations
@@ -22,25 +25,64 @@ from ..topology.encoding import TopologySnapshot
 from .build import load_library
 
 
+def _encode_elig(order: list[SolverGang], total_pods: int, num_nodes: int):
+    """(masks uint8 [M, N], pod_mask_idx int32 [P_total]) or (None, None)
+    when no gang carries masks. Shared mask arrays (snapshot.eligibility
+    cache) dedupe by identity, so M stays tiny."""
+    if all(g.pod_elig is None for g in order):
+        return None, None
+    rows: list[np.ndarray] = []
+    row_of: dict[int, int] = {}
+    idx = np.full(total_pods, -1, np.int32)
+    p = 0
+    for g in order:
+        for j in range(g.num_pods):
+            mask = g.pod_elig[j] if g.pod_elig is not None else None
+            if mask is not None:
+                row = row_of.get(id(mask))
+                if row is None:
+                    row = len(rows)
+                    row_of[id(mask)] = row
+                    rows.append(mask)
+                idx[p] = row
+            p += 1
+    masks = np.ascontiguousarray(np.stack(rows).astype(np.uint8))
+    assert masks.shape[1] == num_nodes
+    return masks, idx
+
+
 def solve_serial_native(
     snapshot: TopologySnapshot,
     gangs: list[SolverGang],
     free: np.ndarray | None = None,
 ) -> SolveResult | None:
     """Returns None when the native library is unavailable or any gang is
-    outside the C++ subset (constraint groups, group preferences, per-pod
-    eligibility masks) — callers then fall back to the Python serial path,
-    the semantic reference."""
+    outside the C++ subset (constraint groups, group preferences) —
+    callers then fall back to the Python serial path, the semantic
+    reference."""
     lib = load_library()
     if lib is None:
         return None
     if any(not gang_native_compatible(g) for g in gangs):
         return None
     t0 = time.perf_counter()
-    order = sorted(gangs, key=gang_sort_key)
+    result = SolveResult()
+    solvable = []
+    for g in gangs:
+        if g.unschedulable_reason:
+            # pre-declared unschedulable (unresolved required level): hold
+            # with the reason, exactly like solve_serial — the C++ core
+            # would otherwise weaken the hard constraint to best-effort
+            result.unplaced[g.name] = g.unschedulable_reason
+        else:
+            solvable.append(g)
+    order = sorted(solvable, key=gang_sort_key)
     n, r = snapshot.num_nodes, len(snapshot.resource_names)
     if free is None:
         free = snapshot.free.copy()
+    if not order:
+        result.wall_seconds = time.perf_counter() - t0
+        return result
 
     pod_offsets = np.zeros(len(order) + 1, np.int32)
     group_offsets = np.zeros(len(order) + 1, np.int32)
@@ -68,6 +110,7 @@ def solve_serial_native(
     def ptr(a, typ):
         return a.ctypes.data_as(ct.POINTER(typ))
 
+    masks, mask_idx = _encode_elig(order, int(pod_offsets[-1]), n)
     lib.solve_serial(
         ct.c_int32(n), ct.c_int32(r), ct.c_int32(snapshot.num_levels),
         ptr(cap, ct.c_float), ptr(free_c, ct.c_float),
@@ -76,10 +119,11 @@ def solve_serial_native(
         ptr(pod_offsets, ct.c_int32), ptr(demand, ct.c_float),
         ptr(required_arr, ct.c_int32), ptr(group_ids_arr, ct.c_int32),
         ptr(group_offsets, ct.c_int32), ptr(group_levels_arr, ct.c_int32),
+        None if masks is None else ptr(masks, ct.c_uint8),
+        None if mask_idx is None else ptr(mask_idx, ct.c_int32),
         ptr(assign, ct.c_int32),
     )
 
-    result = SolveResult()
     for i, g in enumerate(order):
         a = assign[pod_offsets[i] : pod_offsets[i + 1]].astype(np.int64)
         if (a < 0).any():
@@ -151,6 +195,7 @@ def repair_native(
     def ptr(a, typ):
         return a.ctypes.data_as(ct.POINTER(typ))
 
+    masks, mask_idx = _encode_elig(order, int(pod_offsets[-1]), n)
     fallbacks = ct.c_int32(0)
     lib.repair_gangs.restype = ct.c_int32
     lib.repair_gangs(
@@ -163,6 +208,8 @@ def repair_native(
         ptr(top_dom_c, ct.c_int32), ptr(top_val_c, ct.c_float),
         ct.c_int32(top_dom_c.shape[1]),
         ptr(dom_level_c, ct.c_int32), ptr(dom_offsets_c, ct.c_int32),
+        None if masks is None else ptr(masks, ct.c_uint8),
+        None if mask_idx is None else ptr(mask_idx, ct.c_int32),
         ptr(assign, ct.c_int32), ct.byref(fallbacks),
     )
 
@@ -186,11 +233,10 @@ def repair_native(
 
 
 def gang_native_compatible(gang: SolverGang) -> bool:
-    """The C++ paths implement required group constraints only, and know
-    nothing of per-pod node-eligibility masks (node_selector/tolerations) —
-    such gangs take the Python repair path, the semantic reference."""
+    """The C++ paths implement required group constraints and per-pod
+    node-eligibility masks; constraint groups and group PREFERENCES still
+    fall back to the Python paths, the semantic reference."""
     return (
         not gang.constraint_groups
         and (gang.group_preferred_level < 0).all()
-        and gang.pod_elig is None
     )

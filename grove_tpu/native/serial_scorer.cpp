@@ -29,8 +29,21 @@ struct Ctx {
   const float* capacity;      // [N*R]
   const int32_t* domain_ids;  // [L*N]
   const uint8_t* schedulable; // [N]
+  // Node-eligibility (node_selector/tolerations): unique mask rows [M*N]
+  // + per-pod row index (-1 = unconstrained). Both null when the backlog
+  // carries no masks. Hard filter, enforced in bfd exactly like the
+  // Python fit primitives.
+  const uint8_t* elig_masks;     // [M*N] or null
+  const int32_t* pod_mask_idx;   // [P_total] or null
   std::vector<float> cap_scale;
 };
+
+inline bool eligible(const Ctx& ctx, int32_t pod, int32_t node) {
+  if (!ctx.pod_mask_idx) return true;
+  int32_t mi = ctx.pod_mask_idx[pod];
+  if (mi < 0) return true;
+  return ctx.elig_masks[(size_t)mi * ctx.num_nodes + node] != 0;
+}
 
 inline float dominant_share(const Ctx& ctx, const float* vec) {
   float best = -1e30f;
@@ -63,6 +76,7 @@ bool bfd(const Ctx& ctx, const std::vector<int32_t>& pods, const float* demand,
     int32_t best_node = -1;
     float best_left = 1e30f;
     for (int32_t n : dom) {
+      if (!eligible(ctx, p, n)) continue;
       float* row = free.data() + n * ctx.num_res;
       if (!fits(ctx, row, d)) continue;
       float left = -1e30f;
@@ -142,11 +156,15 @@ struct Gang {
 bool place_in_domain(const Ctx& ctx, const Gang& g, const float* demand,
                      const std::vector<int32_t>& dom, int dom_level,
                      std::vector<float>& free, int32_t* assign) {
+  // Mirrors fit.py's unit tree exactly: EVERY group with a required level
+  // is its own placement unit (even when the enclosing domain already
+  // satisfies it — it still BFDs as a unit, which changes pod ordering
+  // and therefore node choices); only level-free groups' pods are loose.
   std::vector<std::vector<int32_t>> group_pods(g.num_groups);
   std::vector<int32_t> loose;
   for (int32_t p = g.pod_begin; p < g.pod_end; ++p) {
     int32_t gi = g.group_ids[p - g.pod_begin];
-    if (gi >= 0 && gi < g.num_groups && g.group_levels[gi] > dom_level)
+    if (gi >= 0 && gi < g.num_groups && g.group_levels[gi] >= 0)
       group_pods[gi].push_back(p);
     else
       loose.push_back(p);
@@ -170,6 +188,12 @@ bool place_in_domain(const Ctx& ctx, const Gang& g, const float* demand,
     return sa > sb;
   });
   for (int32_t gi : gorder) {
+    if (g.group_levels[gi] <= dom_level) {
+      // constraint already satisfied by the enclosing domain: place the
+      // group as a unit within it (fit.py _place_child: req <= domain)
+      if (!bfd(ctx, group_pods[gi], demand, dom, free, assign)) return false;
+      continue;
+    }
     std::vector<float> total = total_of(group_pods[gi]);
     auto subs = subdomains_tightest(ctx, dom, g.group_levels[gi], total.data(), free);
     bool placed = false;
@@ -212,6 +236,8 @@ int32_t solve_serial(
     const int32_t* group_ids,       // [P_total] per-pod group (relative)
     const int32_t* group_offsets,   // [G+1] into group_levels
     const int32_t* group_levels,    // per gang's groups: level or -1
+    const uint8_t* elig_masks,      // [M*N] or null
+    const int32_t* pod_mask_idx,    // [P_total] or null
     int32_t* assign                 // out [P_total]
 ) {
   Ctx ctx;
@@ -221,6 +247,8 @@ int32_t solve_serial(
   ctx.capacity = capacity;
   ctx.domain_ids = domain_ids;
   ctx.schedulable = schedulable;
+  ctx.elig_masks = elig_masks;
+  ctx.pod_mask_idx = pod_mask_idx;
   ctx.cap_scale.assign(num_res, 1e-9f);
   for (int n = 0; n < num_nodes; ++n)
     for (int r = 0; r < num_res; ++r)
@@ -312,6 +340,7 @@ int32_t repair_gangs(
     const int32_t* group_offsets, const int32_t* group_levels,
     const int32_t* top_dom, const float* top_val, int32_t top_k,
     const int32_t* dom_level, const int32_t* dom_offsets,
+    const uint8_t* elig_masks, const int32_t* pod_mask_idx,
     int32_t* assign, int32_t* fallbacks_out) {
   Ctx ctx;
   ctx.num_nodes = num_nodes;
@@ -320,6 +349,8 @@ int32_t repair_gangs(
   ctx.capacity = capacity;
   ctx.domain_ids = domain_ids;
   ctx.schedulable = schedulable;
+  ctx.elig_masks = elig_masks;
+  ctx.pod_mask_idx = pod_mask_idx;
   ctx.cap_scale.assign(num_res, 1e-9f);
   for (int n = 0; n < num_nodes; ++n)
     for (int r = 0; r < num_res; ++r)

@@ -81,3 +81,31 @@ class TestNativeRepairParity:
                 nat.placed[name].node_indices, py.placed[name].node_indices
             )
         assert nat.stats["fallbacks"] == py.stats["fallbacks"]
+
+
+def test_native_holds_predeclared_unschedulable_gangs():
+    """A gang whose required pack level is unresolved must be HELD by the
+    native path with its reason, never weakened to best-effort (parity
+    with solve_serial; review finding)."""
+    import numpy as np
+    import pytest
+
+    from grove_tpu.native import native_available, solve_serial_native
+    from grove_tpu.solver import SolverGang
+    from grove_tpu.solver.problem import UNRESOLVED_LEVEL
+
+    from test_solver import cluster, gang
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    snap = cluster()
+    held = gang("held", pods=2, cpu=1.0)
+    held.required_level = UNRESOLVED_LEVEL
+    held.unschedulable_reason = "required topology level(s) unavailable: zone"
+    ok = gang("ok", pods=2, cpu=1.0)
+    res = solve_serial_native(snap, [held, ok])
+    assert res is not None
+    assert res.unplaced == {
+        "held": "required topology level(s) unavailable: zone"
+    }
+    assert set(res.placed) == {"ok"}
