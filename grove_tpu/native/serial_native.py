@@ -27,25 +27,12 @@ from .build import load_library
 
 def _encode_elig(order: list[SolverGang], total_pods: int, num_nodes: int):
     """(masks uint8 [M, N], pod_mask_idx int32 [P_total]) or (None, None)
-    when no gang carries masks. Shared mask arrays (snapshot.eligibility
-    cache) dedupe by identity, so M stays tiny."""
-    if all(g.pod_elig is None for g in order):
+    when no gang carries masks."""
+    from ..solver.problem import dedupe_pod_masks
+
+    rows, idx = dedupe_pod_masks(order)
+    if not rows:
         return None, None
-    rows: list[np.ndarray] = []
-    row_of: dict[int, int] = {}
-    idx = np.full(total_pods, -1, np.int32)
-    p = 0
-    for g in order:
-        for j in range(g.num_pods):
-            mask = g.pod_elig[j] if g.pod_elig is not None else None
-            if mask is not None:
-                row = row_of.get(id(mask))
-                if row is None:
-                    row = len(rows)
-                    row_of[id(mask)] = row
-                    rows.append(mask)
-                idx[p] = row
-            p += 1
     masks = np.ascontiguousarray(np.stack(rows).astype(np.uint8))
     assert masks.shape[1] == num_nodes
     return masks, idx

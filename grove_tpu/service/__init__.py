@@ -1,0 +1,11 @@
+"""Placement service: the engine behind a gRPC boundary (SURVEY §7)."""
+
+from .client import RemotePlacementEngine
+from .server import PlacementService, serve, snapshot_epoch
+
+__all__ = [
+    "PlacementService",
+    "RemotePlacementEngine",
+    "serve",
+    "snapshot_epoch",
+]

@@ -1,0 +1,101 @@
+"""Client-side engine: the PlacementEngine interface over the service.
+
+Drop-in for GangScheduler's engine_cls: the control plane keeps its exact
+local semantics for everything EXCEPT the batched solve, which crosses
+the process boundary — the reference's operator/KAI split. The static
+topology syncs once per epoch (content hash); each solve ships the free
+matrix + dense gang structs and gets assignments back.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import grpc
+
+from ..solver.engine import PlacementEngine
+from ..solver.result import SolveResult
+from ..topology.encoding import TopologySnapshot
+from . import codec
+from .server import SERVICE, snapshot_epoch
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+]
+
+#: one channel per address, shared by every engine the scheduler builds
+#: (it constructs a fresh engine whenever the static topology changes —
+#: per-engine channels would leak fds/threads under node churn). Channels
+#: live for the process, like the operator's apiserver connection.
+_channels: dict[str, grpc.Channel] = {}
+
+
+def _channel_for(address: str) -> grpc.Channel:
+    ch = _channels.get(address)
+    if ch is None:
+        ch = _channels[address] = grpc.insecure_channel(
+            address, options=_CHANNEL_OPTIONS
+        )
+    return ch
+
+
+class RemotePlacementEngine:
+    """solve() over the placement service. Accepts (and forwards metrics
+    for) the same constructor knobs as PlacementEngine so the scheduler
+    can inject it via engine_cls unchanged; solver tuning knobs live
+    server-side with the engine."""
+
+    def __init__(self, snapshot: TopologySnapshot, address: str,
+                 metrics=None, timeout_seconds: float = 120.0,
+                 **_engine_knobs):
+        self.snapshot = snapshot
+        self.address = address
+        self.metrics = metrics
+        #: RPC deadline: a wedged service must surface as a reconcile
+        #: error (manager retries) rather than blocking the control plane
+        #: forever
+        self.timeout_seconds = timeout_seconds
+        channel = _channel_for(address)
+        self._sync = channel.unary_unary(f"/{SERVICE}/Sync")
+        self._solve = channel.unary_unary(f"/{SERVICE}/Solve")
+        self.epoch = snapshot_epoch(snapshot)
+        self._register()
+
+    def _register(self) -> None:
+        server_epoch = self._sync(
+            codec.encode_topology_snapshot(self.snapshot),
+            timeout=self.timeout_seconds, wait_for_ready=True,
+        ).decode()
+        if server_epoch != self.epoch:
+            raise RuntimeError(
+                f"epoch mismatch: client {self.epoch} server {server_epoch}"
+            )
+
+    def solve(self, gangs, free: np.ndarray | None = None) -> SolveResult:
+        if free is None:
+            free = self.snapshot.free.copy()
+        request = codec.encode_solve_request(self.epoch, gangs, free)
+        try:
+            response = self._solve(request, timeout=self.timeout_seconds,
+                                   wait_for_ready=True)
+        except grpc.RpcError as err:
+            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
+                raise
+            # the service restarted (or evicted this epoch): re-Sync and
+            # retry once — without this the scheduler's cached engine
+            # would fail every reconcile until the topology changed
+            self._register()
+            response = self._solve(request, timeout=self.timeout_seconds,
+                                   wait_for_ready=True)
+        result = codec.decode_solve_response(
+            response, {g.name: g for g in gangs}, self.snapshot.node_names
+        )
+        # the server solved against its own copy of free; mirror the
+        # placements into the caller's array so the scheduler's
+        # best-effort/preemption accounting sees the residual capacity
+        for placement in result.placed.values():
+            for p, ni in enumerate(placement.node_indices):
+                free[ni] -= placement.gang.demand[p]
+        if self.metrics is not None:
+            PlacementEngine._record_metrics(self, result, len(gangs))
+        return result

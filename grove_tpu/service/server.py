@@ -1,0 +1,130 @@
+"""The placement service: gRPC Score(batch) -> assignments.
+
+SURVEY §7 step 2's north-star shape: the control plane is one process,
+the accelerator-backed placement engine another — the same split the
+reference draws between the operator and the external KAI scheduler,
+except the contract here is the dense solver encoding instead of PodGang
+CRs, and the engine is grove_tpu's own.
+
+Implemented with grpcio generic handlers (bytes-in/bytes-out + the numpy
+codec) — no protoc codegen needed. Two methods on `grove.Placement`:
+
+  Sync(topology snapshot) -> epoch     registers the static encoding and
+                                       builds the engine once
+  Solve(epoch, free, gangs) -> result  one batched backlog solve
+
+The engine is cached per epoch (content hash), so steady-state solves
+ship only the free matrix + gang structs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from concurrent import futures
+
+import grpc
+
+from ..solver import PlacementEngine
+from . import codec
+
+SERVICE = "grove.Placement"
+
+
+def snapshot_epoch(snapshot) -> str:
+    """Content hash of the static encoding — the cache key both sides
+    derive independently."""
+    h = hashlib.sha1()
+    h.update(snapshot.domain_ids.tobytes())
+    h.update(snapshot.capacity.tobytes())
+    h.update(snapshot.schedulable.tobytes())
+    h.update("\x00".join(snapshot.node_names).encode())
+    return h.hexdigest()[:16]
+
+
+class PlacementService:
+    """Holds one engine per registered topology epoch (bounded)."""
+
+    def __init__(self, engine_cls=PlacementEngine, max_epochs: int = 4,
+                 **engine_kwargs):
+        self.engine_cls = engine_cls
+        self.engine_kwargs = engine_kwargs
+        self.max_epochs = max_epochs
+        self._engines: dict[str, PlacementEngine] = {}
+        # the gRPC thread pool serves RPCs concurrently: the
+        # check-evict-insert must be atomic (double-pop at capacity /
+        # double engine build otherwise)
+        self._lock = threading.Lock()
+
+    def sync(self, request: bytes, context=None) -> bytes:
+        snapshot = codec.decode_topology_snapshot(request)
+        epoch = snapshot_epoch(snapshot)
+        with self._lock:
+            if epoch not in self._engines:
+                if len(self._engines) >= self.max_epochs:
+                    self._engines.pop(next(iter(self._engines)))
+                self._engines[epoch] = self.engine_cls(
+                    snapshot, **self.engine_kwargs
+                )
+        return epoch.encode()
+
+    def solve(self, request: bytes, context=None) -> bytes:
+        epoch, gangs, free = codec.decode_solve_request(request)
+        with self._lock:
+            engine = self._engines.get(epoch)
+        if engine is None:
+            if context is not None:
+                context.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION,
+                    f"unknown topology epoch {epoch}: Sync first",
+                )
+            raise KeyError(epoch)
+        result = engine.solve(gangs, free=free)
+        return codec.encode_solve_response(result)
+
+
+def serve(address: str, service: PlacementService | None = None,
+          max_workers: int = 4) -> grpc.Server:
+    """Start a gRPC server for the placement service at `address`
+    (e.g. "unix:/tmp/grove-placement.sock" or "127.0.0.1:7077").
+    Returns the started server; caller owns stop()."""
+    service = service or PlacementService()
+    identity = lambda b: b  # noqa: E731 — codec owns (de)serialization
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "Sync": grpc.unary_unary_rpc_method_handler(
+                service.sync, request_deserializer=identity,
+                response_serializer=identity),
+            "Solve": grpc.unary_unary_rpc_method_handler(
+                service.solve, request_deserializer=identity,
+                response_serializer=identity),
+        },
+    )
+    options = [
+        ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+        ("grpc.max_send_message_length", 256 * 1024 * 1024),
+    ]
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=options
+    )
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(address)
+    server.start()
+    return server
+
+
+def main() -> int:  # pragma: no cover - thin CLI
+    import argparse
+
+    ap = argparse.ArgumentParser(description="grove_tpu placement service")
+    ap.add_argument("--address", default="127.0.0.1:7077")
+    args = ap.parse_args()
+    server = serve(args.address)
+    print(f"placement service listening on {args.address}", flush=True)
+    server.wait_for_termination()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

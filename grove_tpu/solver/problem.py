@@ -119,6 +119,33 @@ def pod_eligibility_mask(
     return mask
 
 
+def dedupe_pod_masks(
+    gangs: list[SolverGang],
+) -> tuple[list[np.ndarray], np.ndarray]:
+    """Flatten per-pod eligibility masks across a gang list into unique
+    rows + a per-pod row index (-1 = unconstrained). Masks are shared
+    read-only arrays (snapshot.eligibility cache), so identity dedup keeps
+    the row count tiny. The ONE home of this encoding — the native ctypes
+    wrapper and the service codec both ship masks this way."""
+    total = sum(g.num_pods for g in gangs)
+    idx = np.full(total, -1, np.int32)
+    rows: list[np.ndarray] = []
+    row_of: dict[int, int] = {}
+    p = 0
+    for g in gangs:
+        for j in range(g.num_pods):
+            mask = g.pod_elig[j] if g.pod_elig is not None else None
+            if mask is not None:
+                row = row_of.get(id(mask))
+                if row is None:
+                    row = len(rows)
+                    row_of[id(mask)] = row
+                    rows.append(mask)
+                idx[p] = row
+            p += 1
+    return rows, idx
+
+
 def encode_podgangs(
     podgangs: list[PodGang],
     snapshot: TopologySnapshot,

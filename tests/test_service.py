@@ -1,0 +1,165 @@
+"""Placement-service tests: codec round-trip, the gRPC boundary, and the
+full control plane driving a remote engine (the operator/external-
+scheduler split of the reference, with grove_tpu's own engine behind it).
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.service import (
+    PlacementService,
+    RemotePlacementEngine,
+    serve,
+    snapshot_epoch,
+)
+from grove_tpu.service import codec
+from grove_tpu.solver import PlacementEngine, solve_serial
+
+from test_solver import cluster, gang, snap_with_accel_labels, constrained_gang
+
+
+@pytest.fixture(scope="module")
+def server_address(tmp_path_factory):
+    sock = tmp_path_factory.mktemp("svc") / "placement.sock"
+    address = f"unix:{sock}"
+    server = serve(address)
+    yield address
+    server.stop(grace=None)
+
+
+def backlog(snap):
+    gangs = [
+        gang("a", pods=2, cpu=2.0),
+        gang("b", pods=4, cpu=6.0, required=1),
+        gang("c", pods=4, cpu=6.0,
+             group_levels=[(2, 1, -1), (2, 1, -1)], required=0),
+        constrained_gang("sel", pods=2, cpu=6.0, snap=snap,
+                         selector={"accel": "v5"}),
+        constrained_gang("held", pods=3, cpu=6.0, snap=snap,
+                         selector={"accel": "v5"}),
+    ]
+    return gangs
+
+
+class TestCodec:
+    def test_request_roundtrip(self):
+        snap = snap_with_accel_labels()
+        gangs = backlog(snap)
+        data = codec.encode_solve_request("ep", gangs, snap.free.copy())
+        epoch, decoded, free = codec.decode_solve_request(data)
+        assert epoch == "ep"
+        assert [g.name for g in decoded] == [g.name for g in gangs]
+        for orig, back in zip(gangs, decoded):
+            np.testing.assert_array_equal(orig.demand, back.demand)
+            np.testing.assert_array_equal(orig.group_ids, back.group_ids)
+            assert orig.required_level == back.required_level
+            assert orig.constraint_groups == back.constraint_groups
+            if orig.pod_elig is None:
+                assert back.pod_elig is None
+            else:
+                for m1, m2 in zip(orig.pod_elig, back.pod_elig):
+                    if m1 is None:
+                        assert m2 is None
+                    else:
+                        np.testing.assert_array_equal(m1, m2)
+        np.testing.assert_allclose(free, snap.free)
+
+    def test_topology_roundtrip(self):
+        snap = cluster()
+        back = codec.decode_topology_snapshot(
+            codec.encode_topology_snapshot(snap)
+        )
+        np.testing.assert_array_equal(back.domain_ids, snap.domain_ids)
+        np.testing.assert_allclose(back.capacity, snap.capacity)
+        assert back.node_names == snap.node_names
+        assert snapshot_epoch(back) == snapshot_epoch(snap)
+
+
+class TestServiceSolve:
+    def test_remote_matches_local(self, server_address):
+        snap = snap_with_accel_labels()
+        gangs = backlog(snap)
+        local = PlacementEngine(snap).solve(gangs)
+        remote = RemotePlacementEngine(snap, server_address).solve(gangs)
+        assert set(remote.placed) == set(local.placed)
+        for name in remote.placed:
+            np.testing.assert_array_equal(
+                remote.placed[name].node_indices,
+                local.placed[name].node_indices,
+            )
+        assert remote.unplaced == local.unplaced
+
+    def test_remote_mirrors_residual_free(self, server_address):
+        snap = cluster()
+        eng = RemotePlacementEngine(snap, server_address)
+        free = snap.free.copy()
+        result = eng.solve([gang("a", pods=2, cpu=2.0)], free=free)
+        assert result.num_placed == 1
+        used = snap.free.sum() - free.sum()
+        assert used == pytest.approx(2 * 2.0 + 2 * 1.0)  # cpu + memory col
+
+    def test_unknown_epoch_is_failed_precondition(self, server_address):
+        import grpc
+
+        snap = cluster()
+        eng = RemotePlacementEngine(snap, server_address)
+        bad = codec.encode_solve_request(
+            "deadbeef", [gang("a", pods=1)], snap.free.copy()
+        )
+        with pytest.raises(grpc.RpcError) as err:
+            eng._solve(bad)
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+class TestRemoteControlPlane:
+    def test_full_control_plane_through_the_service(self, server_address):
+        """apply -> pods -> gangs -> REMOTE solve -> bound/ready, with a
+        selector-constrained clique — the operator/external-scheduler
+        process split, end to end."""
+        from functools import partial
+
+        from grove_tpu.api.podgang import PodGang
+        from grove_tpu.api.types import Pod
+        from grove_tpu.cluster import make_nodes
+        from grove_tpu.controller import Harness
+        from test_e2e_basic import clique, simple_pcs
+
+        nodes = make_nodes(8, racks_per_block=2, hosts_per_rack=4)
+        for n in nodes[:4]:
+            n.metadata.labels["accel"] = "v5"
+        pcs = simple_pcs(cliques=[clique("fe", replicas=2),
+                                  clique("be", replicas=2)])
+        pcs.spec.template.cliques[0].spec.pod_spec.node_selector = {
+            "accel": "v5"}
+        h = Harness(
+            nodes=nodes,
+            engine_cls=partial(RemotePlacementEngine,
+                               address=server_address),
+        )
+        h.apply(pcs)
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert all(p.node_name and p.status.ready for p in pods)
+        accel = {f"node-{i}" for i in range(4)}
+        for p in pods:
+            if p.spec.node_selector:
+                assert p.node_name in accel
+        gang_obj = h.store.list(PodGang.KIND)[0]
+        assert gang_obj.status.placement_score == 1.0
+
+
+def test_resync_after_server_restart(tmp_path):
+    """A restarted (state-less) service must not wedge existing clients:
+    the FAILED_PRECONDITION on the lost epoch triggers a re-Sync and the
+    solve retries transparently."""
+    addr = f"unix:{tmp_path}/restart.sock"
+    server = serve(addr)
+    snap = cluster()
+    eng = RemotePlacementEngine(snap, addr, timeout_seconds=30.0)
+    assert eng.solve([gang("a", pods=1)]).num_placed == 1
+    server.stop(grace=None)
+    server2 = serve(addr)  # fresh process state: epoch cache empty
+    try:
+        assert eng.solve([gang("b", pods=1)]).num_placed == 1
+    finally:
+        server2.stop(grace=None)
