@@ -115,7 +115,14 @@ def main() -> int:
                     "the FULL path (apply -> pods -> gangs -> scheduler -> "
                     "bound/ready) at the same scale as the solver stress "
                     "config; 0 disables")
+    ap.add_argument("--service", action="store_true",
+                    help="benchmark the solve THROUGH the placement-service "
+                    "gRPC boundary (server spawned as a subprocess on this "
+                    "machine's accelerator; measures whether the RPC hop + "
+                    "codec amortize at full-backlog batches)")
     args = ap.parse_args()
+    if args.service:
+        return bench_service(args)
     if args.small:
         args.nodes, args.gangs, args.iters = 512, 64, 3
         args.cp_replicas = min(args.cp_replicas, 20)
@@ -205,6 +212,85 @@ def main() -> int:
     }
     print(json.dumps(out))
     return 0
+
+
+def bench_service(args) -> int:
+    """Solve the stress backlog through the gRPC service boundary: the
+    server subprocess owns the accelerator; this process only encodes,
+    ships, and decodes. SURVEY hard part (d): the RPC hop + host->device
+    transfer must amortize over whole-backlog batches — this measures it
+    against the in-process engine wall."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    if args.small:
+        args.nodes, args.gangs, args.iters = 512, 64, 3
+
+    snapshot = make_cluster(args.nodes)
+    gangs = make_gangs(args.gangs)
+
+    sock = os.path.join(tempfile.mkdtemp(), "placement.sock")
+    address = f"unix:{sock}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "grove_tpu.service.server",
+         "--address", address],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # scan a few lines for the banner (interpreter warnings may
+        # precede it); a dead process means startup failed — surface its
+        # output instead of hanging in a blocking read on a live pipe
+        seen = []
+        for _ in range(10):
+            line = proc.stdout.readline()
+            seen.append(line)
+            if "listening" in line:
+                break
+            if not line or proc.poll() is not None:
+                raise RuntimeError(
+                    "placement service failed to start:\n" + "".join(seen)
+                )
+        else:
+            proc.send_signal(signal.SIGTERM)
+            raise RuntimeError(
+                "placement service never reported listening:\n"
+                + "".join(seen)
+            )
+        from grove_tpu.service import RemotePlacementEngine
+        from grove_tpu.service.codec import encode_solve_request
+
+        engine = RemotePlacementEngine(snapshot, address)
+        engine.solve(gangs)  # warm-up: server-side compile + caches
+        walls = []
+        placed = 0
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            result = engine.solve(gangs)
+            walls.append(time.perf_counter() - t0)
+            placed = result.num_placed
+        walls.sort()
+        p99 = walls[min(len(walls) - 1, int(round(0.99 * (len(walls) - 1))))]
+        wire = len(encode_solve_request(
+            engine.epoch, gangs, snapshot.free.copy()))
+        out = {
+            "metric": f"gang placements/sec over the gRPC service boundary "
+            f"({args.gangs} x 8-pod gangs, {args.nodes} nodes)",
+            "value": round(args.gangs / p99, 1),
+            "unit": "gangs/sec",
+            "vs_baseline": 0.0,  # no serial comparison in service mode
+            "p99_backlog_bind_seconds": round(p99, 4),
+            "p50_backlog_bind_seconds": round(walls[len(walls) // 2], 4),
+            "placed": placed,
+            "request_bytes": wire,
+            "engine": "service",
+        }
+        print(json.dumps(out))
+        return 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
 
 
 def bench_controlplane(num_nodes: int, replicas: int) -> dict:

@@ -25,7 +25,7 @@ from ..topology.encoding import TopologySnapshot
 from .build import load_library
 
 
-def _encode_elig(order: list[SolverGang], total_pods: int, num_nodes: int):
+def _encode_elig(order: list[SolverGang], num_nodes: int):
     """(masks uint8 [M, N], pod_mask_idx int32 [P_total]) or (None, None)
     when no gang carries masks."""
     from ..solver.problem import dedupe_pod_masks
@@ -97,7 +97,7 @@ def solve_serial_native(
     def ptr(a, typ):
         return a.ctypes.data_as(ct.POINTER(typ))
 
-    masks, mask_idx = _encode_elig(order, int(pod_offsets[-1]), n)
+    masks, mask_idx = _encode_elig(order, n)
     lib.solve_serial(
         ct.c_int32(n), ct.c_int32(r), ct.c_int32(snapshot.num_levels),
         ptr(cap, ct.c_float), ptr(free_c, ct.c_float),
@@ -182,7 +182,7 @@ def repair_native(
     def ptr(a, typ):
         return a.ctypes.data_as(ct.POINTER(typ))
 
-    masks, mask_idx = _encode_elig(order, int(pod_offsets[-1]), n)
+    masks, mask_idx = _encode_elig(order, n)
     fallbacks = ct.c_int32(0)
     lib.repair_gangs.restype = ct.c_int32
     lib.repair_gangs(

@@ -18,11 +18,6 @@ from ..topology.encoding import TopologySnapshot
 from . import codec
 from .server import SERVICE, snapshot_epoch
 
-_CHANNEL_OPTIONS = [
-    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-    ("grpc.max_send_message_length", 256 * 1024 * 1024),
-]
-
 #: one channel per address, shared by every engine the scheduler builds
 #: (it constructs a fresh engine whenever the static topology changes —
 #: per-engine channels would leak fds/threads under node churn). Channels
@@ -34,7 +29,7 @@ def _channel_for(address: str) -> grpc.Channel:
     ch = _channels.get(address)
     if ch is None:
         ch = _channels[address] = grpc.insecure_channel(
-            address, options=_CHANNEL_OPTIONS
+            address, options=codec.GRPC_MESSAGE_OPTIONS
         )
     return ch
 
@@ -72,6 +67,9 @@ class RemotePlacementEngine:
             )
 
     def solve(self, gangs, free: np.ndarray | None = None) -> SolveResult:
+        import time
+
+        t0 = time.perf_counter()
         if free is None:
             free = self.snapshot.free.copy()
         request = codec.encode_solve_request(self.epoch, gangs, free)
@@ -96,6 +94,11 @@ class RemotePlacementEngine:
         for placement in result.placed.values():
             for p, ni in enumerate(placement.node_indices):
                 free[ni] -= placement.gang.demand[p]
+        # the north-star bind-latency metric must include what the
+        # boundary ADDS (encode + RPC + decode), not just the server's
+        # solve wall — keep the server number in stats for the breakdown
+        result.stats["server_wall_seconds"] = result.wall_seconds
+        result.wall_seconds = time.perf_counter() - t0
         if self.metrics is not None:
             PlacementEngine._record_metrics(self, result, len(gangs))
         return result

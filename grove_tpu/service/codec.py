@@ -20,6 +20,15 @@ from ..solver.result import SolveResult, GangPlacement
 from ..topology.encoding import TopologySnapshot
 
 
+#: gRPC message-size bounds shared by server and client — the wire-size
+#: contract is single-sourced here next to the codec that produces the
+#: payloads it bounds.
+GRPC_MESSAGE_OPTIONS = [
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+]
+
+
 def _pack(header: dict, arrays: dict[str, np.ndarray]) -> bytes:
     buf = io.BytesIO()
     np.savez(buf, __header__=np.frombuffer(

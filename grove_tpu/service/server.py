@@ -60,12 +60,17 @@ class PlacementService:
         snapshot = codec.decode_topology_snapshot(request)
         epoch = snapshot_epoch(snapshot)
         with self._lock:
-            if epoch not in self._engines:
-                if len(self._engines) >= self.max_epochs:
-                    self._engines.pop(next(iter(self._engines)))
-                self._engines[epoch] = self.engine_cls(
-                    snapshot, **self.engine_kwargs
-                )
+            known = epoch in self._engines
+        if not known:
+            # build OUTSIDE the lock: engine construction (DomainSpace
+            # index over 5k nodes) must not stall concurrent Solves;
+            # double-checked insert tolerates a racing duplicate build
+            engine = self.engine_cls(snapshot, **self.engine_kwargs)
+            with self._lock:
+                if epoch not in self._engines:
+                    if len(self._engines) >= self.max_epochs:
+                        self._engines.pop(next(iter(self._engines)))
+                    self._engines[epoch] = engine
         return epoch.encode()
 
     def solve(self, request: bytes, context=None) -> bytes:
@@ -101,12 +106,9 @@ def serve(address: str, service: PlacementService | None = None,
                 response_serializer=identity),
         },
     )
-    options = [
-        ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-        ("grpc.max_send_message_length", 256 * 1024 * 1024),
-    ]
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers), options=options
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=codec.GRPC_MESSAGE_OPTIONS,
     )
     server.add_generic_rpc_handlers((handler,))
     server.add_insecure_port(address)
