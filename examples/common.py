@@ -1,0 +1,68 @@
+"""Shared runner for the workload-archetype examples.
+
+Each example mirrors one of the reference's concept-overview samples
+(operator/samples/user-guide/concept-overview/*.yaml) re-expressed
+against grove_tpu's API, and runs end-to-end on the simulated cluster:
+apply -> reconcile -> gang-schedule -> bound, ready pods.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+# runnable from anywhere: the repo root holds the grove_tpu package
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from grove_tpu.api.meta import ObjectMeta  # noqa: E402
+from grove_tpu.api.types import (
+    Container,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+
+def clique(name: str, replicas: int, cpu: float = 1.0, memory: float = 2.0,
+           tpu: float = 0.0, min_available: int | None = None,
+           starts_after: tuple[str, ...] = ()) -> PodCliqueTemplateSpec:
+    return PodCliqueTemplateSpec(name=name, spec=PodCliqueSpec(
+        replicas=replicas,
+        min_available=min_available,
+        starts_after=list(starts_after),
+        pod_spec=PodSpec(containers=[Container(
+            name=name, image="inference-engine:latest",
+            resources={"cpu": cpu, "memory": memory, "tpu": tpu},
+        )]),
+    ))
+
+
+def pcs(name: str, template: PodCliqueSetTemplateSpec,
+        replicas: int = 1) -> PodCliqueSet:
+    return PodCliqueSet(metadata=ObjectMeta(name=name),
+                        spec=PodCliqueSetSpec(replicas=replicas,
+                                              template=template))
+
+
+def run(workload: PodCliqueSet, nodes: int = 32) -> Harness:
+    h = Harness(nodes=make_nodes(nodes, racks_per_block=4, hosts_per_rack=4))
+    h.apply(workload)
+    h.settle()
+    return h
+
+
+def report(h: Harness) -> None:
+    print(f"{'POD':42s} {'NODE':10s} READY")
+    for pod in h.store.list("Pod"):
+        print(f"{pod.metadata.name:42s} {pod.node_name:10s} "
+              f"{pod.status.ready}")
+    print()
+    print(f"{'PODGANG':34s} {'PHASE':10s} SCORE")
+    for gang in h.store.list("PodGang"):
+        print(f"{gang.metadata.name:34s} {gang.status.phase.value:10s} "
+              f"{gang.status.placement_score}")

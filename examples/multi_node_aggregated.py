@@ -1,0 +1,32 @@
+"""Multi-node aggregated serving: one model instance spans a leader and
+worker pods (multi-node-aggregated.yaml); the instance is a scaling
+group, so adding capacity means whole new leader+workers gangs that
+schedule all-or-nothing and pack a rack."""
+
+from common import clique, pcs, report, run
+from grove_tpu.api.types import (
+    PodCliqueScalingGroupConfig,
+    PodCliqueSetTemplateSpec,
+    TopologyConstraintSpec,
+    TopologyPackConstraintSpec,
+)
+
+
+def build():
+    return pcs("multinode", PodCliqueSetTemplateSpec(
+        cliques=[
+            clique("leader", replicas=1, cpu=2.0, memory=4.0),
+            clique("worker", replicas=4, cpu=4.0, memory=8.0, tpu=2.0),
+        ],
+        pod_clique_scaling_group_configs=[PodCliqueScalingGroupConfig(
+            name="instance", clique_names=["leader", "worker"],
+            replicas=2, min_available=1,
+            topology_constraint=TopologyConstraintSpec(
+                pack_constraint=TopologyPackConstraintSpec(preferred="rack"),
+            ),
+        )],
+    ))
+
+
+if __name__ == "__main__":
+    report(run(build()))

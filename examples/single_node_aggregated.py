@@ -1,0 +1,17 @@
+"""Single-node aggregated serving: one clique of identical replicas, each
+pod a complete engine (samples/user-guide/concept-overview/
+single-node-aggregated.yaml). Simplest archetype: no gangs-of-gangs, one
+base PodGang per PCS replica."""
+
+from common import clique, pcs, report, run
+from grove_tpu.api.types import PodCliqueSetTemplateSpec
+
+
+def build():
+    return pcs("aggregated", PodCliqueSetTemplateSpec(
+        cliques=[clique("engine", replicas=4, cpu=4.0, memory=8.0, tpu=1.0)],
+    ))
+
+
+if __name__ == "__main__":
+    report(run(build()))

@@ -1,0 +1,23 @@
+"""Single-node disaggregated serving: prefill and decode as separate
+cliques behind a frontend, decode only starting after prefill
+(single-node-disaggregated.yaml). One base gang carries all three
+roles — they schedule all-or-nothing."""
+
+from common import clique, pcs, report, run
+from grove_tpu.api.types import CliqueStartupType, PodCliqueSetTemplateSpec
+
+
+def build():
+    return pcs("disagg", PodCliqueSetTemplateSpec(
+        startup_type=CliqueStartupType.EXPLICIT,
+        cliques=[
+            clique("frontend", replicas=1, cpu=0.5, memory=1.0),
+            clique("prefill", replicas=2, cpu=4.0, memory=8.0, tpu=1.0),
+            clique("decode", replicas=2, cpu=4.0, memory=8.0, tpu=1.0,
+                   starts_after=("prefill",)),
+        ],
+    ))
+
+
+if __name__ == "__main__":
+    report(run(build()))

@@ -1,0 +1,34 @@
+"""Every examples/ archetype converges: all pods bound and ready, gangs
+Running — the reference's concept-overview samples as living code."""
+
+import importlib
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+CASES = [
+    ("single_node_aggregated", 4, 1),
+    ("single_node_disaggregated", 5, 1),
+    ("multi_node_aggregated", 10, 2),      # base + 1 scaled instance
+    ("multi_node_disaggregated", 15, 2),   # base + 1 scaled prefill
+    ("complete_inference_pipeline", 15, 3),
+]
+
+
+@pytest.mark.parametrize("module,pods,gangs", CASES)
+def test_example_converges(module, pods, gangs):
+    mod = importlib.import_module(module)
+    from common import run
+
+    h = run(mod.build(), nodes=64)
+    pod_objs = h.store.list("Pod")
+    assert len(pod_objs) == pods, [p.metadata.name for p in pod_objs]
+    assert all(p.node_name and p.status.ready for p in pod_objs)
+    gang_objs = h.store.list("PodGang")
+    assert len(gang_objs) == gangs, [g.metadata.name for g in gang_objs]
+    from grove_tpu.api.podgang import PodGangPhase
+
+    assert all(g.status.phase == PodGangPhase.RUNNING for g in gang_objs)
