@@ -24,7 +24,7 @@ pod kills as its fault model):
 from __future__ import annotations
 
 from ..api import constants
-from ..api.types import Pod, PodPhase
+from ..api.types import Node, Pod, PodPhase
 from .store import ObjectStore
 
 
@@ -94,24 +94,45 @@ class SimKubelet:
             for p in self.store.scan(Pod.KIND)
             if p.status.ready
         }
+        live_nodes = {
+            n.metadata.name for n in self.store.scan(Node.KIND)
+        }
         to_run: list[tuple[str, str]] = []
         to_ready: list[tuple[str, str]] = []
+        to_lose: list[tuple[str, str]] = []
         for pod in self.store.scan(Pod.KIND):
+            if not pod.node_name or pod.metadata.deletion_timestamp is not None:
+                continue
+            key = (pod.metadata.namespace, pod.metadata.name)
+            if pod.node_name not in live_nodes:
+                # node-loss failure model (the node-lifecycle controller +
+                # pod GC analog): a pod bound to a DELETED node is gone —
+                # mark it Failed so the clique replaces it and the
+                # scheduler rebinds elsewhere (terminal pods stay as they
+                # ended — a SUCCEEDED pod did not fail)
+                if pod.status.phase not in (PodPhase.FAILED,
+                                            PodPhase.SUCCEEDED):
+                    to_lose.append(key)
+                continue
             if pod.metadata.uid in self._crashed:
                 continue  # stays NotReady until recover_pod
             if pod.status.phase == PodPhase.FAILED:
                 continue
-            if not pod.node_name or pod.spec.scheduling_gates:
+            if pod.spec.scheduling_gates:
                 continue
-            if pod.metadata.deletion_timestamp is not None:
-                continue
-            key = (pod.metadata.namespace, pod.metadata.name)
             if pod.status.phase == PodPhase.PENDING:
                 to_run.append(key)
             elif pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
                 if self._barrier_open(pod, ready_at_tick_start):
                     to_ready.append(key)
         now = self.store.clock.now()
+
+        def lost(status):
+            status.phase = PodPhase.FAILED
+            status.ready = False
+
+        for ns, name in to_lose:
+            changes += self.store.patch_status(Pod.KIND, ns, name, lost)
 
         def start(status):
             status.phase = PodPhase.RUNNING

@@ -490,3 +490,51 @@ class TestPP_PriorityPreemption:
         assert cond(scaled, PodGangConditionType.SCHEDULED.value).status == "True"
         hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
         assert cond(hi_gang, PodGangConditionType.SCHEDULED.value).status == "False"
+
+
+class TestFT_NodeLoss:
+    """FT7: node deletion with bound pods (the node-lifecycle + pod GC
+    failure model). Pods on a vanished node are lost, replaced, and
+    rebound to surviving capacity; the gang recovers."""
+
+    def test_ft7_node_deletion_replaces_and_rebinds_pods(self):
+        from grove_tpu.api.types import Node
+
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)]))
+        h.settle()
+        placements = {p.metadata.name: p.node_name
+                      for p in h.store.list(Pod.KIND)}
+        lost = next(iter(placements.values()))
+        h.store.delete(Node.KIND, "default", lost)
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 2
+        assert all(p.node_name and p.node_name != lost for p in pods), [
+            (p.metadata.name, p.node_name) for p in pods
+        ]
+        assert all(p.status.ready for p in pods)
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        assert cond(gang, PodGangConditionType.UNHEALTHY.value).status == "False"
+
+    def test_ft7b_total_node_loss_holds_pods_pending(self):
+        from grove_tpu.api.types import Node
+
+        h = Harness(nodes=make_nodes(1))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=1, cpu=1.0)]))
+        h.settle()
+        h.store.delete(Node.KIND, "default", "node-0")
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        pods = h.store.list(Pod.KIND)
+        assert pods and all(not p.node_name for p in pods), [
+            (p.metadata.name, p.node_name) for p in pods
+        ]
+        # capacity returns -> recovery
+        for n in make_nodes(1, name_prefix="new"):
+            h.store.create(n)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        pods = h.store.list(Pod.KIND)
+        assert all(p.node_name == "new-0" and p.status.ready for p in pods)
