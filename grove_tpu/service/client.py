@@ -18,19 +18,32 @@ from ..topology.encoding import TopologySnapshot
 from . import codec
 from .server import SERVICE, snapshot_epoch
 
-#: one channel per address, shared by every engine the scheduler builds
-#: (it constructs a fresh engine whenever the static topology changes —
-#: per-engine channels would leak fds/threads under node churn). Channels
-#: live for the process, like the operator's apiserver connection.
-_channels: dict[str, grpc.Channel] = {}
+#: one channel per (address, CA) pair, shared by every engine the
+#: scheduler builds (it constructs a fresh engine whenever the static
+#: topology changes — per-engine channels would leak fds/threads under
+#: node churn). Channels live for the process, like the operator's
+#: apiserver connection.
+_channels: dict[tuple[str, bytes | None], grpc.Channel] = {}
 
 
-def _channel_for(address: str) -> grpc.Channel:
-    ch = _channels.get(address)
+def _channel_for(address: str, root_ca: bytes | None = None) -> grpc.Channel:
+    key = (address, root_ca)
+    ch = _channels.get(key)
     if ch is None:
-        ch = _channels[address] = grpc.insecure_channel(
-            address, options=codec.GRPC_MESSAGE_OPTIONS
-        )
+        # CA rotation: a new CA for the same address supersedes the old
+        # channel — close and evict it rather than leaking fds per rotation
+        for old_key in [k for k in _channels if k[0] == address]:
+            _channels.pop(old_key).close()
+        if root_ca is not None:
+            creds = grpc.ssl_channel_credentials(root_certificates=root_ca)
+            ch = grpc.secure_channel(
+                address, creds, options=codec.GRPC_MESSAGE_OPTIONS
+            )
+        else:
+            ch = grpc.insecure_channel(
+                address, options=codec.GRPC_MESSAGE_OPTIONS
+            )
+        _channels[key] = ch
     return ch
 
 
@@ -42,7 +55,7 @@ class RemotePlacementEngine:
 
     def __init__(self, snapshot: TopologySnapshot, address: str,
                  metrics=None, timeout_seconds: float = 120.0,
-                 **_engine_knobs):
+                 root_ca: bytes | None = None, **_engine_knobs):
         self.snapshot = snapshot
         self.address = address
         self.metrics = metrics
@@ -50,7 +63,7 @@ class RemotePlacementEngine:
         #: error (manager retries) rather than blocking the control plane
         #: forever
         self.timeout_seconds = timeout_seconds
-        channel = _channel_for(address)
+        channel = _channel_for(address, root_ca)
         self._sync = channel.unary_unary(f"/{SERVICE}/Sync")
         self._solve = channel.unary_unary(f"/{SERVICE}/Solve")
         self.epoch = snapshot_epoch(snapshot)

@@ -89,10 +89,12 @@ class PlacementService:
 
 
 def serve(address: str, service: PlacementService | None = None,
-          max_workers: int = 4) -> grpc.Server:
+          max_workers: int = 4, tls=None) -> grpc.Server:
     """Start a gRPC server for the placement service at `address`
     (e.g. "unix:/tmp/grove-placement.sock" or "127.0.0.1:7077").
-    Returns the started server; caller owns stop()."""
+    tls: an optional tls.CertBundle — the self-managed webhook-TLS analog
+    (cert.go:36-70); plaintext without it. Returns the started server;
+    caller owns stop()."""
     service = service or PlacementService()
     identity = lambda b: b  # noqa: E731 — codec owns (de)serialization
     handler = grpc.method_handlers_generic_handler(
@@ -111,7 +113,11 @@ def serve(address: str, service: PlacementService | None = None,
         options=codec.GRPC_MESSAGE_OPTIONS,
     )
     server.add_generic_rpc_handlers((handler,))
-    server.add_insecure_port(address)
+    if tls is not None:
+        creds = grpc.ssl_server_credentials([(tls.key, tls.cert)])
+        server.add_secure_port(address, creds)
+    else:
+        server.add_insecure_port(address)
     server.start()
     return server
 
@@ -121,9 +127,29 @@ def main() -> int:  # pragma: no cover - thin CLI
 
     ap = argparse.ArgumentParser(description="grove_tpu placement service")
     ap.add_argument("--address", default="127.0.0.1:7077")
+    ap.add_argument("--tls-dir", default=None,
+                    help="write a self-managed CA + server cert here and "
+                    "serve TLS; clients read ca.pem from the same dir")
     args = ap.parse_args()
-    server = serve(args.address)
-    print(f"placement service listening on {args.address}", flush=True)
+    tls_bundle = None
+    if args.tls_dir:
+        from pathlib import Path
+
+        from .tls import issue_server_cert, load_or_create_ca
+
+        if args.address.startswith("unix:"):
+            host = "localhost"
+        else:
+            host = args.address.rsplit(":", 1)[0] or "localhost"
+        # persistent CA: restarts re-issue the server cert (rotation)
+        # under the SAME CA, so clients holding ca.pem keep trusting
+        ca_cert, ca_key = load_or_create_ca(args.tls_dir)
+        tls_bundle = issue_server_cert(ca_cert, ca_key, hostname=host)
+        (Path(args.tls_dir) / "server.pem").write_bytes(tls_bundle.cert)
+    server = serve(args.address, tls=tls_bundle)
+    mode = "TLS" if tls_bundle else "plaintext"
+    print(f"placement service listening on {args.address} ({mode})",
+          flush=True)
     server.wait_for_termination()
     return 0
 
