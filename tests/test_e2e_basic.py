@@ -242,3 +242,36 @@ class TestNodeSelectorEndToEnd:
         assert all(p.node_name in untainted for p in pods), [
             (p.metadata.name, p.node_name) for p in pods
         ]
+
+
+class TestMultiNamespace:
+    """Namespaces isolate workloads end to end: same-named objects in two
+    namespaces coexist, selection/scheduling never crosses, and deleting
+    one tree leaves the other untouched."""
+
+    def test_same_names_in_two_namespaces(self):
+        h = Harness(nodes=make_nodes(16))
+        for ns in ("team-a", "team-b"):
+            pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+            pcs.metadata.namespace = ns
+            h.apply(pcs)
+        h.settle()
+        for ns in ("team-a", "team-b"):
+            pods = h.store.list(Pod.KIND, namespace=ns)
+            assert len(pods) == 2 and all(
+                p.node_name and p.status.ready for p in pods
+            )
+            gang = h.store.get(PodGang.KIND, ns, "simple1-0")
+            assert gang is not None
+            assert all(
+                ref.namespace == ns
+                for gr in gang.spec.pod_groups
+                for ref in gr.pod_references
+            )
+        # cascade delete one namespace's tree; the other is untouched
+        h.store.delete(PodCliqueSet.KIND, "team-a", "simple1")
+        h.settle()
+        assert h.store.list(Pod.KIND, namespace="team-a") == []
+        assert h.store.get(PodGang.KIND, "team-a", "simple1-0") is None
+        b_pods = h.store.list(Pod.KIND, namespace="team-b")
+        assert len(b_pods) == 2 and all(p.status.ready for p in b_pods)
