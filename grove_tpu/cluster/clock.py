@@ -17,6 +17,7 @@ class SimClock:
         return self._now
 
     def advance(self, seconds: float) -> float:
-        assert seconds >= 0, "time only moves forward"
+        if seconds < 0:  # explicit: must survive python -O
+            raise ValueError("time only moves forward")
         self._now += seconds
         return self._now

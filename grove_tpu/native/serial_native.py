@@ -34,7 +34,11 @@ def _encode_elig(order: list[SolverGang], num_nodes: int):
     if not rows:
         return None, None
     masks = np.ascontiguousarray(np.stack(rows).astype(np.uint8))
-    assert masks.shape[1] == num_nodes
+    if masks.shape[1] != num_nodes:  # guards C++ OOB; must survive python -O
+        raise ValueError(
+            f"eligibility masks are {masks.shape[1]}-wide, snapshot has "
+            f"{num_nodes} nodes"
+        )
     return masks, idx
 
 

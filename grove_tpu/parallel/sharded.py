@@ -54,7 +54,8 @@ def make_solver_mesh(devices=None, gang_axis: int | None = None) -> Mesh:
             if n % f == 0:
                 gang_axis = n // f
                 break
-    assert n % gang_axis == 0, f"{gang_axis} does not divide {n} devices"
+    if n % gang_axis:  # explicit: must survive python -O
+        raise ValueError(f"gang_axis {gang_axis} does not divide {n} devices")
     arr = np.asarray(devices).reshape(gang_axis, n // gang_axis)
     return Mesh(arr, axis_names=("gangs", "nodes"))
 
