@@ -90,6 +90,18 @@ class TopologyAwareSchedulingConfig:
 
 
 @dataclass
+class LeaderElectionConfig:
+    """HA leader election (types.go LeaderElection block; manager.go:98-104
+    wires it into controller-runtime). One active manager per lease;
+    standbys take over when the holder stops renewing."""
+
+    enabled: bool = False
+    lease_name: str = "grove-operator"
+    lease_namespace: str = "grove-system"
+    lease_duration_seconds: float = 15.0
+
+
+@dataclass
 class LogConfig:
     level: str = "info"
     format: str = "text"
@@ -108,6 +120,9 @@ class OperatorConfig:
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
     topology_aware_scheduling: TopologyAwareSchedulingConfig = field(
         default_factory=TopologyAwareSchedulingConfig
+    )
+    leader_election: LeaderElectionConfig = field(
+        default_factory=LeaderElectionConfig
     )
     log: LogConfig = field(default_factory=LogConfig)
 
@@ -138,6 +153,7 @@ def _build(cls, data: Any, path: str, errs: list[str]):
 
 _TYPES = {
     "WorkloadDefaultsConfig": WorkloadDefaultsConfig,
+    "LeaderElectionConfig": LeaderElectionConfig,
     "ControllerConfig": ControllerConfig,
     "SolverConfig": SolverConfig,
     "AutoscalerConfig": AutoscalerConfig,
@@ -210,6 +226,14 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append("config.solver.native_repair: must be a bool")
     if not isinstance(sv.preemption_enabled, bool):
         errs.append("config.solver.preemption_enabled: must be a bool")
+
+    le = cfg.leader_election
+    if not isinstance(le.enabled, bool):
+        errs.append("config.leader_election.enabled: must be a bool")
+    if not _num(le.lease_duration_seconds) or le.lease_duration_seconds <= 0:
+        errs.append(
+            "config.leader_election.lease_duration_seconds: must be > 0"
+        )
 
     if not _num(cfg.autoscaler.tolerance) or not (0 <= cfg.autoscaler.tolerance < 1):
         errs.append("config.autoscaler.tolerance: must be in [0, 1)")

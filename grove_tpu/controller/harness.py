@@ -19,6 +19,11 @@ from .runtime import ControllerManager
 from .scheduler import GangScheduler
 
 
+from itertools import count as _count
+
+_replica_counter = _count()
+
+
 class Harness:
     def __init__(self, nodes: list[Node] | None = None,
                  cluster: Cluster | None = None, engine_cls=None,
@@ -34,6 +39,23 @@ class Harness:
         self.store = self.cluster.store
         self.clock = self.cluster.clock
         self.kubelet = self.cluster.kubelet
+        elector = None
+        if self.config.leader_election.enabled:
+            from .leaderelection import LeaderElector
+
+            le = self.config.leader_election
+            # each manager instance is its own replica identity
+            elector = LeaderElector(
+                self.store,
+                identity=(
+                    f"{self.config.authorization.operator_identity}"
+                    f"#{next(_replica_counter)}"
+                ),
+                lease_name=le.lease_name,
+                namespace=le.lease_namespace,
+                lease_duration_seconds=le.lease_duration_seconds,
+            )
+        self.elector = elector
         self.manager = ControllerManager(
             self.store,
             identity=self.config.authorization.operator_identity,
@@ -42,6 +64,7 @@ class Harness:
             ),
             logger=self.cluster.logger.with_name("manager"),
             metrics=self.cluster.metrics,
+            elector=elector,
         )
         self.manager.register(
             PodCliqueSetReconciler(self.store, config=self.config)
@@ -59,7 +82,14 @@ class Harness:
     def autoscale(self) -> None:
         """One periodic HPA sweep + settle (the HPA sync interval). The
         sweep mutates managed scale targets, so it runs as the operator
-        identity like any reconcile."""
+        identity like any reconcile — and, under HA, only on the replica
+        holding the lease (a standby sweeping would be split-brain)."""
+        if self.elector is not None:
+            with self.store.impersonate(
+                self.manager.identity or self.store.actor
+            ):
+                if not self.elector.try_acquire():
+                    return  # standing by: the leader sweeps
         with self.store.impersonate(self.manager.identity or self.store.actor):
             self.autoscaler.run_all()
         self.settle()

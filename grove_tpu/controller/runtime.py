@@ -56,8 +56,13 @@ class Reconciler(Protocol):
 class ControllerManager:
     def __init__(self, store: ObjectStore, identity: str | None = None,
                  error_retry_seconds: float = 5.0, logger=None,
-                 metrics=None):
+                 metrics=None, elector=None):
         self.store = store
+        #: optional LeaderElector (manager.go:98-104): a manager that does
+        #: not hold the lease runs NOTHING — it neither drains events nor
+        #: reconciles, so its cursor stays put and takeover replays (or
+        #: relists past a compaction horizon) to catch up
+        self.elector = elector
         #: observability.MetricsRegistry; the controller-runtime metrics
         #: analog (workqueue depth, reconcile totals/errors/duration per
         #: controller — manager.go exposes these via its metrics server)
@@ -148,6 +153,21 @@ class ControllerManager:
     def run_once(self) -> int:
         """Drain events + due requeues, run every queued reconcile once.
         Returns the number of reconciles executed."""
+        if self.elector is not None:
+            acquire = self.elector.try_acquire
+            if self.identity is not None:
+                with self.store.impersonate(self.identity):
+                    held = acquire()
+            else:
+                held = acquire()
+            if not held:
+                if self.metrics is not None:
+                    # a standby has no queue of its own to report
+                    self.metrics.gauge(
+                        "grove_manager_workqueue_depth",
+                        "requests drained into the current reconcile round",
+                    ).set(0.0)
+                return 0  # standing by
         self._drain_events()
         self._pop_due_requeues()
         batch, self._queue = self._queue, []
@@ -231,9 +251,13 @@ class ControllerManager:
 
     def settle(self, max_rounds: int = 256) -> None:
         """Run until no events are pending and the queue is empty (due
-        requeues included; future requeues are left on the heap)."""
+        requeues included; future requeues are left on the heap). A
+        manager standing by for the lease is quiescent by definition —
+        work waits for the leader, not for this replica."""
         for _ in range(max_rounds):
             if self.run_once() == 0:
+                if self.elector is not None and not self.elector.is_leader():
+                    return  # standing by: nothing is ours to run
                 self._drain_events()
                 self._pop_due_requeues()
                 if not self._queue:

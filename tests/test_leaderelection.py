@@ -1,0 +1,103 @@
+"""HA leader election (manager.go:98-104): one active manager per lease;
+a standby takes over when the leader stops renewing or releases."""
+
+from grove_tpu.api.types import Pod
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+HA = {"leader_election": {"enabled": True, "lease_duration_seconds": 15.0}}
+
+
+def ha_pair():
+    leader = Harness(nodes=make_nodes(8), config=dict(HA))
+    standby = Harness(cluster=leader.cluster)
+    return leader, standby
+
+
+def test_standby_runs_nothing_while_leader_holds_lease():
+    leader, standby = ha_pair()
+    leader.manager.run_once()  # first to try wins the lease
+    leader.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    assert standby.manager.run_once() == 0  # cannot acquire: stands by
+    leader.settle()
+    pods = leader.store.list(Pod.KIND)
+    assert len(pods) == 2 and all(p.status.ready for p in pods)
+    # the whole settle ran under ONE leader
+    assert leader.elector.is_leader() and not standby.elector.is_leader()
+
+
+def test_standby_takes_over_after_lease_expiry():
+    leader, standby = ha_pair()
+    leader.settle()  # leader acquires
+    assert leader.elector.is_leader()
+    # leader "crashes": stops running; work arrives meanwhile
+    leader.cluster.store.create(
+        simple_pcs(cliques=[clique("w", replicas=2)])
+    )
+    assert standby.manager.run_once() == 0  # lease still fresh
+    standby.clock.advance(16.0)  # past lease_duration: holder is stale
+    standby.settle()
+    assert standby.elector.is_leader()
+    pods = standby.store.list(Pod.KIND)
+    assert len(pods) == 2 and all(p.node_name and p.status.ready
+                                  for p in pods)
+
+
+def test_clean_release_hands_off_immediately():
+    leader, standby = ha_pair()
+    leader.settle()
+    leader.elector.release()  # graceful shutdown (ReleaseOnCancel)
+    standby.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    standby.settle()  # no lease wait needed
+    assert standby.elector.is_leader()
+    assert all(p.status.ready for p in standby.store.list(Pod.KIND))
+
+
+def test_no_split_brain_under_alternating_steps():
+    """Interleaved run_once calls never let both managers reconcile in
+    the same window while the lease is fresh."""
+    leader, standby = ha_pair()
+    leader.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    for _ in range(16):
+        a = leader.manager.run_once()
+        b = standby.manager.run_once()
+        leader.kubelet.tick()
+        assert b == 0, "standby reconciled while the leader held the lease"
+        if a == 0:
+            break
+    assert all(p.status.ready for p in leader.store.list(Pod.KIND))
+
+
+def test_standby_autoscale_is_a_noop():
+    """HPA sweeps are leader-only: a standby's periodic autoscale() must
+    not mutate scale targets (split-brain guard)."""
+    from grove_tpu.api.types import (
+        AutoScalingConfig,
+        PodCliqueScalingGroup,
+        PodCliqueScalingGroupConfig,
+    )
+
+    leader, standby = ha_pair()
+    pcs = simple_pcs(
+        name="as",
+        cliques=[clique("w", replicas=2)],
+        sgs=[PodCliqueScalingGroupConfig(
+            name="grp", clique_names=["w"], replicas=2, min_available=1,
+            scale_config=AutoScalingConfig(min_replicas=1, max_replicas=5,
+                                           target_utilization=0.5))],
+    )
+    leader.apply(pcs)
+    leader.settle()
+    for p in leader.store.list(Pod.KIND):
+        standby.autoscaler.observe(p.metadata.name, 1.0)  # 2x target
+    standby.autoscale()  # not the leader: must not scale
+    pcsg = standby.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+    assert pcsg.spec.replicas == 2
+    # the leader's sweep does scale
+    for p in leader.store.list(Pod.KIND):
+        leader.autoscaler.observe(p.metadata.name, 1.0)
+    leader.autoscale()
+    pcsg = leader.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
+    assert pcsg.spec.replicas == 4
