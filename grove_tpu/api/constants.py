@@ -88,3 +88,9 @@ MAX_COMBINED_NAME_LENGTH = 45
 # and reserves a fixed 8/10 chars for indices; counting the generated name
 # exactly closes the gap where huge replica counts overflow the reserve.
 MAX_GENERATED_NAME_LENGTH = 63
+
+#: The gang scheduler's own name: pods with an empty schedulerName or this
+#: one are grove_tpu's to place; any other name routes to an external
+#: scheduler (the reference routes schedulerName=kai-scheduler pods to KAI
+#: the same way — single-name rule enforced by validation).
+SCHEDULER_NAME = "grove-tpu-scheduler"

@@ -196,8 +196,12 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
                 errs.append(f"{path}.spec.scaleConfig.maxReplicas must be >= replicas")
             if sc.min_replicas < 1:
                 errs.append(f"{path}.spec.scaleConfig.minReplicas must be >= 1")
-        if clique.spec.pod_spec.scheduler_name:
-            scheduler_names.add(clique.spec.pod_spec.scheduler_name)
+        # empty means the framework's own scheduler — mixing it with a
+        # foreign name would deadlock the gang (half its pods routed
+        # elsewhere), so it counts toward the single-name rule
+        scheduler_names.add(
+            clique.spec.pod_spec.scheduler_name or constants.SCHEDULER_NAME
+        )
         _validate_topology_constraint(
             clique.spec.topology_constraint, f"{path}.spec.topologyConstraint", errs
         )

@@ -269,15 +269,28 @@ class GangScheduler:
                     and not pod.node_name
                     and not pod.spec.scheduling_gates
                     and pod.metadata.deletion_timestamp is None
+                    # same ownership filter as _bind_best_effort: a
+                    # foreign-named pod we will never bind must not mark
+                    # the gang starved (permanent busy-retry otherwise)
+                    and self._ours(pod)
                 ):
                     return True
         return False
 
     # -- backlog membership -------------------------------------------------
+    @staticmethod
+    def _ours(pod: Pod) -> bool:
+        """schedulerName routing (the reference routes kai-scheduler pods
+        to KAI): empty or our own name is grove_tpu's to place; anything
+        else belongs to an external scheduler and we never touch it."""
+        name = pod.spec.scheduler_name
+        return not name or name == constants.SCHEDULER_NAME
+
     def _gang_ready_to_schedule(self, gang: PodGang) -> bool:
-        """Every min-replica pod exists and is ungated (the operator's gate
-        removal is the admission signal; scaled gangs stay gated until their
-        base gang schedules, so they naturally stay out of the backlog)."""
+        """Every min-replica pod exists, is ungated, and is OURS to
+        schedule (the operator's gate removal is the admission signal;
+        scaled gangs stay gated until their base gang schedules, so they
+        naturally stay out of the backlog)."""
         for group in gang.spec.pod_groups:
             refs = group.pod_references[: group.min_replicas]
             if len(refs) < group.min_replicas:
@@ -286,6 +299,8 @@ class GangScheduler:
                 pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
                 if pod is None or pod.spec.scheduling_gates or pod.node_name:
                     return False
+                if not self._ours(pod):
+                    return False  # a foreign scheduler owns this gang
         return True
 
     def _priority_of(self, gang: PodGang) -> float:
@@ -607,6 +622,7 @@ class GangScheduler:
                         or pod.node_name
                         or pod.spec.scheduling_gates
                         or pod.metadata.deletion_timestamp is not None
+                        or not self._ours(pod)
                     ):
                         continue
                     demand = demand_fn(ref.namespace, ref.name)

@@ -275,3 +275,71 @@ class TestMultiNamespace:
         assert h.store.get(PodGang.KIND, "team-a", "simple1-0") is None
         b_pods = h.store.list(Pod.KIND, namespace="team-b")
         assert len(b_pods) == 2 and all(p.status.ready for p in b_pods)
+
+
+class TestSchedulerNameRouting:
+    """schedulerName routing: pods naming a foreign scheduler are never
+    touched by the gang scheduler (the reference routes its pods to KAI
+    by schedulerName the same way); empty or grove-tpu-scheduler is ours."""
+
+    def foreign_pcs(self):
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.scheduler_name = "third-party-scheduler"
+        return pcs
+
+    def test_foreign_gang_is_left_to_its_scheduler(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(self.foreign_pcs())
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        # operator machinery ran (pods exist, ungated, gang created) but
+        # OUR scheduler never bound them or wrote Unschedulable
+        assert pods and all(not p.spec.scheduling_gates for p in pods)
+        assert all(not p.node_name for p in pods)
+        gang = h.store.get(PodGang.KIND, "default", "simple1-0")
+        assert gang is not None
+        assert get_condition(gang.status.conditions, "Scheduled") is None
+        # an "external scheduler" binds them AND writes the PodGang
+        # contract's status — exactly KAI's duty in the reference (gate
+        # removal for scaled pods reads the base gang's Scheduled that the
+        # OWNING scheduler writes, syncflow.go:306-345)
+        from grove_tpu.api.meta import set_condition
+
+        for i, p in enumerate(pods):
+            h.store.bind_pod("default", p.metadata.name, f"node-{i}")
+
+        def external_scheduled(status):
+            set_condition(status.conditions, "Scheduled", "True",
+                          reason="ExternallyPlaced", now=h.clock.now())
+
+        h.store.patch_status(PodGang.KIND, "default", "simple1-0",
+                             external_scheduled)
+        h.settle()
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_mixed_empty_and_foreign_scheduler_rejected(self):
+        """Empty schedulerName counts as the framework's own in the
+        single-name rule: mixing it with a foreign name would deadlock
+        the gang (half its pods routed elsewhere)."""
+        import pytest
+
+        from grove_tpu.api.validation import ValidationError
+
+        pcs = simple_pcs(cliques=[clique("a", replicas=1),
+                                  clique("b", replicas=1)])
+        pcs.spec.template.cliques[1].spec.pod_spec.scheduler_name = "kai"
+        h = Harness(nodes=make_nodes(4))
+        with pytest.raises(ValidationError) as err:
+            h.apply(pcs)
+        assert "single scheduler" in str(err.value)
+
+    def test_explicit_grove_scheduler_name_is_ours(self):
+        pcs = simple_pcs(cliques=[clique("w", replicas=2, cpu=1.0)])
+        for c in pcs.spec.template.cliques:
+            c.spec.pod_spec.scheduler_name = constants.SCHEDULER_NAME
+        h = Harness(nodes=make_nodes(4))
+        h.apply(pcs)
+        h.settle()
+        assert all(p.node_name and p.status.ready
+                   for p in h.store.list(Pod.KIND))
