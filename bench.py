@@ -101,7 +101,7 @@ def main() -> int:
                     help="CPU-friendly quick run (512 nodes, 64 gangs)")
     ap.add_argument("--nodes", type=int, default=5000)
     ap.add_argument("--gangs", type=int, default=1000)
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=9)
     ap.add_argument("--serial-sample", type=int, default=0,
                     help="measure serial baseline on this many gangs and "
                     "extrapolate (0 = run the full backlog serially)")
@@ -159,7 +159,13 @@ def main() -> int:
         placed = engine.solve(gangs).num_placed
 
     bind_h = registry.histogram("grove_solver_backlog_bind_seconds")
-    engine_wall = bind_h.percentile(99)
+    # Throughput (value, vs_baseline) uses the MEDIAN solve wall: through
+    # the shared dev tunnel a single congested iteration can triple the
+    # max, and p99-of-K IS the max — one hiccup would misreport steady
+    # throughput 3x low. The p99 is still reported for BASELINE's <1s
+    # latency north star.
+    engine_wall = bind_h.percentile(50)
+    engine_p99 = bind_h.percentile(99)
     score = registry.histogram("grove_solver_placement_score").mean()
     # counters accumulate across the identical iterations; report per-solve
     fallbacks = int(
@@ -173,13 +179,16 @@ def main() -> int:
     from grove_tpu.native import solve_serial_native
 
     sample = args.serial_sample or len(gangs)
-    t0 = time.perf_counter()
-    sres = solve_serial_native(snapshot, gangs[:sample])
+    serial_runs = []
     baseline = "native-cpp"
-    if sres is None:
-        sres = solve_serial(snapshot, gangs[:sample])
-        baseline = "python"
-    serial_sample_wall = time.perf_counter() - t0
+    for _ in range(3):  # median-of-3: same noise treatment as the engine
+        t0 = time.perf_counter()
+        sres = solve_serial_native(snapshot, gangs[:sample])
+        if sres is None:
+            sres = solve_serial(snapshot, gangs[:sample])
+            baseline = "python"
+        serial_runs.append(time.perf_counter() - t0)
+    serial_sample_wall = sorted(serial_runs)[1]
     serial_wall = serial_sample_wall * (len(gangs) / max(sample, 1))
 
     # Control-plane bench (VERDICT r1 #4): the FULL path — apply one PCS
@@ -197,7 +206,12 @@ def main() -> int:
         "value": round(gangs_per_sec, 1),
         "unit": "gangs/sec",
         "vs_baseline": round(serial_wall / engine_wall, 2),
-        "p99_backlog_bind_seconds": round(engine_wall, 4),
+        # r3 basis change, recorded so BENCH files are self-describing:
+        # r1/r2 computed value+vs_baseline from p99 (=max of iters); a
+        # single tunnel hiccup misreported steady throughput 3x low
+        "throughput_basis": "p50_of_iters",
+        "p50_backlog_bind_seconds": round(engine_wall, 4),
+        "p99_backlog_bind_seconds": round(engine_p99, 4),
         "serial_baseline_seconds": round(serial_wall, 2),
         "serial_baseline_impl": baseline,
         "serial_sampled_gangs": sample,

@@ -414,6 +414,20 @@ class GangScheduler:
                 continue  # only SCALED gangs are reclaim victims
             if not _cond_true(gang, PodGangConditionType.SCHEDULED.value):
                 continue
+            first_ref = next(
+                (ref for gr in gang.spec.pod_groups
+                 for ref in gr.pod_references), None
+            )
+            first_pod = (
+                self.store.peek(Pod.KIND, first_ref.namespace,
+                                first_ref.name)
+                if first_ref is not None else None
+            )
+            if first_pod is not None and not self._ours(first_pod):
+                # routed to a foreign scheduler (one name per PCS, so one
+                # pod speaks for the gang): never evict what we never
+                # placed — cross-scheduler eviction would just thrash
+                continue
             evictable.append(
                 (self._priority_of(gang), gang.metadata.name, gang)
             )

@@ -538,3 +538,46 @@ class TestFT_NodeLoss:
         h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
         pods = h.store.list(Pod.KIND)
         assert all(p.node_name == "new-0" and p.status.ready for p in pods)
+
+def test_pp7_foreign_scheduled_gangs_are_never_preempted():
+    """Routing contract: grove must not evict pods of a gang owned by
+    a foreign scheduler, no matter the priorities."""
+    from grove_tpu.api.meta import ObjectMeta, set_condition
+    from grove_tpu.api.auxiliary import PriorityClass
+    from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+    h = Harness(nodes=make_nodes(
+        4, racks_per_block=2, hosts_per_rack=2,
+        allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}))
+    low = simple_pcs(
+        name="low",
+        cliques=[clique("w", replicas=1, cpu=1.0)],
+        sgs=[PodCliqueScalingGroupConfig(
+            name="grp", clique_names=["w"], replicas=4, min_available=1)],
+    )
+    for c in low.spec.template.cliques:
+        c.spec.pod_spec.scheduler_name = "third-party-scheduler"
+    h.apply(low)
+    h.settle()
+    # the external scheduler fills the cluster and writes the contract
+    pods = h.store.list(Pod.KIND)
+    for i, p in enumerate(sorted(pods, key=lambda x: x.metadata.name)):
+        h.store.bind_pod("default", p.metadata.name, f"node-{i}")
+    for g in h.store.list(PodGang.KIND):
+        def mark(status):
+            set_condition(status.conditions, "Scheduled", "True",
+                          reason="ExternallyPlaced", now=h.clock.now())
+        h.store.patch_status(PodGang.KIND, "default", g.metadata.name, mark)
+    h.settle()
+    h.store.create(PriorityClass(
+        metadata=ObjectMeta(name="gold", namespace=""), value=1000.0))
+    hi = simple_pcs(name="hi", cliques=[clique("w", replicas=1, cpu=1.0)])
+    hi.spec.template.priority_class_name = "gold"
+    h.apply(hi)
+    h.settle()
+    h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+    # no preemption of the foreign gangs; our gang waits
+    assert h.cluster.metrics.counter(
+        "grove_scheduler_preemptions_total").total() == 0
+    assert all(p.node_name for p in h.store.list(
+        Pod.KIND, labels={constants.LABEL_PART_OF: "low"}))
