@@ -596,3 +596,41 @@ class TestRU_PodCliqueScaleBeforeUpdate:
         assert len(h.store.list(Pod.KIND)) == 2
         bump_image(h, "s")
         self.finish(h, expect_pods=2)
+
+
+class TestRU_TerminationDuringUpdate:
+    """The remaining named race: a replica breaches MinAvailable and its
+    termination delay expires WHILE the rolling update is mid-flight —
+    gang termination rebuilds the replica and the update still completes
+    on the new template."""
+
+    def test_gang_termination_mid_update_converges(self):
+        h = Harness(nodes=make_nodes(16))
+        pcs = simple_pcs(name="t", replicas=2,
+                         cliques=[clique("w", replicas=2, cpu=1.0)])
+        pcs.spec.template.termination_delay = 30.0
+        h.apply(pcs)
+        h.settle()
+        bump_image(h, "t")
+        # start the update, then crash BOTH pods of the OTHER replica so
+        # it breaches while ordinal 0/1 is mid-update
+        for _ in range(3):
+            h.manager.run_once()
+            h.kubelet.tick()
+        pcs_live = h.store.get(PodCliqueSet.KIND, "default", "t")
+        updating = pcs_live.status.rolling_update_progress.current_replica_index
+        victim_replica = 1 - updating
+        for i in range(2):
+            h.kubelet.crash_pod("default", f"t-{victim_replica}-w-{i}")
+        h.settle()
+        # the breach clock runs out mid-update -> gang termination rebuilds
+        h.advance(31.0)
+        h.settle()
+        h.advance(RETRY)
+        h.advance(RETRY)
+        pcs_live = h.store.get(PodCliqueSet.KIND, "default", "t")
+        assert pcs_live.status.rolling_update_progress.completed
+        target = stable_hash(pcs_live.spec.template.cliques[0].spec.pod_spec)
+        assert set(pod_hashes(h).values()) == {target}
+        pods = h.store.list(Pod.KIND)
+        assert len(pods) == 4 and all(p.status.ready for p in pods)
