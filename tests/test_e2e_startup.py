@@ -97,6 +97,66 @@ class TestStartupOrdering:
         assert pod.status.ready
 
 
+class TestStartupOrderingAcrossGroups:
+    """SO5/SO6: startsAfter across scaling-group boundaries
+    (GenerateDependencyNamesForBasePodGang, componentutils
+    podcliquescalinggroup.go:70-83; scaled replicas order only within
+    their own gang instance, pcsg podclique.go:391-408)."""
+
+    def test_so5_standalone_waits_for_pcsg_base_replicas(self):
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        h = Harness(nodes=make_nodes(16))
+        pcs = simple_pcs(
+            cliques=[
+                clique("worker", replicas=2),
+                clique("router", starts_after=["worker"]),
+            ],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="sg", clique_names=["worker"], replicas=2,
+                min_available=1)],
+            startup=CliqueStartupType.EXPLICIT,
+        )
+        h.apply(pcs)
+        order = ready_order(h)
+        # router waits on the BASE group replica (sg-0), which must be
+        # ready strictly before it
+        assert order["simple1-0-sg-0-worker"] < order["simple1-0-router"]
+        pods = h.store.list(Pod.KIND)
+        router = [p for p in pods if "-router-" in p.metadata.name][0]
+        dep = router.metadata.annotations[constants.ANNOTATION_WAIT_FOR]
+        assert "simple1-0-sg-0-worker" in dep
+        assert "simple1-0-sg-1-worker" not in dep, (
+            "scaled replicas must not gate cross-group dependents"
+        )
+
+    def test_so6_scaled_replica_orders_within_its_own_instance(self):
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        h = Harness(nodes=make_nodes(16))
+        pcs = simple_pcs(
+            cliques=[
+                clique("a", replicas=1),
+                clique("b", replicas=1, starts_after=["a"]),
+            ],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="sg", clique_names=["a", "b"], replicas=2,
+                min_available=1)],
+            startup=CliqueStartupType.EXPLICIT,
+        )
+        h.apply(pcs)
+        order = ready_order(h)
+        # within each gang instance b follows its own a
+        assert order["simple1-0-sg-0-a"] < order["simple1-0-sg-0-b"]
+        assert order["simple1-0-sg-1-a"] < order["simple1-0-sg-1-b"]
+        pods = h.store.list(Pod.KIND)
+        b1 = [p for p in pods if "sg-1-b" in p.metadata.name][0]
+        dep = b1.metadata.annotations[constants.ANNOTATION_WAIT_FOR]
+        assert "simple1-0-sg-1-a" in dep and "sg-0-a" not in dep, (
+            "a scaled replica orders only within its own instance"
+        )
+
+
 class TestRBACEnforcement:
     """The RBAC trio is consumed, not decorative: the startup barrier's
     pod watch runs as the pod's ServiceAccount identity, and a missing
