@@ -56,8 +56,25 @@ class PlacementService:
         # double engine build otherwise)
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _abort(context, code, message: str, cause: Exception):
+        """abort through gRPC when serving; plain raise when called
+        directly (tests/in-process use)."""
+        if context is not None:
+            context.abort(code, message)
+        raise cause
+
+    def _decode(self, decoder, request: bytes, what: str, context):
+        try:
+            return decoder(request)
+        except Exception as err:
+            self._abort(context, grpc.StatusCode.INVALID_ARGUMENT,
+                        f"malformed {what} payload: {err}", err)
+
     def sync(self, request: bytes, context=None) -> bytes:
-        snapshot = codec.decode_topology_snapshot(request)
+        snapshot = self._decode(
+            codec.decode_topology_snapshot, request, "topology", context
+        )
         epoch = snapshot_epoch(snapshot)
         with self._lock:
             known = epoch in self._engines
@@ -74,7 +91,9 @@ class PlacementService:
         return epoch.encode()
 
     def solve(self, request: bytes, context=None) -> bytes:
-        epoch, gangs, free = codec.decode_solve_request(request)
+        epoch, gangs, free = self._decode(
+            codec.decode_solve_request, request, "solve", context
+        )
         with self._lock:
             engine = self._engines.get(epoch)
         if engine is None:
@@ -84,7 +103,20 @@ class PlacementService:
                     f"unknown topology epoch {epoch}: Sync first",
                 )
             raise KeyError(epoch)
-        result = engine.solve(gangs, free=free)
+        if free.shape != engine.snapshot.free.shape:
+            err = ValueError(
+                f"free matrix {free.shape} does not match epoch topology "
+                f"{engine.snapshot.free.shape}"
+            )
+            self._abort(context, grpc.StatusCode.INVALID_ARGUMENT,
+                        str(err), err)
+        try:
+            result = engine.solve(gangs, free=free)
+        except Exception as err:
+            # a decodable-but-inconsistent payload (bad group indexing,
+            # mask widths, ...) must not surface as an opaque UNKNOWN
+            self._abort(context, grpc.StatusCode.INVALID_ARGUMENT,
+                        f"solve failed on payload: {err}", err)
         return codec.encode_solve_response(result)
 
 

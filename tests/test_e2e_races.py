@@ -634,3 +634,36 @@ class TestRU_TerminationDuringUpdate:
         assert set(pod_hashes(h).values()) == {target}
         pods = h.store.list(Pod.KIND)
         assert len(pods) == 4 and all(p.status.ready for p in pods)
+
+
+class TestRU_BackToBackTemplateChanges:
+    """A second template change lands while the first update is
+    mid-flight: the update restarts toward the NEW target and every pod
+    converges to v3 — no pod is left on v2, no wedge."""
+
+    def test_back_to_back_updates_converge_on_final_template(self):
+        h = Harness(nodes=make_nodes(16))
+        h.apply(simple_pcs(name="bb", replicas=2,
+                           cliques=[clique("w", replicas=2, cpu=1.0)]))
+        h.settle()
+
+        bump_image(h, "bb", tag="app:v2")
+        for _ in range(4):  # v2 rollout mid-flight
+            h.manager.run_once()
+            h.kubelet.tick()
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "bb")
+        assert not pcs.status.rolling_update_progress.completed
+        v2_target = pcs.status.rolling_update_progress.target_generation_hash
+        bump_image(h, "bb", tag="app:v3")  # restart toward the new target
+        h.settle()
+        h.advance(RETRY)
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "bb")
+        prog = pcs.status.rolling_update_progress
+        assert prog.completed
+        assert prog.target_generation_hash != v2_target
+        assert pcs.status.current_generation_hash == prog.target_generation_hash
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        hashes = pod_hashes(h)
+        assert len(hashes) == 4
+        assert set(hashes.values()) == {target}, "a pod stuck on v2"
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))

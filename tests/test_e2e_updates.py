@@ -17,10 +17,10 @@ from grove_tpu.controller.common import stable_hash
 from test_e2e_basic import clique, simple_pcs
 
 
-def bump_image(harness, name="simple1"):
+def bump_image(harness, name="simple1", tag="app:v2"):
     pcs = harness.store.get(PodCliqueSet.KIND, "default", name)
     for c in pcs.spec.template.cliques:
-        c.spec.pod_spec.containers[0].image = "app:v2"
+        c.spec.pod_spec.containers[0].image = tag
     return harness.store.update(pcs)
 
 

@@ -206,3 +206,17 @@ def test_cert_rotation_reissues_under_same_ca(tmp_path):
     second = issue_server_cert(ca_cert, ca_key)  # rotation = re-issue
     assert first.cert != second.cert
     assert first.ca_cert == second.ca_cert  # clients keep trusting the CA
+
+
+def test_malformed_payloads_are_invalid_argument(server_address):
+    """Garbage bytes must come back as INVALID_ARGUMENT with a message,
+    not an opaque server crash."""
+    import grpc
+
+    snap = cluster()
+    eng = RemotePlacementEngine(snap, server_address)
+    for stub in (eng._sync, eng._solve):
+        with pytest.raises(grpc.RpcError) as err:
+            stub(b"not an npz payload", timeout=10.0)
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "malformed" in err.value.details()
