@@ -667,3 +667,77 @@ class TestRU_BackToBackTemplateChanges:
         assert len(hashes) == 4
         assert set(hashes.values()) == {target}, "a pod stuck on v2"
         assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+class TestRU_PreemptionDuringUpdate:
+    """Cross-feature race: a high-priority gang preempts the updating
+    workload's SCALED gang mid-rolling-update. The update of the base
+    replica still completes; the victim re-queues at its priority."""
+
+    def test_preemption_mid_update_still_converges(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta, get_condition
+        from grove_tpu.api.podgang import PodGang
+
+        h = Harness(nodes=make_nodes(
+            4, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}))
+        low = simple_pcs(
+            name="low",
+            cliques=[clique("w", replicas=2, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2,
+                min_available=1)],
+        )
+        h.apply(low)
+        h.settle()
+        assert len(bound(h)) == 4  # cluster exactly full
+        bump_image(h, "low", tag="app:v2")
+        for _ in range(3):  # update mid-flight
+            h.manager.run_once()
+            h.kubelet.tick()
+        h.store.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=1000.0))
+        hi = simple_pcs(name="hi", cliques=[clique("w", replicas=2,
+                                                   cpu=1.0)])
+        hi.spec.template.priority_class_name = "gold"
+        h.apply(hi)  # needs 2; cluster is full -> preempts low's scaled gang
+        h.settle()
+        h.advance(RETRY)
+        h.advance(RETRY)
+        # high-priority workload placed
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert get_condition(hi_gang.status.conditions,
+                             "Scheduled").status == "True"
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() >= 1
+        # base gang survived the preemption...
+        base = h.store.get(PodGang.KIND, "default", "low-0")
+        assert get_condition(base.status.conditions,
+                             "Scheduled").status == "True"
+        # ...and the update PAUSES (RU10 semantics: the displaced scaled
+        # replica cannot re-ready on a full cluster) instead of wedging or
+        # collapsing availability
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "low")
+        assert not pcs.status.rolling_update_progress.completed
+        # capacity returns -> victim re-places AND the update completes
+        for n in make_nodes(2, name_prefix="extra",
+                            allocatable={"cpu": 1.0, "memory": 8.0,
+                                         "tpu": 0.0}):
+            h.store.create(n)
+        h.advance(RETRY)
+        h.advance(RETRY)
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "low")
+        assert pcs.status.rolling_update_progress.completed
+        target = stable_hash(pcs.spec.template.cliques[0].spec.pod_spec)
+        low_pods = h.store.list(Pod.KIND,
+                                labels={constants.LABEL_PART_OF: "low"})
+        assert len(low_pods) == 4
+        assert all(
+            p.node_name and p.status.ready
+            and p.metadata.labels[constants.LABEL_POD_TEMPLATE_HASH] == target
+            for p in low_pods
+        )
+        scaled = h.store.get(PodGang.KIND, "default", "low-0-grp-0")
+        assert get_condition(scaled.status.conditions,
+                             "Scheduled").status == "True"
