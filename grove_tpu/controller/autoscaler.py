@@ -29,6 +29,7 @@ KIND = HorizontalPodAutoscaler.KIND
 
 class Autoscaler:
     name = "autoscaler"
+    watch_kinds = frozenset((KIND,))
 
     def __init__(self, cluster: Cluster):
         self.cluster = cluster

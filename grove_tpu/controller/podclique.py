@@ -40,6 +40,7 @@ KIND = PodClique.KIND
 
 class PodCliqueReconciler:
     name = "podclique"
+    watch_kinds = frozenset((KIND, Pod.KIND, PodGang.KIND))
 
     def __init__(self, store: ObjectStore):
         self.store = store
@@ -184,7 +185,8 @@ class PodCliqueReconciler:
                 (
                     naming.pod_name(pclq.metadata.name, idx),
                     lambda idx=idx: self.store.create(
-                        self._build_pod(pclq, pcs, idx, sg_num_pods)
+                        self._build_pod(pclq, pcs, idx, sg_num_pods),
+                        owned=True,
                     ),
                 )
                 for idx in free_indices

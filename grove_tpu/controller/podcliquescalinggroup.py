@@ -31,6 +31,9 @@ KIND = PodCliqueScalingGroup.KIND
 
 class PCSGReconciler:
     name = "podcliquescalinggroup"
+    watch_kinds = frozenset(
+        (KIND, PodClique.KIND, "Pod", PodCliqueSet.KIND)
+    )
 
     def __init__(self, store: ObjectStore):
         self.store = store
@@ -246,7 +249,8 @@ class PCSGReconciler:
                 PodClique(
                     metadata=new_meta(pclq_name, ns, pcsg, labels),
                     spec=clone(template.spec),
-                )
+                ),
+                owned=True,
             )
         # scale-in: drop highest replica indices (components/podclique/
         # podclique.go scale-in path)

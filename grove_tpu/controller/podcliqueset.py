@@ -65,6 +65,16 @@ KIND = PodCliqueSet.KIND
 
 class PodCliqueSetReconciler:
     name = "podcliqueset"
+    watch_kinds = frozenset(
+        (
+            KIND,
+            PodClique.KIND,
+            PodCliqueScalingGroup.KIND,
+            Pod.KIND,
+            PodGang.KIND,
+            ClusterTopology.KIND,
+        )
+    )
 
     def __init__(self, store: ObjectStore, config: OperatorConfig | None = None):
         self.store = store
@@ -235,11 +245,14 @@ class PodCliqueSetReconciler:
         sa_name = f"{name}-sa"
         if self.store.get(ServiceAccount.KIND, ns, sa_name) is None:
             self.store.create(
-                ServiceAccount(metadata=new_meta(sa_name, ns, pcs, labels))
+                ServiceAccount(metadata=new_meta(sa_name, ns, pcs, labels)),
+                owned=True,
             )
         role_name = f"{name}-pod-reader"
         if self.store.get(Role.KIND, ns, role_name) is None:
-            self.store.create(Role(metadata=new_meta(role_name, ns, pcs, labels)))
+            self.store.create(
+                Role(metadata=new_meta(role_name, ns, pcs, labels)), owned=True
+            )
         rb_name = f"{name}-pod-reader"
         if self.store.get(RoleBinding.KIND, ns, rb_name) is None:
             self.store.create(
@@ -247,7 +260,8 @@ class PodCliqueSetReconciler:
                     metadata=new_meta(rb_name, ns, pcs, labels),
                     role_name=role_name,
                     service_account_name=sa_name,
-                )
+                ),
+                owned=True,
             )
         secret_name = f"{name}-sa-token"
         if self.store.get(Secret.KIND, ns, secret_name) is None:
@@ -255,7 +269,8 @@ class PodCliqueSetReconciler:
                 Secret(
                     metadata=new_meta(secret_name, ns, pcs, labels),
                     service_account_name=sa_name,
-                )
+                ),
+                owned=True,
             )
 
     def _sync_services(self, pcs: PodCliqueSet) -> None:
@@ -282,7 +297,8 @@ class PodCliqueSetReconciler:
                         publish_not_ready_addresses=(
                             cfg.publish_not_ready_addresses if cfg else True
                         ),
-                    )
+                    ),
+                    owned=True,
                 )
         for svc in self.store.scan(Service.KIND, namespace=ns, labels=labels):
             if svc.metadata.name not in expected:
@@ -327,7 +343,8 @@ class PodCliqueSetReconciler:
                 self.store.create(
                     HorizontalPodAutoscaler(
                         metadata=new_meta(hpa_name, ns, pcs, labels), spec=spec
-                    )
+                    ),
+                    owned=True,
                 )
         for hpa in self.store.scan(
             HorizontalPodAutoscaler.KIND, namespace=ns, labels=labels
@@ -459,7 +476,8 @@ class PodCliqueSetReconciler:
                 PodClique(
                     metadata=new_meta(fqn, ns, pcs, labels),
                     spec=_copy_spec(spec),
-                )
+                ),
+                owned=True,
             )
         for pclq in self.store.scan(PodClique.KIND, namespace=ns, labels=comp_labels):
             if pclq.metadata.name not in expected:
@@ -493,7 +511,8 @@ class PodCliqueSetReconciler:
                             clique_names=list(sg.clique_names),
                             topology_constraint=sg.topology_constraint,
                         ),
-                    )
+                    ),
+                    owned=True,
                 )
         for pcsg in self.store.scan(
             PodCliqueScalingGroup.KIND, namespace=ns, labels=comp_labels
@@ -551,7 +570,10 @@ class PodCliqueSetReconciler:
                     **extra_labels,
                 )
                 self.store.create(
-                    PodGang(metadata=new_meta(gang_name, ns, pcs, labels), spec=spec)
+                    PodGang(
+                        metadata=new_meta(gang_name, ns, pcs, labels), spec=spec
+                    ),
+                    owned=True,
                 )
             elif existing.spec != spec:
                 existing.spec = spec

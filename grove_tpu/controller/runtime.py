@@ -44,6 +44,12 @@ class Result:
 
 class Reconciler(Protocol):
     name: str
+    #: kinds this controller watches (None = every kind). The manager
+    #: routes events by kind (the controller-runtime Watches() registration
+    #: analog) so a pod status write is not offered to controllers that
+    #: could never care — per-event map_event fan-out across all
+    #: controllers was measurable at 10^5-event settle scale.
+    watch_kinds: Optional[frozenset[str]]
 
     def map_event(self, event: Event) -> list[Request]:
         """Watch predicate + event-to-primary mapping. Return the primary
@@ -76,6 +82,8 @@ class ControllerManager:
         #: observability.Logger (config.log); None = silent
         self.logger = logger
         self.controllers: list[Reconciler] = []
+        #: kind -> controllers watching it (rebuilt on register)
+        self._dispatch: dict[str, list[Reconciler]] = {}
         self._cursor = 0  # event-log position
         self._queue: list[tuple[str, Request]] = []
         self._queued: set[tuple[str, Request]] = set()
@@ -90,6 +98,7 @@ class ControllerManager:
 
     def register(self, controller: Reconciler) -> None:
         self.controllers.append(controller)
+        self._dispatch: dict[str, list[Reconciler]] = {}
 
     def _record_error_entry(self, cname: str, req: Request, msg: str) -> None:
         """Append to self.errors, keeping at most max_errors_per_key entries
@@ -128,8 +137,16 @@ class ControllerManager:
         else:
             if events:
                 self._cursor = events[-1].seq
+        dispatch = self._dispatch
         for event in events:
-            for controller in self.controllers:
+            ctrls = dispatch.get(event.kind)
+            if ctrls is None:
+                ctrls = dispatch[event.kind] = [
+                    c for c in self.controllers
+                    if getattr(c, "watch_kinds", None) is None
+                    or event.kind in c.watch_kinds
+                ]
+            for controller in ctrls:
                 for req in controller.map_event(event):
                     self._enqueue(controller.name, req)
 

@@ -50,6 +50,9 @@ _SINGLETON_REQ = Request("", "schedule")
 
 class GangScheduler:
     name = "scheduler"
+    watch_kinds = frozenset(
+        (PodGang.KIND, Pod.KIND, Node.KIND, ClusterTopology.KIND)
+    )
 
     def __init__(self, cluster: Cluster, engine_cls=PlacementEngine):
         self.cluster = cluster

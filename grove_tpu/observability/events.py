@@ -73,7 +73,8 @@ class EventRecorder:
                 reporting_controller=self.controller,
                 first_timestamp=now,
                 last_timestamp=now,
-            )
+            ),
+            owned=True,
         )
 
     def normal(self, involved, reason: str, message: str) -> None:
