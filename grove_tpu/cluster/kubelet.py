@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from ..api import constants
 from ..api.types import Node, Pod, PodPhase
-from .store import ObjectStore
+from .store import ObjectStore, StoreError
 
 
 def parse_wait_for(value: str) -> list[tuple[str, int]]:
@@ -42,6 +42,13 @@ def parse_wait_for(value: str) -> list[tuple[str, int]]:
 
 
 class SimKubelet:
+    """Event-driven like a real kubelet: instead of scanning every pod per
+    tick (O(pods x ticks) dominated settle at 10^4-pod scale), it keeps an
+    informer-style watch cursor on the store's event log and maintains the
+    candidate set (bound pods that still need a lifecycle step), the ready
+    set, and the live-node set incrementally. A cursor that falls behind
+    the compaction horizon relists, exactly like the controller manager."""
+
     def __init__(self, store: ObjectStore):
         self.store = store
         # keyed by pod UID: a replacement pod reusing a hole-filled NAME
@@ -49,6 +56,70 @@ class SimKubelet:
         self._crashed: set[str] = set()
         #: namespace -> {sa: granted rules}, rebuilt lazily per tick
         self._authz_cache: dict[str, dict[str, set[str]]] = {}
+        self._cursor = 0
+        #: bound pods whose phase can still advance this side of ready
+        self._candidates: set[tuple[str, str]] = set()
+        #: pods currently reporting ready
+        self._ready: set[tuple[str, str]] = set()
+        self._nodes: set[str] = set()
+        #: nodes deleted since the last tick (node-loss sweep targets);
+        #: a node that comes back before the tick is spared, preserving
+        #: the scan-at-tick-start semantics
+        self._nodes_lost: set[str] = set()
+
+    def _relist(self) -> None:
+        self._candidates.clear()
+        self._ready.clear()
+        self._nodes = {
+            n.metadata.name for n in self.store.scan(Node.KIND)
+        }
+        for pod in self.store.scan(Pod.KIND):
+            self._observe_pod(pod)
+
+    def _observe_pod(self, pod: Pod) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        if pod.status.ready:
+            self._ready.add(key)
+        else:
+            self._ready.discard(key)
+        if (
+            pod.node_name
+            and pod.metadata.deletion_timestamp is None
+            and (
+                pod.status.phase == PodPhase.PENDING
+                or (pod.status.phase == PodPhase.RUNNING
+                    and not pod.status.ready)
+            )
+        ):
+            self._candidates.add(key)
+        else:
+            self._candidates.discard(key)
+
+    def _drain(self) -> None:
+        try:
+            events = self.store.events_since(self._cursor)
+        except StoreError:
+            # fell behind the compaction horizon: relist like an informer
+            self._cursor = self.store.last_seq
+            self._relist()
+            return
+        if events:
+            self._cursor = events[-1].seq
+        for ev in events:
+            if ev.kind == Pod.KIND:
+                key = (ev.namespace, ev.name)
+                if ev.type == "Deleted":
+                    self._candidates.discard(key)
+                    self._ready.discard(key)
+                else:
+                    self._observe_pod(ev.obj)
+            elif ev.kind == Node.KIND:
+                if ev.type == "Deleted":
+                    self._nodes.discard(ev.name)
+                    self._nodes_lost.add(ev.name)
+                else:
+                    self._nodes.add(ev.name)
+                    self._nodes_lost.discard(ev.name)
 
     def crash_pod(self, namespace: str, name: str) -> None:
         """Container crash: pod stays bound/Running but NotReady until
@@ -86,38 +157,45 @@ class SimKubelet:
         which no real cluster does (informer propagation delay)."""
         changes = 0
         self._authz_cache.clear()
-        # no-copy scans: decisions read live state; mutations re-fetch a
-        # real copy below (list()'s defensive copies of every pod per tick
-        # dominated settle wall-clock at control-plane scale)
-        ready_at_tick_start = {
-            (p.metadata.namespace, p.metadata.name)
-            for p in self.store.scan(Pod.KIND)
-            if p.status.ready
-        }
-        live_nodes = {
-            n.metadata.name for n in self.store.scan(Node.KIND)
-        }
+        self._drain()
+        # the readiness snapshot is the drained state: writes made DURING
+        # this tick emit events that only land at the next drain, so
+        # membership is exactly "ready as of tick start"
+        ready_at_tick_start = self._ready
+        live_nodes = self._nodes
         to_run: list[tuple[str, str]] = []
         to_ready: list[tuple[str, str]] = []
         to_lose: list[tuple[str, str]] = []
-        for pod in self.store.scan(Pod.KIND):
-            if not pod.node_name or pod.metadata.deletion_timestamp is not None:
+        if self._nodes_lost:
+            # node-loss failure model (the node-lifecycle controller + pod
+            # GC analog): pods bound to a DELETED node are gone — mark them
+            # Failed so the clique replaces them and the scheduler rebinds
+            # elsewhere (terminal pods stay as they ended — a SUCCEEDED pod
+            # did not fail). Rare event: one full sweep, not per-tick cost.
+            lost = self._nodes_lost
+            self._nodes_lost = set()
+            for pod in self.store.scan(Pod.KIND):
+                if (
+                    pod.node_name in lost
+                    and pod.metadata.deletion_timestamp is None
+                    and pod.status.phase not in (PodPhase.FAILED,
+                                                 PodPhase.SUCCEEDED)
+                ):
+                    to_lose.append(
+                        (pod.metadata.namespace, pod.metadata.name)
+                    )
+        for key in sorted(self._candidates):
+            pod = self.store.peek(Pod.KIND, *key)
+            if (
+                pod is None
+                or not pod.node_name
+                or pod.metadata.deletion_timestamp is not None
+            ):
                 continue
-            key = (pod.metadata.namespace, pod.metadata.name)
             if pod.node_name not in live_nodes:
-                # node-loss failure model (the node-lifecycle controller +
-                # pod GC analog): a pod bound to a DELETED node is gone —
-                # mark it Failed so the clique replaces it and the
-                # scheduler rebinds elsewhere (terminal pods stay as they
-                # ended — a SUCCEEDED pod did not fail)
-                if pod.status.phase not in (PodPhase.FAILED,
-                                            PodPhase.SUCCEEDED):
-                    to_lose.append(key)
-                continue
+                continue  # swept via _nodes_lost above
             if pod.metadata.uid in self._crashed:
                 continue  # stays NotReady until recover_pod
-            if pod.status.phase == PodPhase.FAILED:
-                continue
             if pod.spec.scheduling_gates:
                 continue
             if pod.status.phase == PodPhase.PENDING:

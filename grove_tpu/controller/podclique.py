@@ -45,6 +45,17 @@ class PodCliqueReconciler:
     def __init__(self, store: ObjectStore):
         self.store = store
         self.recorder = EventRecorder(store, controller=self.name)
+        #: clique keys whose next reconcile must run the pod component
+        #: (_sync_pods: diff/replace/gates). The generation-change
+        #: predicate analog: pod phase/readiness churn only needs the
+        #: status flow — at 10^4-pod scale the pod component re-running
+        #: per status event dominated settle wall-clock.
+        self._pods_dirty: set[tuple[str, str]] = set()
+        #: cliques with a pod-template rollout in flight: readiness flips
+        #: drive _rolling_replace forward there, so they re-run the pod
+        #: component on every pod event until the rollout completes
+        #: (maintained by _reconcile_status, which computes outdated pods)
+        self._rollout_active: set[tuple[str, str]] = set()
 
     def record_error(self, request: Request, err: GroveError) -> None:
         """Every kind surfaces its own controller errors
@@ -55,11 +66,31 @@ class PodCliqueReconciler:
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
+            self._pods_dirty.add((event.namespace, event.name))
             return [Request(event.namespace, event.name)]
         if event.kind == Pod.KIND:
             pclq = event.obj.metadata.labels.get(constants.LABEL_PODCLIQUE)
-            if pclq:
-                return [Request(event.namespace, pclq)]
+            if not pclq:
+                return []
+            key = (event.namespace, pclq)
+            # pod component triggers: inventory changes (add/delete),
+            # spec changes (ungate bumps generation), active-ness flips
+            # (Failed/Succeeded pods get replaced). Pure phase/readiness
+            # churn only rolls up counts — unless a rollout is in flight,
+            # where readiness gates the next pod-at-a-time replacement.
+            if (
+                event.type != "Modified"
+                or event.old is None
+                or event.obj.metadata.generation
+                != event.old.metadata.generation
+                or is_pod_active(event.obj) != is_pod_active(event.old)
+                or (
+                    key in self._rollout_active
+                    and event.obj.status.ready != event.old.status.ready
+                )
+            ):
+                self._pods_dirty.add(key)
+            return [Request(event.namespace, pclq)]
         if event.kind == PodGang.KIND:
             # Gang creation/scheduling unblocks gate removal
             # (register.go:49-120) — but only for cliques the gang actually
@@ -68,19 +99,38 @@ class PodCliqueReconciler:
             # every clique of the PCS (the r2 shape) turned each gang
             # status write into an O(cliques) reconcile fan-out — the
             # control-plane bottleneck at 1000-replica scale.
-            reqs = [
-                Request(event.namespace, group.name)
-                for group in event.obj.spec.pod_groups
-            ]
-            base_of = event.obj.metadata.name
-            reqs.extend(
-                Request(event.namespace, p.metadata.name)
-                for p in self.store.scan(  # names only: no-copy scan
-                    KIND,
-                    namespace=event.namespace,
-                    labels={constants.LABEL_BASE_PODGANG: base_of},
-                )
+            #
+            # Gate relevance (syncflow.go:242-394): a gang's EXISTENCE and
+            # pod_references (spec) gate its own cliques' pods; its
+            # SCHEDULED condition gates pods of scaled gangs based on it.
+            # Phase/score churn gates nothing — no reconcile at all.
+            ns = event.namespace
+            spec_changed = event.type != "Modified" or event.old is None or (
+                event.obj.metadata.generation
+                != event.old.metadata.generation
             )
+            scheduled_changed = spec_changed or _is_scheduled(
+                event.obj
+            ) != _is_scheduled(event.old)
+            if not spec_changed and not scheduled_changed:
+                return []
+            reqs = []
+            if spec_changed:
+                reqs = [
+                    Request(ns, group.name)
+                    for group in event.obj.spec.pod_groups
+                ]
+            if scheduled_changed:
+                base_of = event.obj.metadata.name
+                reqs.extend(
+                    Request(ns, p.metadata.name)
+                    for p in self.store.scan(  # names only: no-copy scan
+                        KIND,
+                        namespace=ns,
+                        labels={constants.LABEL_BASE_PODGANG: base_of},
+                    )
+                )
+            self._pods_dirty.update((r.namespace, r.name) for r in reqs)
             return reqs
         return []
 
@@ -89,6 +139,9 @@ class PodCliqueReconciler:
         # every write goes through a dedicated store call (pod CRUD,
         # finalizers, patch_status) — and the per-reconcile get() clone of
         # the whole clique dominated settle at 10^3-clique scale
+        key = (request.namespace, request.name)
+        pods_dirty = key in self._pods_dirty
+        self._pods_dirty.discard(key)
         pclq = self.store.peek(KIND, request.namespace, request.name)
         if pclq is None:
             return Result()
@@ -97,7 +150,13 @@ class PodCliqueReconciler:
         self.store.add_finalizer(
             KIND, request.namespace, request.name, constants.FINALIZER_PCLQ
         )
-        self._sync_pods(pclq)
+        if pods_dirty:
+            try:
+                self._sync_pods(pclq)
+            except Exception:
+                # error-interval retry must re-run the pod component
+                self._pods_dirty.add(key)
+                raise
         self._reconcile_status(pclq)
         return Result()
 
@@ -440,6 +499,19 @@ class PodCliqueReconciler:
         scheduled = sum(1 for p in pods if p.node_name)
         gated = sum(1 for p in pods if p.spec.scheduling_gates)
         template_hash = stable_hash(fresh.spec.pod_spec)
+        # rollout tracking for map_event: while outdated pods exist (or the
+        # clique is mid-replacement, below complement), readiness flips
+        # must re-run the pod component (pod-at-a-time advancement)
+        key = (fresh.metadata.namespace, fresh.metadata.name)
+        rolling = len(pods) < fresh.spec.replicas or any(
+            p.metadata.labels.get(constants.LABEL_POD_TEMPLATE_HASH)
+            != template_hash
+            for p in pods
+        )
+        if rolling:
+            self._rollout_active.add(key)
+        else:
+            self._rollout_active.discard(key)
         min_avail = fresh.spec.min_available or fresh.spec.replicas
         now = self.store.clock.now()
         scheduled_enough = scheduled >= min_avail
