@@ -201,12 +201,15 @@ class ObjectStore:
         #: authorization disabled (the default; see api.config).
         self.authorizer: Optional[Callable[[str, str, Any], None]] = None
         self.actor = DEFAULT_ACTOR
-        # Label index: (kind, label_key, label_value) -> {obj key: obj}.
-        # Label-filtered list/scan walk the smallest matching bucket instead
-        # of every object of the kind — the equivalent of client-go's field/
-        # label indexers, and the difference between O(pods) and O(match)
-        # per controller scan at 1000-gang scale.
-        self._label_idx: dict[tuple[str, str, str], dict[tuple[str, str], Any]] = {}
+        # Label index: (kind, label_key, label_value) -> {obj key: None}
+        # (an ordered set). Label-filtered list/scan walk the smallest
+        # matching bucket instead of every object of the kind — the
+        # equivalent of client-go's field/label indexers, and the
+        # difference between O(pods) and O(match) per controller scan at
+        # 1000-gang scale. Buckets hold KEYS, not objects: an MVCC version
+        # bump with unchanged labels (status writes, binds — the vast
+        # majority) skips index maintenance entirely.
+        self._label_idx: dict[tuple[str, str, str], dict[tuple[str, str], None]] = {}
 
     # -- admission ---------------------------------------------------------
     def register_admission(self, kind: str, admission: Admission) -> None:
@@ -271,7 +274,7 @@ class ObjectStore:
     # -- label index --------------------------------------------------------
     def _index_add(self, kind: str, key: tuple[str, str], obj: Any) -> None:
         for lk, lv in obj.metadata.labels.items():
-            self._label_idx.setdefault((kind, lk, lv), {})[key] = obj
+            self._label_idx.setdefault((kind, lk, lv), {})[key] = None
 
     def _index_remove(self, kind: str, key: tuple[str, str], obj: Any) -> None:
         for lk, lv in obj.metadata.labels.items():
@@ -290,7 +293,8 @@ class ObjectStore:
                     return ()
                 if best is None or len(bucket) < len(best):
                     best = bucket
-            return best.values()
+            objs = self._objs.get(kind, {})
+            return [objs[k] for k in best]
         return self._objs.get(kind, {}).values()
 
     # -- event log ---------------------------------------------------------
@@ -498,13 +502,20 @@ class ObjectStore:
 
     def _swap(self, kind: str, key: tuple[str, str], current: Any,
               new: Any) -> None:
-        """Install a new version (MVCC): bump rv, reindex, emit. `new` must
-        carry its own metadata instance (old versions stay frozen)."""
+        """Install a new version (MVCC): bump rv, reindex if the labels
+        changed (the index maps to keys, so unchanged labels — every
+        status write and bind — skip it), emit. `new` must carry its own
+        metadata instance (old versions stay frozen)."""
         new.metadata.resource_version = next(self._seq)
         bucket = self._objs[kind]
-        self._index_remove(kind, key, current)
-        bucket[key] = new
-        self._index_add(kind, key, new)
+        old_labels = current.metadata.labels
+        new_labels = new.metadata.labels
+        if new_labels is not old_labels and new_labels != old_labels:
+            self._index_remove(kind, key, current)
+            bucket[key] = new
+            self._index_add(kind, key, new)
+        else:
+            bucket[key] = new
         self._emit("Modified", new, old=current)
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> bool:

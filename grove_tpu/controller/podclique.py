@@ -56,6 +56,17 @@ class PodCliqueReconciler:
         #: component on every pod event until the rollout completes
         #: (maintained by _reconcile_status, which computes outdated pods)
         self._rollout_active: set[tuple[str, str]] = set()
+        #: event seqs of this reconciler's own pod CREATES and UNGATES.
+        #: The expectations-store analog (the reference uses
+        #: internal/expect/ to not re-act on its own writes through a
+        #: stale informer): the reconcile that made the write already ran
+        #: the status flow over the result, so the echoed event needs no
+        #: further reconcile. Deletes are deliberately NOT suppressed —
+        #: the delete->recreate chain (failed-pod replacement, rolling
+        #: updates) rides the Deleted event. Consumed on sight;
+        #: single-threaded store, so store.last_seq right after a write IS
+        #: that write's event.
+        self._own_events: set[int] = set()
 
     def record_error(self, request: Request, err: GroveError) -> None:
         """Every kind surfaces its own controller errors
@@ -66,9 +77,26 @@ class PodCliqueReconciler:
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
+            # the clique's own status writes (and metadata-only bumps like
+            # finalizers) feed nothing this reconciler computes — only
+            # spec changes, lifecycle edges and deletion marks do
+            if (
+                event.type == "Modified"
+                and event.old is not None
+                and event.obj.metadata.generation
+                == event.old.metadata.generation
+                and event.obj.metadata.deletion_timestamp
+                == event.old.metadata.deletion_timestamp
+            ):
+                return []
             self._pods_dirty.add((event.namespace, event.name))
             return [Request(event.namespace, event.name)]
         if event.kind == Pod.KIND:
+            if event.seq in self._own_events:
+                # our own write, already rolled up by the reconcile that
+                # made it (expectations analog — see __init__)
+                self._own_events.discard(event.seq)
+                return []
             pclq = event.obj.metadata.labels.get(constants.LABEL_PODCLIQUE)
             if not pclq:
                 return []
@@ -170,6 +198,13 @@ class PodCliqueReconciler:
         )
         return Result()
 
+    def _mark_own(self) -> None:
+        """Record the event seq of a pod write this reconciler just made
+        (see _own_events). Bounded: consumed at the next drain."""
+        self._own_events.add(self.store.last_seq)
+        if len(self._own_events) > 100_000:  # safety: undrained leak
+            self._own_events.clear()
+
     def _owned_pods(self, pclq: PodClique) -> list[Pod]:
         """Read-only scan (live references): callers decide and then act
         through the store API (create/delete/get-then-update) — they never
@@ -243,9 +278,12 @@ class PodCliqueReconciler:
             [
                 (
                     naming.pod_name(pclq.metadata.name, idx),
-                    lambda idx=idx: self.store.create(
-                        self._build_pod(pclq, pcs, idx, sg_num_pods),
-                        owned=True,
+                    lambda idx=idx: (
+                        self.store.create(
+                            self._build_pod(pclq, pcs, idx, sg_num_pods),
+                            owned=True,
+                        ),
+                        self._mark_own(),
                     ),
                 )
                 for idx in free_indices
@@ -458,30 +496,39 @@ class PodCliqueReconciler:
     def _remove_gates(self, pclq: PodClique) -> None:
         """syncflow.go:242-394. Base-gang pods ungate once referenced in
         their PodGang; scaled-gang pods additionally require the base gang
-        to be scheduled."""
+        to be scheduled. Gang lookups/ref sets are computed once per gang,
+        not per pod (a clique's pods share their gang)."""
         ns = pclq.metadata.namespace
+        ref_sets: dict[str, set[str] | None] = {}
+        base_ok: dict[str, bool] = {}
         for pod in self._owned_pods(pclq):
             if not pod.spec.scheduling_gates:
                 continue
             gang_name = pod.metadata.labels.get(constants.LABEL_PODGANG)
             if not gang_name:
                 continue
-            gang = self.store.peek(PodGang.KIND, ns, gang_name)
-            if gang is None:
-                continue
-            refs = {
-                ref.name
-                for group in gang.spec.pod_groups
-                for ref in group.pod_references
-            }
-            if pod.metadata.name not in refs:
-                continue  # not yet referenced -> keep gated (:261)
+            refs = ref_sets.get(gang_name, False)
+            if refs is False:
+                gang = self.store.peek(PodGang.KIND, ns, gang_name)
+                refs = ref_sets[gang_name] = None if gang is None else {
+                    ref.name
+                    for group in gang.spec.pod_groups
+                    for ref in group.pod_references
+                }
+            if refs is None or pod.metadata.name not in refs:
+                continue  # gang absent / not yet referenced (:261)
             base_name = pod.metadata.labels.get(constants.LABEL_BASE_PODGANG)
             if base_name:
-                base = self.store.peek(PodGang.KIND, ns, base_name)
-                if base is None or not _is_scheduled(base):
+                ok = base_ok.get(base_name)
+                if ok is None:
+                    base = self.store.peek(PodGang.KIND, ns, base_name)
+                    ok = base_ok[base_name] = (
+                        base is not None and _is_scheduled(base)
+                    )
+                if not ok:
                     continue  # scaled gang waits for base (:306-345)
-            self.store.ungate_pod(ns, pod.metadata.name)
+            if self.store.ungate_pod(ns, pod.metadata.name):
+                self._mark_own()
 
     # -- status flow (reconcilestatus.go) ----------------------------------
     def _reconcile_status(self, pclq: PodClique) -> None:
