@@ -164,6 +164,7 @@ class SimKubelet:
         ready_at_tick_start = self._ready
         live_nodes = self._nodes
         to_run: list[tuple[str, str]] = []
+        to_start_ready: list[tuple[str, str]] = []
         to_ready: list[tuple[str, str]] = []
         to_lose: list[tuple[str, str]] = []
         if self._nodes_lost:
@@ -199,7 +200,15 @@ class SimKubelet:
             if pod.spec.scheduling_gates:
                 continue
             if pod.status.phase == PodPhase.PENDING:
-                to_run.append(key)
+                # container start and readiness land in ONE tick when the
+                # startup barrier is already open as of tick start (the
+                # common, dependency-free case) — readiness still
+                # propagates at most one dependency hop per tick, which is
+                # the invariant the startup-order suites pin down
+                if self._barrier_open(pod, ready_at_tick_start):
+                    to_start_ready.append(key)
+                else:
+                    to_run.append(key)
             elif pod.status.phase == PodPhase.RUNNING and not pod.status.ready:
                 if self._barrier_open(pod, ready_at_tick_start):
                     to_ready.append(key)
@@ -220,8 +229,18 @@ class SimKubelet:
             status.ready = True
             status.ever_started = True
 
+        def start_ready(status):
+            status.phase = PodPhase.RUNNING
+            status.started_at = now
+            status.ready = True
+            status.ever_started = True
+
         for ns, name in to_run:
             changes += self.store.patch_status(Pod.KIND, ns, name, start)
+        for ns, name in to_start_ready:
+            changes += self.store.patch_status(
+                Pod.KIND, ns, name, start_ready
+            )
         for ns, name in to_ready:
             changes += self.store.patch_status(Pod.KIND, ns, name, ready)
         return changes
