@@ -405,11 +405,15 @@ class ObjectStore:
         defensive copies in list() dominate settle wall-clock, so every
         read-only scan goes through here."""
         out = []
+        # a single-label selector needs no re-check: the chosen index
+        # bucket IS that label's membership (multi-label selectors verify
+        # the labels the bucket doesn't guarantee)
+        recheck = labels if labels and len(labels) > 1 else None
         for obj in self._candidates(kind, labels):
             if namespace is not None and obj.metadata.namespace != namespace:
                 continue
-            if labels is not None and any(
-                obj.metadata.labels.get(k) != v for k, v in labels.items()
+            if recheck is not None and any(
+                obj.metadata.labels.get(k) != v for k, v in recheck.items()
             ):
                 continue
             if predicate is not None and not predicate(obj):

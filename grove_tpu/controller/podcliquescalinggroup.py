@@ -23,6 +23,7 @@ from ..api.types import (
 )
 from ..cluster.store import Event, ObjectStore, clone
 from .common import base_labels, new_meta
+from .podcliqueset import _shallow_spec
 from .errors import GroveError, clear_status_errors, record_status_error
 from .runtime import Request, Result
 
@@ -248,7 +249,9 @@ class PCSGReconciler:
             self.store.create(
                 PodClique(
                     metadata=new_meta(pclq_name, ns, pcsg, labels),
-                    spec=clone(template.spec),
+                    # frozen-template sharing, as in the PCS podclique
+                    # component (see podcliqueset._shallow_spec)
+                    spec=_shallow_spec(template.spec),
                 ),
                 owned=True,
             )

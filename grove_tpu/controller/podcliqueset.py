@@ -571,7 +571,13 @@ class PodCliqueSetReconciler:
             self.store.create(
                 PodClique(
                     metadata=new_meta(fqn, ns, pcs, labels),
-                    spec=_copy_spec(spec),
+                    # share the FROZEN template's substructure (pod_spec
+                    # etc.) across replicas instead of a deep copy per
+                    # clique: the store never mutates in place (MVCC), and
+                    # one shared pod_spec object also means ONE template-
+                    # hash memo entry for the whole PCS instead of one
+                    # sha1 per clique
+                    spec=_shallow_spec(spec),
                 ),
                 owned=True,
             )
@@ -905,3 +911,12 @@ def _translate(
 
 def _copy_spec(spec: PodCliqueSpec) -> PodCliqueSpec:
     return clone(spec)
+
+
+def _shallow_spec(spec: PodCliqueSpec) -> PodCliqueSpec:
+    """Independent PodCliqueSpec shell (scalar fields like replicas may be
+    written by HPA updates via get-clone-update) sharing the frozen
+    template substructure."""
+    from ..cluster.store import _shallow
+
+    return _shallow(spec)

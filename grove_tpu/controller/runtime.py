@@ -190,6 +190,15 @@ class ControllerManager:
         batch, self._queue = self._queue, []
         self._queued -= set(batch)
         by_name = {c.name: c for c in self.controllers}
+        # Run the round grouped by controller REGISTRATION order (stable
+        # within a controller). Controllers register parents before
+        # consumers (PCS -> cliques -> scheduler), so a round's writes
+        # land before the consumer runs — interleaving by event-arrival
+        # order let the scheduler see a 1-gang sliver of a backlog whose
+        # other 999 ungates were still queued behind it (an extra
+        # full-device solve round at stress scale).
+        rank = {c.name: i for i, c in enumerate(self.controllers)}
+        batch.sort(key=lambda cr: rank[cr[0]])
         m = self.metrics
         if m is not None:
             # set unconditionally: an idle round must read 0, not the last
