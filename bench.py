@@ -155,8 +155,12 @@ def main() -> int:
     engine = mk_engine(metrics=registry)
     # Each iteration is one "bind the whole backlog" event.
     placed = 0
+    phase_stats: dict[str, list[float]] = {}
     for _ in range(args.iters):
-        placed = engine.solve(gangs).num_placed
+        res = engine.solve(gangs)
+        placed = res.num_placed
+        for k in ("encode_seconds", "device_seconds", "repair_seconds"):
+            phase_stats.setdefault(k, []).append(res.stats.get(k, 0.0))
 
     bind_h = registry.histogram("grove_solver_backlog_bind_seconds")
     # Throughput (value, vs_baseline) uses the MEDIAN solve wall: through
@@ -219,6 +223,14 @@ def main() -> int:
         "serial_placed_sampled": sres.num_placed,
         "mean_placement_score": round(score, 4),
         "repair_fallbacks": fallbacks,
+        # solve-phase split (p50 across iters): host encode, device
+        # score+commit-scan (incl. D2H of the packed top-k), host exact
+        # repair — where the next optimization lives is visible, not
+        # guessed (VERDICT r3 #2)
+        **{
+            f"p50_{k}": round(sorted(v)[len(v) // 2], 4)
+            for k, v in phase_stats.items()
+        },
         "backend": __import__("jax").default_backend(),
         "engine": "sharded" if args.sharded else "single",
         **({"mesh": dict(mesh.shape)} if args.sharded else {}),

@@ -216,27 +216,36 @@ def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int,
 
 @partial(
     jax.jit,
-    static_argnames=("num_domains", "top_k", "chunk"),
+    static_argnames=("num_domains", "top_k", "chunk", "num_res"),
 )
 def _device_score(
     free,            # f32 [N, R] (unschedulable nodes zeroed)
-    gdom,            # i32 [L+1, N]
-    dom_level,       # i32 [D]
-    anc_ids,         # i32 [D, L+1] ancestor chains (padded with D)
-    total_demand,    # f32 [G, R]
-    u_sig_demand,    # f32 [U, R] UNIQUE signature max-pod demand rows
-    u_sig_mask,      # i32 [U] eligibility-mask row per signature
+    gdom,            # i32 [L+1, N]          (device-resident static)
+    dom_level,       # i32 [D]               (device-resident static)
+    anc_ids,         # i32 [D, L+1] ancestors(device-resident static)
+    gang_pack,       # f32 [G, R+3+S]: total_demand | required_level |
+                     #   preferred_level | valid | sig_idx. ONE fused
+                     #   buffer: each separate H2D transfer pays the dev
+                     #   tunnel's fixed latency, and the unpack slices
+                     #   below are free under XLA fusion.
+    u_pack,          # f32 [U, R+1]: unique signature max-pod demand rows
+                     #   | eligibility-mask row index
     elig_masks,      # f32 [M, N] node-eligibility masks (row 0 = all ones)
-    sig_idx,         # i32 [G, S] gang -> its signature rows (dummy-padded)
-    required_level,  # i32 [G]
-    preferred_level, # i32 [G]
-    valid,           # bool [G]
-    cap_scale,       # f32 [R]
+    cap_scale,       # f32 [R]               (device-resident static)
     *,
     num_domains: int,
     top_k: int,
     chunk: int = 32,
+    num_res: int,
 ):
+    r = num_res
+    total_demand = gang_pack[:, :r]
+    required_level = gang_pack[:, r].astype(jnp.int32)
+    preferred_level = gang_pack[:, r + 1].astype(jnp.int32)
+    valid = gang_pack[:, r + 2] > 0.5
+    sig_idx = gang_pack[:, r + 3:].astype(jnp.int32)        # [G, S]
+    u_sig_demand = u_pack[:, :r]
+    u_sig_mask = u_pack[:, r].astype(jnp.int32)
     m = membership_matrix(gdom, num_domains)
     dom_free = m.T @ free                                   # [D, R]
     # Node-granularity proxy: per signature (= unique max-pod demand ×
@@ -285,6 +294,15 @@ class PlacementEngine:
         #: numbers (backlog bind latency, placements, score distribution)
         self.metrics = metrics
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
+        self._cap_scale = np.maximum(
+            snapshot.capacity.max(axis=0), 1e-9
+        ).astype(np.float32)
+        #: device-resident static topology arrays (gdom, dom_level,
+        #: anc_ids, cap_scale), materialized lazily at the first solve so
+        #: constructing an engine never touches an accelerator. Re-shipping
+        #: them per solve paid 4 extra host->device transfers, each with
+        #: the dev tunnel's fixed latency.
+        self._dev_static = None
 
     def solve(
         self, gangs: list[SolverGang], free: np.ndarray | None = None
@@ -326,18 +344,17 @@ class PlacementEngine:
         dev_free = np.where(
             snapshot.schedulable[:, None], free, 0.0
         ).astype(np.float32)
-        cap_scale = np.maximum(snapshot.capacity.max(axis=0), 1e-9).astype(
-            np.float32
-        )
         result.stats["encode_seconds"] = time.perf_counter() - t0
         t_dev = time.perf_counter()
         top_val, top_dom = self._device_phase(
             dev_free, total_demand, sig, required_level,
-            preferred_level, valid, cap_scale,
+            preferred_level, valid, self._cap_scale,
         )
         result.stats["device_seconds"] = time.perf_counter() - t_dev
 
+        t_rep = time.perf_counter()
         placed_map, fallbacks = self._repair(order, top_val, top_dom, free)
+        result.stats["repair_seconds"] = time.perf_counter() - t_rep
         for gang in order:
             if gang.name in placed_map:
                 result.placed[gang.name] = placed_map[gang.name]
@@ -488,25 +505,59 @@ class PlacementEngine:
                       preferred_level, valid, cap_scale):
         """Single-device scoring; ShardedPlacementEngine overrides this with
         the mesh-SPMD version (grove_tpu/parallel/sharded.py). `sig` is the
-        _gang_signatures tuple."""
+        _gang_signatures tuple.
+
+        Transfer discipline (the dev tunnel charges fixed latency per
+        transfer, and at stress scale the device phase is latency-bound,
+        not FLOP-bound): statics ship once per engine, per-solve inputs
+        ship as THREE fused buffers (free, gang pack, signature pack + the
+        cached all-ones mask row when no pod carries a selector), results
+        return as one packed array."""
         u_sig_demand, u_sig_mask, elig_masks, sig_idx = sig
+        if self._dev_static is None:
+            self._dev_static = (
+                jnp.asarray(self.space.gdom),
+                jnp.asarray(self.space.dom_level),
+                jnp.asarray(self.space.anc_ids),
+                jnp.asarray(cap_scale),
+                # the default eligibility table (row 0 = all nodes): the
+                # common no-selector backlog reuses it device-resident
+                jnp.asarray(np.ones((1, dev_free.shape[0]), np.float32)),
+            )
+        gdom_d, dom_level_d, anc_ids_d, cap_scale_d, ones_mask_d = (
+            self._dev_static
+        )
+        r = total_demand.shape[1]
+        gang_pack = np.concatenate(
+            [
+                total_demand,
+                required_level[:, None].astype(np.float32),
+                preferred_level[:, None].astype(np.float32),
+                valid[:, None].astype(np.float32),
+                sig_idx.astype(np.float32),
+            ],
+            axis=1,
+        )
+        u_pack = np.concatenate(
+            [u_sig_demand, u_sig_mask[:, None].astype(np.float32)], axis=1
+        )
+        masks_d = (
+            ones_mask_d if elig_masks.shape[0] == 1
+            else jnp.asarray(elig_masks)
+        )
         packed = _device_score(
             jnp.asarray(dev_free),
-            jnp.asarray(self.space.gdom),
-            jnp.asarray(self.space.dom_level),
-            jnp.asarray(self.space.anc_ids),
-            jnp.asarray(total_demand),
-            jnp.asarray(u_sig_demand),
-            jnp.asarray(u_sig_mask),
-            jnp.asarray(elig_masks),
-            jnp.asarray(sig_idx),
-            jnp.asarray(required_level),
-            jnp.asarray(preferred_level),
-            jnp.asarray(valid),
-            jnp.asarray(cap_scale),
+            gdom_d,
+            dom_level_d,
+            anc_ids_d,
+            jnp.asarray(gang_pack),
+            jnp.asarray(u_pack),
+            masks_d,
+            cap_scale_d,
             num_domains=self.space.num_domains,
             top_k=min(self.top_k, self.space.num_domains),
             chunk=self.commit_chunk,
+            num_res=r,
         )
         packed = np.asarray(packed)  # single D2H transfer
         k = packed.shape[1] // 2

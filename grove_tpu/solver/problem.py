@@ -60,7 +60,14 @@ class SolverGang:
         return int(self.demand.shape[0])
 
     def total_demand(self) -> np.ndarray:
-        return self.demand.sum(axis=0)
+        # cached: demand is frozen after construction, and the encode
+        # phase sums it once per gang per solve (measurable at 10^3-gang
+        # backlogs resolved repeatedly)
+        td = getattr(self, "_total_demand", None)
+        if td is None:
+            td = self.demand.sum(axis=0)
+            object.__setattr__(self, "_total_demand", td)
+        return td
 
     def max_pod_demand(self) -> np.ndarray:
         return self.demand.max(axis=0) if self.num_pods else self.demand.sum(axis=0)
