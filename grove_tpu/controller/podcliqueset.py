@@ -129,11 +129,13 @@ class PodCliqueSetReconciler:
             if event.kind == Pod.KIND:
                 # the podgang component consumes the pod INVENTORY: pods
                 # appearing/leaving or flipping active-ness (Failed /
-                # Succeeded / marked deleting). Phase and readiness churn
-                # rolls up through the owning PodClique's status instead.
-                if not spec_relevant and is_pod_active(
-                    event.obj
-                ) == is_pod_active(event.old):
+                # Succeeded / marked deleting). Phase/readiness churn rolls
+                # up through the owning PodClique's status, and pod SPEC
+                # changes (= gate removal, the only pod generation bump)
+                # feed nothing at the PCS level either — no reconcile.
+                if event.type == "Modified" and event.old is not None and (
+                    is_pod_active(event.obj) == is_pod_active(event.old)
+                ):
                     return []
                 self._spec_dirty.add((event.namespace, owner))
             elif event.kind == PodGang.KIND:
