@@ -271,6 +271,7 @@ class PodCliqueReconciler:
                         if i not in used][:count]
         pcs = self._owner_pcs(pclq)
         sg_num_pods = self._pcsg_template_num_pods(pclq, pcs)
+        ctx = self._pod_template_ctx(pclq, pcs, sg_num_pods)
         # slow-start pacing (utils/concurrent.go:72-105): a failing
         # admission/authz hook sees one probe create, not the whole diff;
         # the skipped remainder is recomputed idempotently on retry
@@ -280,7 +281,7 @@ class PodCliqueReconciler:
                     naming.pod_name(pclq.metadata.name, idx),
                     lambda idx=idx: (
                         self.store.create(
-                            self._build_pod(pclq, pcs, idx, sg_num_pods),
+                            self._build_pod(pclq, idx, ctx),
                             owned=True,
                         ),
                         self._mark_own(),
@@ -317,20 +318,22 @@ class PodCliqueReconciler:
                 )
         return None
 
-    def _build_pod(self, pclq: PodClique, pcs: PodCliqueSet | None, idx: int,
-                   sg_num_pods: int | None = None) -> Pod:
+    def _pod_template_ctx(
+        self, pclq: PodClique, pcs: PodCliqueSet | None,
+        sg_num_pods: int | None
+    ) -> dict:
+        """Everything about a pod build that is CONSTANT across one create
+        batch (labels base, annotations, env base, DNS identity) — computed
+        once per batch, not once per pod (pod.go:227-264 equivalents)."""
         ns = pclq.metadata.namespace
-        pod_name = naming.pod_name(pclq.metadata.name, idx)
         pcs_name = pclq.metadata.labels.get(constants.LABEL_PART_OF, "")
         replica = pclq.metadata.labels.get(constants.LABEL_PCS_REPLICA_INDEX, "0")
-        gang = pclq.metadata.labels.get(constants.LABEL_PODGANG, "")
         labels = {
             k: v
             for k, v in pclq.metadata.labels.items()
             if k.startswith("grove.io/") or k.startswith("app.kubernetes.io/")
         }
         labels[constants.LABEL_PODCLIQUE] = pclq.metadata.name
-        labels[constants.LABEL_POD_INDEX] = str(idx)
         labels[constants.LABEL_POD_TEMPLATE_HASH] = stable_hash(pclq.spec.pod_spec)
         annotations = {}
         deps = self._startup_deps(pclq, pcs)
@@ -338,25 +341,10 @@ class PodCliqueReconciler:
             annotations[constants.ANNOTATION_WAIT_FOR] = ",".join(
                 f"{fqn}:{minav}" for fqn, minav in deps
             )
-        # Structural sharing instead of a deep template clone: the stored
-        # clique's pod_spec is FROZEN (every store write replaces, never
-        # mutates — MVCC), so the pod spec shares its substructure and only
-        # replaces what differs per pod: gates, identity fields, and each
-        # container (shallow) with its merged env dict. At 10^4-pod settle
-        # scale the per-pod deep clone here was a top host cost.
-        spec = _shallow(pclq.spec.pod_spec)
-        spec.scheduling_gates = [constants.PODGANG_PENDING_CREATION_GATE]
-        spec.hostname = pod_name
-        spec.subdomain = naming.headless_service_name(pcs_name, int(replica))
-        if pcs_name and not spec.service_account_name:
-            # the per-PCS identity whose Role grants the startup-barrier
-            # watcher its pod list/watch (components/satokensecret/)
-            spec.service_account_name = f"{pcs_name}-sa"
         env = {
             constants.ENV_PCS_NAME: pcs_name,
             constants.ENV_PCS_INDEX: replica,
             constants.ENV_PCLQ_NAME: pclq.metadata.name,
-            constants.ENV_PCLQ_POD_INDEX: str(idx),
             constants.ENV_HEADLESS_SERVICE: naming.headless_service_address(
                 pcs_name, int(replica), ns
             ),
@@ -371,6 +359,39 @@ class PodCliqueReconciler:
             # workload size its world from env alone
             if sg_num_pods is not None:
                 env[constants.ENV_PCSG_TEMPLATE_NUM_PODS] = str(sg_num_pods)
+        sa = ""
+        if pcs_name and not pclq.spec.pod_spec.service_account_name:
+            # the per-PCS identity whose Role grants the startup-barrier
+            # watcher its pod list/watch (components/satokensecret/)
+            sa = f"{pcs_name}-sa"
+        return {
+            "ns": ns,
+            "labels": labels,
+            "annotations": annotations,
+            "env": env,
+            "subdomain": naming.headless_service_name(pcs_name, int(replica)),
+            "service_account": sa,
+        }
+
+    def _build_pod(self, pclq: PodClique, idx: int, ctx: dict) -> Pod:
+        ns = ctx["ns"]
+        pod_name = naming.pod_name(pclq.metadata.name, idx)
+        labels = dict(ctx["labels"])
+        labels[constants.LABEL_POD_INDEX] = str(idx)
+        # Structural sharing instead of a deep template clone: the stored
+        # clique's pod_spec is FROZEN (every store write replaces, never
+        # mutates — MVCC), so the pod spec shares its substructure and only
+        # replaces what differs per pod: gates, identity fields, and each
+        # container (shallow) with its merged env dict. At 10^4-pod settle
+        # scale the per-pod deep clone here was a top host cost.
+        spec = _shallow(pclq.spec.pod_spec)
+        spec.scheduling_gates = [constants.PODGANG_PENDING_CREATION_GATE]
+        spec.hostname = pod_name
+        spec.subdomain = ctx["subdomain"]
+        if ctx["service_account"]:
+            spec.service_account_name = ctx["service_account"]
+        env = dict(ctx["env"])
+        env[constants.ENV_PCLQ_POD_INDEX] = str(idx)
         containers = []
         for container in spec.containers:
             c = _shallow(container)
@@ -378,7 +399,7 @@ class PodCliqueReconciler:
             containers.append(c)
         spec.containers = containers
         return Pod(
-            metadata=new_meta(pod_name, ns, pclq, labels, annotations),
+            metadata=new_meta(pod_name, ns, pclq, labels, ctx["annotations"]),
             spec=spec,
         )
 
@@ -472,10 +493,13 @@ class PodCliqueReconciler:
         return name.rsplit("-", 1)[0]
 
     def _owner_pcs(self, pclq: PodClique) -> PodCliqueSet | None:
+        """Read-only peek: callers only read the template (startup deps,
+        PCSG sizing) — the per-create-batch full PCS clone was measurable
+        at 10^3-clique scale."""
         pcs_name = pclq.metadata.labels.get(constants.LABEL_PART_OF)
         if not pcs_name:
             return None
-        return self.store.get(
+        return self.store.peek(
             PodCliqueSet.KIND, pclq.metadata.namespace, pcs_name
         )
 

@@ -93,6 +93,13 @@ class PodCliqueSetReconciler:
         self.store = store
         self.config = config or OperatorConfig()
         self.recorder = EventRecorder(store, controller=self.name)
+        #: event seqs of this reconciler's own child CREATES/spec
+        #: updates (cliques, PCSGs, gangs). Expectations analog, same
+        #: rationale as PodCliqueReconciler._own_events: the spec flow
+        #: that made the write is already consistent with it, so the echo
+        #: must not re-dirty the spec flow. Deletes stay live (the
+        #: delete->recreate chain of gang termination rides them).
+        self._own_events: set[int] = set()
         #: PCS keys whose next reconcile must run the FULL spec flow
         #: (component syncs). The generation-change predicate analog
         #: (register.go predicates): pure status writes on owned objects
@@ -105,6 +112,14 @@ class PodCliqueSetReconciler:
         """Manager error hook: surface to status.last_errors/last_operation
         (reconcile_error_recorder.go analog)."""
         record_pcs_error(self.store, request.namespace, request.name, err)
+
+    def _mark_own(self) -> None:
+        """Record the event seq of a child write this reconciler just
+        made (see _own_events). Single-threaded store: store.last_seq
+        right after a write IS that write's event."""
+        self._own_events.add(self.store.last_seq)
+        if len(self._own_events) > 100_000:  # safety: undrained leak
+            self._own_events.clear()
 
     # -- watches (register.go:53-121; the generation-change predicates the
     # reference attaches to its watches are what keeps pod status churn
@@ -119,6 +134,9 @@ class PodCliqueSetReconciler:
                 self._spec_dirty.add((req.namespace, req.name))
             return [req]
         if event.kind in ("PodClique", "PodCliqueScalingGroup", "Pod", "PodGang"):
+            if event.seq in self._own_events:
+                self._own_events.discard(event.seq)
+                return []
             owner = event.obj.metadata.labels.get(constants.LABEL_PART_OF)
             if not owner:
                 return []
@@ -561,6 +579,7 @@ class PodCliqueSetReconciler:
                         fresh = self.store.get(PodClique.KIND, ns, fqn)
                         fresh.spec = new_spec
                         self.store.update(fresh)
+                        self._mark_own()
                 continue
             labels = dict(
                 comp_labels,
@@ -583,6 +602,7 @@ class PodCliqueSetReconciler:
                 ),
                 owned=True,
             )
+            self._mark_own()
         for pclq in self.store.scan(PodClique.KIND, namespace=ns, labels=comp_labels):
             if pclq.metadata.name not in expected:
                 self.store.delete(PodClique.KIND, ns, pclq.metadata.name)
@@ -618,6 +638,7 @@ class PodCliqueSetReconciler:
                     ),
                     owned=True,
                 )
+                self._mark_own()
         for pcsg in self.store.scan(
             PodCliqueScalingGroup.KIND, namespace=ns, labels=comp_labels
         ):
@@ -679,10 +700,12 @@ class PodCliqueSetReconciler:
                     ),
                     owned=True,
                 )
+                self._mark_own()
             elif existing.spec != spec:
                 fresh = self.store.get(PodGang.KIND, ns, gang_name)
                 fresh.spec = spec
                 self.store.update(fresh)
+                self._mark_own()
         for gang in self.store.scan(PodGang.KIND, namespace=ns, labels=comp_labels):
             if gang.metadata.name not in expected:
                 self.store.delete(PodGang.KIND, ns, gang.metadata.name)
