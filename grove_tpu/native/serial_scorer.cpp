@@ -145,75 +145,193 @@ std::vector<std::vector<int32_t>> subdomains_tightest(
 struct Gang {
   int32_t pod_begin, pod_end;  // into demand matrix
   int32_t required_level;
+  int32_t preferred_level;
   const int32_t* group_ids;       // per pod (relative)
   const int32_t* group_levels;    // per group: required level or -1
+  const int32_t* group_prefs;     // per group: preferred level or -1
   int32_t num_groups;
+  // constraint groups (PCSG co-location inside a base gang,
+  // podgang.go:121-132): each spans a subset of pod groups
+  int32_t num_cgroups;
+  const int32_t* cg_req;           // [num_cgroups]
+  const int32_t* cg_pref;          // [num_cgroups]
+  const int32_t* cg_member_begin;  // [num_cgroups+1] into cg_members
+  const int32_t* cg_members;       // group indices
 };
 
-// Place one gang inside `dom` (already a single domain at `dom_level`).
-// Group constraints narrower than dom_level place each group in one
-// subdomain at the group's level.
-bool place_in_domain(const Ctx& ctx, const Gang& g, const float* demand,
-                     const std::vector<int32_t>& dom, int dom_level,
-                     std::vector<float>& free, int32_t* assign) {
-  // Mirrors fit.py's unit tree exactly: EVERY group with a required level
-  // is its own placement unit (even when the enclosing domain already
-  // satisfies it — it still BFDs as a unit, which changes pod ordering
-  // and therefore node choices); only level-free groups' pods are loose.
+// Co-location unit — the C++ mirror of fit.py's _Unit tree: gang root ->
+// constraint groups -> pod groups, each placed inside ONE domain at its
+// required level, with soft preferred levels tried first. Semantics and
+// ordering (tightest-first candidates, stable largest-first children,
+// BFD) match fit.py line for line so native and Python repair produce
+// identical placements.
+struct Unit {
+  int32_t req = -1, pref = -1;
+  std::vector<int32_t> pods;   // direct pods (absolute demand rows)
+  std::vector<int32_t> children;  // indices into the arena
+};
+
+void collect_pods(const std::vector<Unit>& arena, const Unit& u,
+                  std::vector<int32_t>* out) {
+  out->insert(out->end(), u.pods.begin(), u.pods.end());
+  for (int32_t c : u.children) collect_pods(arena, arena[c], out);
+}
+
+// Build the unit arena for one gang; returns the root's index.
+int32_t build_unit_tree(const Gang& g, std::vector<Unit>* arena) {
+  arena->clear();
+  arena->push_back(Unit{});  // root
+  // per-group pod lists (ascending pod index, matching np.flatnonzero)
   std::vector<std::vector<int32_t>> group_pods(g.num_groups);
-  std::vector<int32_t> loose;
   for (int32_t p = g.pod_begin; p < g.pod_end; ++p) {
     int32_t gi = g.group_ids[p - g.pod_begin];
-    if (gi >= 0 && gi < g.num_groups && g.group_levels[gi] >= 0)
-      group_pods[gi].push_back(p);
-    else
-      loose.push_back(p);
+    if (gi >= 0 && gi < g.num_groups) group_pods[gi].push_back(p);
   }
-  // constrained groups first, larger total demand first
-  std::vector<int32_t> gorder;
-  for (int32_t gi = 0; gi < g.num_groups; ++gi)
-    if (!group_pods[gi].empty()) gorder.push_back(gi);
-  auto total_of = [&](const std::vector<int32_t>& pods) {
-    std::vector<float> t(ctx.num_res, 0.0f);
-    for (int32_t p : pods)
-      for (int r = 0; r < ctx.num_res; ++r) t[r] += demand[p * ctx.num_res + r];
-    return t;
-  };
-  std::stable_sort(gorder.begin(), gorder.end(), [&](int32_t a, int32_t b) {
+  std::vector<char> in_cg(g.num_groups, 0);
+  for (int32_t c = 0; c < g.num_cgroups; ++c) {
+    Unit cg;
+    cg.req = g.cg_req[c];
+    cg.pref = g.cg_pref[c];
+    for (int32_t m = g.cg_member_begin[c]; m < g.cg_member_begin[c + 1]; ++m) {
+      int32_t gi = g.cg_members[m];
+      in_cg[gi] = 1;
+      Unit gu;
+      gu.req = g.group_levels[gi];
+      gu.pref = g.group_prefs[gi];
+      gu.pods = group_pods[gi];
+      arena->push_back(std::move(gu));
+      cg.children.push_back((int32_t)arena->size() - 1);
+    }
+    arena->push_back(std::move(cg));
+    (*arena)[0].children.push_back((int32_t)arena->size() - 1);
+  }
+  for (int32_t gi = 0; gi < g.num_groups; ++gi) {
+    if (in_cg[gi]) continue;
+    if (g.group_levels[gi] >= 0 || g.group_prefs[gi] >= 0) {
+      Unit gu;
+      gu.req = g.group_levels[gi];
+      gu.pref = g.group_prefs[gi];
+      gu.pods = group_pods[gi];
+      arena->push_back(std::move(gu));
+      (*arena)[0].children.push_back((int32_t)arena->size() - 1);
+    } else {
+      // level-free groups' pods are loose on the root, in group order
+      (*arena)[0].pods.insert((*arena)[0].pods.end(),
+                              group_pods[gi].begin(), group_pods[gi].end());
+    }
+  }
+  (*arena)[0].req = -1;  // enclosing domain chosen by the caller
+  (*arena)[0].pref = g.preferred_level;
+  return 0;
+}
+
+bool place_unit(const Ctx& ctx, const std::vector<Unit>& arena,
+                const Unit& u, const float* demand,
+                const std::vector<int32_t>& dom, int domain_level,
+                std::vector<float>& free, int32_t* assign);
+
+// fit.py _place_child: a constrained child goes inside exactly ONE
+// subdomain at its required level (tightest-first, backtracking).
+bool place_child(const Ctx& ctx, const std::vector<Unit>& arena,
+                 const Unit& c, const float* demand,
+                 const std::vector<int32_t>& dom, int domain_level,
+                 std::vector<float>& free, int32_t* assign) {
+  if (c.req <= domain_level) {
+    return place_unit(ctx, arena, c, demand, dom, domain_level, free, assign);
+  }
+  std::vector<int32_t> pods_all;
+  collect_pods(arena, c, &pods_all);
+  std::vector<float> total(ctx.num_res, 0.0f);
+  for (int32_t p : pods_all)
+    for (int r = 0; r < ctx.num_res; ++r) total[r] += demand[p * ctx.num_res + r];
+  auto subs = subdomains_tightest(ctx, dom, c.req, total.data(), free);
+  for (auto& sub : subs) {
+    std::vector<float> save_free;
+    save_free.reserve(sub.size() * ctx.num_res);
+    for (int32_t n : sub)
+      for (int r = 0; r < ctx.num_res; ++r)
+        save_free.push_back(free[n * ctx.num_res + r]);
+    std::vector<int32_t> save_assign;
+    save_assign.reserve(pods_all.size());
+    for (int32_t p : pods_all) save_assign.push_back(assign[p]);
+    if (place_unit(ctx, arena, c, demand, sub, c.req, free, assign))
+      return true;
+    size_t k = 0;
+    for (int32_t n : sub)
+      for (int r = 0; r < ctx.num_res; ++r)
+        free[n * ctx.num_res + r] = save_free[k++];
+    for (size_t i = 0; i < pods_all.size(); ++i)
+      assign[pods_all[i]] = save_assign[i];
+  }
+  return false;
+}
+
+// fit.py _place_unit: soft preference first (whole unit inside one
+// preferred-level subdomain, stripped recursion), then children largest
+// demand first, then the unit's loose pods BFD.
+bool place_unit(const Ctx& ctx, const std::vector<Unit>& arena,
+                const Unit& u, const float* demand,
+                const std::vector<int32_t>& dom, int domain_level,
+                std::vector<float>& free, int32_t* assign) {
+  if (u.pref > domain_level) {
+    std::vector<int32_t> pods_all;
+    collect_pods(arena, u, &pods_all);
+    std::vector<float> total(ctx.num_res, 0.0f);
+    for (int32_t p : pods_all)
+      for (int r = 0; r < ctx.num_res; ++r)
+        total[r] += demand[p * ctx.num_res + r];
+    auto subs = subdomains_tightest(ctx, dom, u.pref, total.data(), free);
+    Unit stripped = u;
+    stripped.pref = -1;
+    for (auto& sub : subs) {
+      std::vector<float> save_free;
+      save_free.reserve(sub.size() * ctx.num_res);
+      for (int32_t n : sub)
+        for (int r = 0; r < ctx.num_res; ++r)
+          save_free.push_back(free[n * ctx.num_res + r]);
+      std::vector<int32_t> save_assign;
+      save_assign.reserve(pods_all.size());
+      for (int32_t p : pods_all) save_assign.push_back(assign[p]);
+      if (place_unit(ctx, arena, stripped, demand, sub, u.pref, free, assign))
+        return true;
+      size_t k = 0;
+      for (int32_t n : sub)
+        for (int r = 0; r < ctx.num_res; ++r)
+          free[n * ctx.num_res + r] = save_free[k++];
+      for (size_t i = 0; i < pods_all.size(); ++i)
+        assign[pods_all[i]] = save_assign[i];
+    }
+    // fall through: preference unsatisfiable, place unrestricted
+  }
+  // children first, larger total demand first (stable, like sorted())
+  std::vector<int32_t> corder(u.children);
+  std::stable_sort(corder.begin(), corder.end(), [&](int32_t a, int32_t b) {
     float sa = 0, sb = 0;
-    for (int32_t p : group_pods[a])
+    std::vector<int32_t> pa, pb;
+    collect_pods(arena, arena[a], &pa);
+    collect_pods(arena, arena[b], &pb);
+    for (int32_t p : pa)
       for (int r = 0; r < ctx.num_res; ++r) sa += demand[p * ctx.num_res + r];
-    for (int32_t p : group_pods[b])
+    for (int32_t p : pb)
       for (int r = 0; r < ctx.num_res; ++r) sb += demand[p * ctx.num_res + r];
     return sa > sb;
   });
-  for (int32_t gi : gorder) {
-    if (g.group_levels[gi] <= dom_level) {
-      // constraint already satisfied by the enclosing domain: place the
-      // group as a unit within it (fit.py _place_child: req <= domain)
-      if (!bfd(ctx, group_pods[gi], demand, dom, free, assign)) return false;
-      continue;
-    }
-    std::vector<float> total = total_of(group_pods[gi]);
-    auto subs = subdomains_tightest(ctx, dom, g.group_levels[gi], total.data(), free);
-    bool placed = false;
-    for (auto& sub : subs) {
-      // row-scoped save/restore over the subdomain
-      std::vector<float> save;
-      save.reserve(sub.size() * ctx.num_res);
-      for (int32_t n : sub)
-        for (int r = 0; r < ctx.num_res; ++r) save.push_back(free[n * ctx.num_res + r]);
-      if (bfd(ctx, group_pods[gi], demand, sub, free, assign)) {
-        placed = true;
-        break;
-      }
-      size_t k = 0;
-      for (int32_t n : sub)
-        for (int r = 0; r < ctx.num_res; ++r) free[n * ctx.num_res + r] = save[k++];
-    }
-    if (!placed) return false;
+  for (int32_t c : corder) {
+    if (!place_child(ctx, arena, arena[c], demand, dom, domain_level, free,
+                     assign))
+      return false;
   }
-  return bfd(ctx, loose, demand, dom, free, assign);
+  return bfd(ctx, u.pods, demand, dom, free, assign);
+}
+
+// Place one gang inside `dom` (already a single domain at `dom_level`).
+bool place_in_domain(const Ctx& ctx, const Gang& g, const float* demand,
+                     const std::vector<int32_t>& dom, int dom_level,
+                     std::vector<float>& free, int32_t* assign) {
+  std::vector<Unit> arena;
+  build_unit_tree(g, &arena);
+  return place_unit(ctx, arena, arena[0], demand, dom, dom_level, free,
+                    assign);
 }
 
 }  // namespace
@@ -233,9 +351,17 @@ int32_t solve_serial(
     const int32_t* pod_offsets,   // [G+1] into demand rows
     const float* demand,          // [P_total * R]
     const int32_t* required_level,  // [G]
+    const int32_t* preferred_level, // [G] soft gang pack level or -1
     const int32_t* group_ids,       // [P_total] per-pod group (relative)
-    const int32_t* group_offsets,   // [G+1] into group_levels
+    const int32_t* group_offsets,   // [G+1] into group_levels/group_prefs
     const int32_t* group_levels,    // per gang's groups: level or -1
+    const int32_t* group_prefs,     // per gang's groups: pref level or -1
+    // constraint groups (flattened per gang; all null/empty when absent)
+    const int32_t* cg_offsets,      // [G+1] into cg_req/cg_pref
+    const int32_t* cg_req,          // [C_total]
+    const int32_t* cg_pref,         // [C_total]
+    const int32_t* cg_member_offsets,  // [C_total+1] into cg_members
+    const int32_t* cg_members,      // member group indices (relative)
     const uint8_t* elig_masks,      // [M*N] or null
     const int32_t* pod_mask_idx,    // [P_total] or null
     int32_t* assign                 // out [P_total]
@@ -268,9 +394,17 @@ int32_t solve_serial(
     g.pod_begin = pod_offsets[gidx];
     g.pod_end = pod_offsets[gidx + 1];
     g.required_level = required_level[gidx];
+    g.preferred_level = preferred_level ? preferred_level[gidx] : -1;
     g.group_ids = group_ids + g.pod_begin;
     g.group_levels = group_levels + group_offsets[gidx];
+    g.group_prefs = group_prefs + group_offsets[gidx];
     g.num_groups = group_offsets[gidx + 1] - group_offsets[gidx];
+    int32_t cg0 = cg_offsets ? cg_offsets[gidx] : 0;
+    g.num_cgroups = cg_offsets ? cg_offsets[gidx + 1] - cg0 : 0;
+    g.cg_req = cg_req ? cg_req + cg0 : nullptr;
+    g.cg_pref = cg_pref ? cg_pref + cg0 : nullptr;
+    g.cg_member_begin = cg_member_offsets ? cg_member_offsets + cg0 : nullptr;
+    g.cg_members = cg_members;
     std::vector<float> total(num_res, 0.0f);
     for (int32_t p = g.pod_begin; p < g.pod_end; ++p)
       for (int r = 0; r < num_res; ++r) total[r] += demand[p * num_res + r];
@@ -336,8 +470,12 @@ int32_t repair_gangs(
     const float* capacity, const float* free_in, const uint8_t* schedulable,
     const int32_t* domain_ids,
     int32_t num_gangs, const int32_t* pod_offsets, const float* demand,
-    const int32_t* required_level, const int32_t* group_ids,
+    const int32_t* required_level, const int32_t* preferred_level,
+    const int32_t* group_ids,
     const int32_t* group_offsets, const int32_t* group_levels,
+    const int32_t* group_prefs,
+    const int32_t* cg_offsets, const int32_t* cg_req, const int32_t* cg_pref,
+    const int32_t* cg_member_offsets, const int32_t* cg_members,
     const int32_t* top_dom, const float* top_val, int32_t top_k,
     const int32_t* dom_level, const int32_t* dom_offsets,
     const uint8_t* elig_masks, const int32_t* pod_mask_idx,
@@ -371,9 +509,17 @@ int32_t repair_gangs(
     g.pod_begin = pod_offsets[gidx];
     g.pod_end = pod_offsets[gidx + 1];
     g.required_level = required_level[gidx];
+    g.preferred_level = preferred_level ? preferred_level[gidx] : -1;
     g.group_ids = group_ids + g.pod_begin;
     g.group_levels = group_levels + group_offsets[gidx];
+    g.group_prefs = group_prefs + group_offsets[gidx];
     g.num_groups = group_offsets[gidx + 1] - group_offsets[gidx];
+    int32_t cg0 = cg_offsets ? cg_offsets[gidx] : 0;
+    g.num_cgroups = cg_offsets ? cg_offsets[gidx + 1] - cg0 : 0;
+    g.cg_req = cg_req ? cg_req + cg0 : nullptr;
+    g.cg_pref = cg_pref ? cg_pref + cg0 : nullptr;
+    g.cg_member_begin = cg_member_offsets ? cg_member_offsets + cg0 : nullptr;
+    g.cg_members = cg_members;
 
     bool placed = false;
     for (int32_t k = 0; k < top_k && !placed; ++k) {

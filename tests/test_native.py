@@ -109,3 +109,149 @@ def test_native_holds_predeclared_unschedulable_gangs():
         "held": "required topology level(s) unavailable: zone"
     }
     assert set(res.placed) == {"ok"}
+
+
+def grouped_gang(name, group_sizes, cg=None, cpu=2.0, required=-1,
+                 preferred=-1, group_req=None, group_pref=None, priority=0.0):
+    """Gang with explicit per-group sizes, optional constraint groups
+    (cg: list of (member group indices, req, pref)) and per-group
+    required/preferred levels."""
+    from grove_tpu.solver import SolverGang
+
+    n_groups = len(group_sizes)
+    group_req = group_req or [-1] * n_groups
+    group_pref = group_pref or [-1] * n_groups
+    demand, gids = [], []
+    for gi, cnt in enumerate(group_sizes):
+        for _ in range(cnt):
+            demand.append([cpu, 1.0, 0.0])
+            gids.append(gi)
+    return SolverGang(
+        name=name,
+        namespace="default",
+        demand=np.asarray(demand, dtype=np.float32),
+        pod_names=[f"{name}-p{i}" for i in range(len(demand))],
+        group_ids=np.asarray(gids, dtype=np.int32),
+        group_names=[f"g{i}" for i in range(n_groups)],
+        group_required_level=np.asarray(group_req, dtype=np.int32),
+        group_preferred_level=np.asarray(group_pref, dtype=np.int32),
+        required_level=required,
+        preferred_level=preferred,
+        priority=priority,
+        constraint_groups=list(cg or []),
+    )
+
+
+def _assert_identical(cc, py):
+    assert cc is not None
+    assert set(cc.placed) == set(py.placed)
+    assert set(cc.unplaced) == set(py.unplaced)
+    for name in py.placed:
+        np.testing.assert_array_equal(
+            cc.placed[name].node_indices, py.placed[name].node_indices
+        )
+        assert cc.placed[name].placement_score == pytest.approx(
+            py.placed[name].placement_score
+        )
+
+
+class TestNativeGroupedParity:
+    """Round-4 coverage (VERDICT r3 #3): constraint groups and PREFERRED
+    levels — the leader/worker PCSG shape (reference README.md:38-44) —
+    must take the native path with placements identical to fit.py."""
+
+    def test_constraint_group_parity(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            # prefill/decode pair: each group rack-packed, the PAIR
+            # block-packed via a constraint group
+            grouped_gang("lw0", [3, 3], cg=[([0, 1], 0, -1)],
+                         group_req=[1, 1]),
+            grouped_gang("lw1", [2, 2], cg=[([0, 1], 0, 1)],
+                         group_req=[1, 1]),
+            grouped_gang("plain", [4]),
+        ]
+        _assert_identical(
+            solve_serial_native(snap, gangs), solve_serial(snap, gangs)
+        )
+
+    def test_group_preferred_parity(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            grouped_gang("p0", [4, 2], group_pref=[1, -1]),
+            grouped_gang("p1", [2, 2], group_req=[0, -1], group_pref=[1, 1]),
+        ]
+        _assert_identical(
+            solve_serial_native(snap, gangs), solve_serial(snap, gangs)
+        )
+
+    def test_gang_preferred_parity(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            grouped_gang("gp0", [6], required=0, preferred=1),
+            grouped_gang("gp1", [4], preferred=0),
+        ]
+        _assert_identical(
+            solve_serial_native(snap, gangs), solve_serial(snap, gangs)
+        )
+
+    def test_engine_repair_grouped_no_fallback_divergence(self):
+        """The engine's native repair must accept grouped gangs (no
+        Python-path fallback) and match the Python repair placements."""
+        from grove_tpu.solver import PlacementEngine
+        from grove_tpu.native.serial_native import gang_native_compatible
+
+        snap = cluster(blocks=2, racks=4, hosts=4, cpu=8.0)
+        gangs = [
+            grouped_gang(f"lw{i}", [2, 2], cg=[([0, 1], 0, -1)],
+                         group_req=[1, 1], cpu=3.0)
+            for i in range(6)
+        ] + [
+            grouped_gang(f"pref{i}", [4], required=0, preferred=1, cpu=2.0)
+            for i in range(4)
+        ]
+        assert all(gang_native_compatible(g) for g in gangs)
+        nat = PlacementEngine(snap, native_repair=True).solve(gangs)
+        py = PlacementEngine(snap, native_repair=False).solve(gangs)
+        assert set(nat.placed) == set(py.placed) == {g.name for g in gangs}
+        for name in py.placed:
+            np.testing.assert_array_equal(
+                nat.placed[name].node_indices, py.placed[name].node_indices
+            )
+        assert nat.stats["fallbacks"] == py.stats["fallbacks"]
+
+    def test_fuzz_grouped_parity(self):
+        """Randomized grouped backlogs: native serial == Python serial,
+        placement for placement."""
+        rng = np.random.default_rng(42)
+        for trial in range(25):
+            snap = cluster(
+                blocks=int(rng.integers(1, 3)),
+                racks=int(rng.integers(1, 4)),
+                hosts=int(rng.integers(2, 5)),
+                cpu=float(rng.integers(4, 10)),
+            )
+            gangs = []
+            for i in range(int(rng.integers(2, 7))):
+                n_groups = int(rng.integers(1, 4))
+                sizes = [int(rng.integers(1, 4)) for _ in range(n_groups)]
+                group_req = [int(rng.integers(-1, 3)) for _ in range(n_groups)]
+                group_pref = [int(rng.integers(-1, 3)) for _ in range(n_groups)]
+                cg = []
+                if n_groups >= 2 and rng.random() < 0.5:
+                    members = list(range(int(rng.integers(2, n_groups + 1))))
+                    cg = [(members, int(rng.integers(-1, 2)),
+                           int(rng.integers(-1, 3)))]
+                gangs.append(
+                    grouped_gang(
+                        f"t{trial}g{i}", sizes, cg=cg,
+                        cpu=float(rng.integers(1, 5)),
+                        required=int(rng.integers(-1, 2)),
+                        preferred=int(rng.integers(-1, 3)),
+                        group_req=group_req, group_pref=group_pref,
+                        priority=float(rng.integers(0, 3)),
+                    )
+                )
+            _assert_identical(
+                solve_serial_native(snap, gangs), solve_serial(snap, gangs)
+            )
