@@ -50,6 +50,10 @@ _SINGLETON_REQ = Request("", "schedule")
 
 class GangScheduler:
     name = "scheduler"
+    #: LRU bounds for reservation memory (class attrs so tests can shrink
+    #: them; eviction drops the OLDEST entry, never the whole map)
+    VACATED_LRU_MAX = 100_000
+    RESERVATIONS_LRU_MAX = 100_000
     watch_kinds = frozenset(
         (PodGang.KIND, Pod.KIND, Node.KIND, ClusterTopology.KIND)
     )
@@ -113,11 +117,15 @@ class GangScheduler:
             if gang:
                 self._dirty.add((event.namespace, gang))
             if event.type == "Deleted" and event.obj.node_name:
-                if len(self._vacated) > 100_000:
-                    self._vacated.clear()
-                self._vacated[(event.namespace, event.name)] = (
-                    event.obj.node_name
-                )
+                # bounded LRU (advisor r3): evict the OLDEST entry instead
+                # of dropping all pod-level reservation memory mid-churn;
+                # dict insertion order is the recency order (re-inserts
+                # refresh it)
+                key = (event.namespace, event.name)
+                self._vacated.pop(key, None)
+                if len(self._vacated) >= self.VACATED_LRU_MAX:
+                    self._vacated.pop(next(iter(self._vacated)))
+                self._vacated[key] = event.obj.node_name
             return [_SINGLETON_REQ]
         if event.kind == Node.KIND or event.kind == ClusterTopology.KIND:
             # capacity/encoding shift: retry the backlog (scan finds it)
@@ -328,18 +336,26 @@ class GangScheduler:
         remembered nodes (exact fit semantics, mutating free on success).
         Returns the gangs the general solve still has to handle.
 
-        The pre-pass walks gangs in the solvers' exact priority order and
-        STOPS at the first gang it cannot reserve-place: reservations are a
-        priority-prefix optimization, so a reserved gang can never consume
-        capacity ahead of a higher-priority gang that the general solve
-        would have served first (no priority inversion)."""
+        The pre-pass walks gangs in the solver's exact priority order and
+        SKIPS gangs without a usable reservation instead of stopping at
+        the first one (advisor r3: one high-priority unreserved gang used
+        to silently disable reuse for the whole backlog). No priority
+        inversion: before committing a reservation, the remaining
+        schedulable free capacity minus this gang's demand must still
+        cover the AGGREGATE demand of every higher-priority gang skipped
+        so far — a reserved gang never consumes capacity a skipped gang
+        may need."""
         from ..solver.fit import place_gang_in_domain, placement_score_for_nodes
         from ..solver.result import GangPlacement
         from ..solver.serial import gang_sort_key
 
         order = sorted(solver_gangs, key=gang_sort_key)
         node_index = snapshot.node_index
-        for pos, sg in enumerate(order):
+        sched = snapshot.schedulable
+        free_agg = free[sched].sum(axis=0)
+        skipped_demand = np.zeros_like(free_agg)
+        remaining: list = []
+        for sg in order:
             pg = by_name.get(sg.name)
             ref = pg.spec.reuse_reservation_ref if pg is not None else None
             reserved = (
@@ -347,8 +363,22 @@ class GangScheduler:
                 if ref is not None and not sg.unschedulable_reason
                 else None
             )
+
+            def skip(sg=sg):
+                remaining.append(sg)
+                return sg.total_demand()
+
             if not reserved:
-                return order[pos:]
+                skipped_demand = skipped_demand + skip()
+                continue
+            gang_total = sg.total_demand()
+            if np.any(
+                free_agg - gang_total + 1e-9 < skipped_demand
+            ):
+                # committing here could starve a skipped higher-priority
+                # gang: leave this one to the general solve as well
+                skipped_demand = skipped_demand + skip()
+                continue
             idx = np.asarray(
                 [
                     node_index[n]
@@ -365,14 +395,18 @@ class GangScheduler:
             if level >= 0 and len(idx):
                 ids = snapshot.domain_ids[level, idx]
                 if not (ids == ids[0]).all():
-                    return order[pos:]
+                    skipped_demand = skipped_demand + skip()
+                    continue
             assign = (
                 place_gang_in_domain(sg, snapshot, free, idx, level)
                 if len(idx)
                 else None
             )
             if assign is None:
-                return order[pos:]  # reservation gone/too small: general
+                # reservation gone/too small: general solve handles it
+                skipped_demand = skipped_demand + skip()
+                continue
+            free_agg = free_agg - gang_total
             self._bind(
                 pg,
                 GangPlacement(
@@ -385,7 +419,7 @@ class GangScheduler:
                     placement_score=placement_score_for_nodes(snapshot, assign),
                 ),
             )
-        return []
+        return remaining
 
     # -- priority preemption (the reclaim the reference outsources to KAI;
     # SURVEY §2: Grove hands PodGangs to an external scheduler that owns
@@ -510,8 +544,18 @@ class GangScheduler:
                     (avail[dom] + vec + 1e-9 >= need).all()
                     for dom, vec in freed.items()
                 ):
-                    satisfied = True
-                    break
+                    # The aggregate check ignores per-node fragmentation
+                    # and per-pod demand shape (advisor r3, medium): two
+                    # victims freeing 4 cpu on different nodes do not help
+                    # a preemptor that needs one 8-cpu node. Verify with
+                    # an EXACT trial placement against a hypothetical free
+                    # matrix before disrupting anything; keep accumulating
+                    # victims while the trial still fails.
+                    if self._trial_place(
+                        sg, snapshot, free, chosen, demand_fn, node_index
+                    ):
+                        satisfied = True
+                        break
             if not chosen or not satisfied:
                 continue  # no victim set makes the preemptor feasible
             self._preempted_for.add(key)
@@ -523,6 +567,32 @@ class GangScheduler:
                 self._evict(victim, preemptor=name)
             evicted_any = True
         return evicted_any
+
+    def _trial_place(
+        self, sg, snapshot, free, victims, demand_fn, node_index
+    ) -> bool:
+        """Exact feasibility check for preemption: return the chosen
+        victims' bound capacity to a COPY of the residual free matrix and
+        run the full serial placement for the preemptor. Only a successful
+        trial licenses the eviction (advisor r3: aggregate accounting
+        destroyed running gangs without making the preemptor placeable)."""
+        from ..solver.serial import _place_one
+
+        trial_free = free.copy()
+        for victim in victims:
+            for group in victim.spec.pod_groups:
+                for ref in group.pod_references:
+                    pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
+                    if pod is None or not pod.node_name:
+                        continue
+                    i = node_index.get(pod.node_name)
+                    if i is None:
+                        continue
+                    d = demand_fn(ref.namespace, ref.name)
+                    if d is not None:
+                        trial_free[i] += d
+        sched_nodes = np.flatnonzero(snapshot.schedulable)
+        return _place_one(sg, snapshot, trial_free, sched_nodes) is not None
 
     def _evict(self, gang: PodGang, preemptor: str) -> None:
         """Preemption eviction: mark DisruptionTarget (the same signal the
@@ -574,9 +644,12 @@ class GangScheduler:
         ns = gang.metadata.namespace
         for pod_name, node_name in placement.pod_to_node.items():
             self.store.bind_pod(ns, pod_name, node_name)
-        if len(self._reservations) > 100_000:
-            self._reservations.clear()
-        self._reservations[(ns, gang.metadata.name)] = tuple(
+        # bounded LRU, same policy as _vacated (advisor r3)
+        rkey = (ns, gang.metadata.name)
+        self._reservations.pop(rkey, None)
+        if len(self._reservations) >= self.RESERVATIONS_LRU_MAX:
+            self._reservations.pop(next(iter(self._reservations)))
+        self._reservations[rkey] = tuple(
             sorted(set(placement.pod_to_node.values()))
         )
         self._preempted_for.discard((ns, gang.metadata.name))

@@ -581,3 +581,89 @@ def test_pp7_foreign_scheduled_gangs_are_never_preempted():
         "grove_scheduler_preemptions_total").total() == 0
     assert all(p.node_name for p in h.store.list(
         Pod.KIND, labels={constants.LABEL_PART_OF: "low"}))
+
+
+class TestPP_TrialPlacement:
+    """Advisor r3 (medium): eviction must be licensed by an EXACT trial
+    placement, not aggregate capacity math — victims freeing fragments on
+    different nodes must never be destroyed for a preemptor whose pod
+    needs one whole node."""
+
+    def test_pp8_fragmented_victims_are_not_evicted(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        # 2 nodes x 2 cpu. Per PCS replica: base gang (1 pod, 1 cpu) +
+        # scaled gang (1 pod, 1 cpu); BFD packs each replica's pair onto
+        # one node -> A: base0+scaled0, B: base1+scaled1. Cluster full.
+        h = Harness(nodes=make_nodes(
+            2, racks_per_block=2, hosts_per_rack=1,
+            allocatable={"cpu": 2.0, "memory": 8.0, "tpu": 0.0}))
+        low = simple_pcs(
+            name="low", replicas=2,
+            cliques=[clique("w", replicas=1, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1)],
+        )
+        h.apply(low)
+        h.settle()
+        assert all(p.node_name for p in h.store.list(Pod.KIND))
+        h.store.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=1000.0))
+        # preemptor: ONE pod needing a WHOLE node (2 cpu). Evicting both
+        # scaled gangs frees 1 cpu on each node -- aggregate 2 >= 2, but
+        # no single node fits the pod. Nothing may be disturbed.
+        hi = simple_pcs(name="hi", cliques=[clique("w", replicas=1, cpu=2.0)])
+        hi.spec.template.priority_class_name = "gold"
+        h.apply(hi)
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        assert h.cluster.metrics.counter(
+            "grove_scheduler_preemptions_total").total() == 0
+        for name in ("low-0-grp-0", "low-1-grp-0"):
+            scaled = h.store.get(PodGang.KIND, "default", name)
+            assert cond(
+                scaled, PodGangConditionType.SCHEDULED.value
+            ).status == "True", name
+        hi_gang = h.store.get(PodGang.KIND, "default", "hi-0")
+        assert cond(
+            hi_gang, PodGangConditionType.SCHEDULED.value
+        ).status == "False"
+
+
+class TestSchedulerLRU:
+    """Advisor r3: crossing the reservation-memory bound evicts the
+    OLDEST entry, not the whole map."""
+
+    def test_vacated_lru_keeps_hot_entries(self):
+        h = Harness(nodes=make_nodes(4))
+        sched = h.scheduler
+        sched.VACATED_LRU_MAX = 4
+        from grove_tpu.cluster.store import Event as Ev
+        from grove_tpu.api.types import Pod
+
+        def deleted(name, node):
+            from grove_tpu.api.meta import ObjectMeta
+            from grove_tpu.api.types import PodSpec
+
+            pod = Pod(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    labels={constants.LABEL_PODGANG: "g"},
+                ),
+                spec=PodSpec(),
+            )
+            pod.node_name = node
+            return Ev(seq=0, type="Deleted", kind=Pod.KIND,
+                      namespace="default", name=name, obj=pod)
+
+        for i in range(4):
+            sched.map_event(deleted(f"p{i}", f"n{i}"))
+        # refresh p0 (re-delete): now p1 is the oldest
+        sched.map_event(deleted("p0", "n0-new"))
+        sched.map_event(deleted("p4", "n4"))  # crosses the bound
+        keys = {k[1] for k in sched._vacated}
+        assert "p1" not in keys, "oldest entry evicted"
+        assert keys == {"p0", "p2", "p3", "p4"}
+        assert sched._vacated[("default", "p0")] == "n0-new"

@@ -307,20 +307,16 @@ class TestRR_ReservationReuse:
         assert all(p.status.ready for p in h.store.list(Pod.KIND))
 
     def test_rr3_reservation_never_inverts_priority(self):
-        """The reserve pre-pass is a priority-prefix: a reserved
-        low-priority gang must NOT bind ahead of a higher-priority gang
-        without a reservation (both fall through to the priority-ordered
-        general solve)."""
+        """Advisor r3: a higher-priority gang WITHOUT a reservation is
+        SKIPPED (not a stop sign) by the reserve pre-pass — but a
+        reservation only commits while the remaining capacity still
+        covers every skipped higher-priority gang's demand, so reuse can
+        never starve them."""
         import numpy as np
 
         from grove_tpu.api.meta import NamespacedName, ObjectMeta
         from grove_tpu.api.podgang import PodGang, PodGangSpec
         from grove_tpu.solver import SolverGang
-
-        h = Harness(nodes=self.one_cpu_nodes(4))
-        sched = h.scheduler
-        snapshot = h.cluster.topology_snapshot()
-        free = snapshot.free.copy()
 
         def sg(name, priority):
             return SolverGang(
@@ -333,23 +329,47 @@ class TestRR_ReservationReuse:
                 priority=priority,
             )
 
-        def pg(name, ref=None):
+        def pg(h, name, ref=None):
             g = PodGang(metadata=ObjectMeta(name=name, namespace="default"))
             if ref:
                 g.spec = PodGangSpec(reuse_reservation_ref=NamespacedName(
                     namespace="default", name=ref))
-            return g
+            return h.store.create(g)
 
+        # AMPLE capacity: the skipped hi gang cannot be starved, so the
+        # reserved lo gang binds back onto node-0 (reuse no longer
+        # disabled by one unreserved higher-priority gang)
+        h = Harness(nodes=self.one_cpu_nodes(4))
+        sched = h.scheduler
+        snapshot = h.cluster.topology_snapshot()
+        free = snapshot.free.copy()
         sched._reservations[("default", "lo")] = ("node-0",)
-        by_name = {"hi": pg("hi"), "lo": pg("lo", ref="lo")}
-        before = free.copy()
+        by_name = {
+            "hi": pg(h, "hi"), "lo": pg(h, "lo", ref="lo"),
+        }
         remaining = sched._try_reserved(
             [sg("lo", 0.0), sg("hi", 10.0)], by_name, snapshot, free
         )
-        # hi (no reservation) is first in priority order -> pre-pass stops
-        # immediately; NOTHING binds and free capacity is untouched
-        assert [g.name for g in remaining] == ["hi", "lo"]
-        np.testing.assert_allclose(free, before)
+        assert [g.name for g in remaining] == ["hi"]
+        n0 = snapshot.node_index["node-0"]
+        assert free[n0, 0] == 0.0, "lo reserve-placed on node-0"
+
+        # SCARCE capacity (1 node): committing lo would starve hi -> lo
+        # must fall through to the priority-ordered general solve
+        h2 = Harness(nodes=self.one_cpu_nodes(1))
+        sched2 = h2.scheduler
+        snap2 = h2.cluster.topology_snapshot()
+        free2 = snap2.free.copy()
+        before2 = free2.copy()
+        sched2._reservations[("default", "lo")] = ("node-0",)
+        by_name2 = {
+            "hi": pg(h2, "hi"), "lo": pg(h2, "lo", ref="lo"),
+        }
+        remaining2 = sched2._try_reserved(
+            [sg("lo", 0.0), sg("hi", 10.0)], by_name2, snap2, free2
+        )
+        assert sorted(g.name for g in remaining2) == ["hi", "lo"]
+        np.testing.assert_allclose(free2, before2)
 
 
 class TestOR_OperatorRestart:
