@@ -350,7 +350,13 @@ def bench_service(args) -> int:
         return 0
     finally:
         proc.send_signal(signal.SIGTERM)
-        proc.wait(timeout=10)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # a lingering server holds the accelerator and poisons the
+            # next run's device acquisition (advisor r3)
+            proc.kill()
+            proc.wait(timeout=10)
 
 
 def bench_controlplane(num_nodes: int, replicas: int) -> dict:

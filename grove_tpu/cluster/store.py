@@ -526,7 +526,14 @@ class ObjectStore:
         """Pod binding fast path (the Binding-subresource analog): set
         node_name on an unbound pod without the full update() clone +
         admission machinery. Returns False when the pod is gone or already
-        bound."""
+        bound.
+
+        Admission exemption (documented contract, advisor r3): like the
+        k8s pods/binding and pods/status SUBRESOURCES, this path and
+        ungate_pod bypass any registered update-admission webhook for the
+        Pod kind — a Pod admission that must see binds/gate-drops has to
+        hook the subresource explicitly (not modeled here), exactly as in
+        Kubernetes where a pods webhook does not fire for pods/binding."""
         key = _key(namespace, name)
         current = self._objs.get("Pod", {}).get(key)
         if current is None or current.node_name:

@@ -1,11 +1,14 @@
 """Placement service: the engine behind a gRPC boundary (SURVEY §7)."""
 
 from .client import RemotePlacementEngine
-from .server import PlacementService, serve, snapshot_epoch
+from .server import PlacementService, RotatingTLSServer, serve, snapshot_epoch
+from .tls import CertRotator
 
 __all__ = [
+    "CertRotator",
     "PlacementService",
     "RemotePlacementEngine",
+    "RotatingTLSServer",
     "serve",
     "snapshot_epoch",
 ]

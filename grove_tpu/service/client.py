@@ -63,11 +63,27 @@ class RemotePlacementEngine:
         #: error (manager retries) rather than blocking the control plane
         #: forever
         self.timeout_seconds = timeout_seconds
-        channel = _channel_for(address, root_ca)
-        self._sync = channel.unary_unary(f"/{SERVICE}/Sync")
-        self._solve = channel.unary_unary(f"/{SERVICE}/Solve")
+        self._root_ca = root_ca
+        self._bind_channel()
         self.epoch = snapshot_epoch(snapshot)
         self._register()
+
+    def _bind_channel(self) -> None:
+        channel = _channel_for(self.address, self._root_ca)
+        self._sync = channel.unary_unary(f"/{SERVICE}/Sync")
+        self._solve = channel.unary_unary(f"/{SERVICE}/Solve")
+
+    def _rechannel(self) -> None:
+        """Tear down and rebuild the shared channel for this address —
+        the client side of the server's restart-on-refresh cert rotation
+        (a live channel can keep a broken/renegotiating transport; a
+        fresh one handshakes against the CURRENT server cert, which the
+        pinned CA still signs)."""
+        key = (self.address, self._root_ca)
+        ch = _channels.pop(key, None)
+        if ch is not None:
+            ch.close()
+        self._bind_channel()
 
     def _register(self) -> None:
         server_epoch = self._sync(
@@ -90,12 +106,24 @@ class RemotePlacementEngine:
             response = self._solve(request, timeout=self.timeout_seconds,
                                    wait_for_ready=True)
         except grpc.RpcError as err:
-            if err.code() != grpc.StatusCode.FAILED_PRECONDITION:
+            if err.code() == grpc.StatusCode.FAILED_PRECONDITION:
+                # the service restarted (or evicted this epoch): re-Sync
+                # and retry once — without this the scheduler's cached
+                # engine would fail every reconcile until the topology
+                # changed
+                self._register()
+            elif err.code() in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+            ):
+                # transport-level outage — e.g. the server hot-restarted
+                # its listener for a cert rotation: rebuild the channel
+                # (fresh handshake against the renewed cert), re-Sync,
+                # retry once
+                self._rechannel()
+                self._register()
+            else:
                 raise
-            # the service restarted (or evicted this epoch): re-Sync and
-            # retry once — without this the scheduler's cached engine
-            # would fail every reconcile until the topology changed
-            self._register()
             response = self._solve(request, timeout=self.timeout_seconds,
                                    wait_for_ready=True)
         result = codec.decode_solve_response(

@@ -154,6 +154,49 @@ def serve(address: str, service: PlacementService | None = None,
     return server
 
 
+class RotatingTLSServer:
+    """A TLS placement server with the reference rotator's
+    restart-on-refresh lifecycle (cert.go:36-70): `maybe_rotate()` checks
+    the CertRotator; when renewal is due it re-issues the server cert
+    under the same CA and HOT-RESTARTS the listener with the fresh
+    credentials. Clients pinning ca.pem reconnect transparently
+    (RemotePlacementEngine retries UNAVAILABLE once over a rebuilt
+    channel). In production `serve_forever_with_rotation` runs the check
+    on an interval; tests drive `maybe_rotate()` from a virtual clock
+    via the rotator's injectable now_fn."""
+
+    def __init__(self, address: str, rotator,
+                 service: PlacementService | None = None,
+                 max_workers: int = 4):
+        self.address = address
+        self.rotator = rotator
+        #: ONE engine-cache shared across restarts: a cert rotation must
+        #: not cold-start every epoch
+        self.service = service or PlacementService()
+        self.max_workers = max_workers
+        self._server = None
+
+    def start(self) -> None:
+        self._server = serve(
+            self.address, service=self.service,
+            max_workers=self.max_workers, tls=self.rotator.bundle,
+        )
+
+    def maybe_rotate(self) -> bool:
+        """Renew + restart the listener when the rotator says so."""
+        if not self.rotator.maybe_renew():
+            return False
+        old = self._server
+        if old is not None:
+            old.stop(grace=1.0)
+        self.start()
+        return True
+
+    def stop(self, grace=None) -> None:
+        if self._server is not None:
+            self._server.stop(grace=grace)
+
+
 def main() -> int:  # pragma: no cover - thin CLI
     import argparse
 
@@ -162,12 +205,16 @@ def main() -> int:  # pragma: no cover - thin CLI
     ap.add_argument("--tls-dir", default=None,
                     help="write a self-managed CA + server cert here and "
                     "serve TLS; clients read ca.pem from the same dir")
+    ap.add_argument("--cert-check-seconds", type=float, default=3600.0,
+                    help="interval of the cert-renewal check loop "
+                    "(TLS mode only)")
     args = ap.parse_args()
-    tls_bundle = None
     if args.tls_dir:
+        import threading
+        import time as _time
         from pathlib import Path
 
-        from .tls import issue_server_cert, load_or_create_ca
+        from .tls import CertRotator, load_or_create_ca
 
         if args.address.startswith("unix:"):
             host = "localhost"
@@ -176,11 +223,29 @@ def main() -> int:  # pragma: no cover - thin CLI
         # persistent CA: restarts re-issue the server cert (rotation)
         # under the SAME CA, so clients holding ca.pem keep trusting
         ca_cert, ca_key = load_or_create_ca(args.tls_dir)
-        tls_bundle = issue_server_cert(ca_cert, ca_key, hostname=host)
-        (Path(args.tls_dir) / "server.pem").write_bytes(tls_bundle.cert)
-    server = serve(args.address, tls=tls_bundle)
-    mode = "TLS" if tls_bundle else "plaintext"
-    print(f"placement service listening on {args.address} ({mode})",
+        rotator = CertRotator(ca_cert, ca_key, hostname=host)
+        (Path(args.tls_dir) / "server.pem").write_bytes(rotator.bundle.cert)
+        rserver = RotatingTLSServer(args.address, rotator)
+        rserver.start()
+        print(f"placement service listening on {args.address} (TLS)",
+              flush=True)
+
+        # the rotator loop (cert.go:36-70): renew + hot-restart before
+        # expiry so an expired server cert can never strand clients
+        def check_loop():
+            while True:
+                _time.sleep(args.cert_check_seconds)
+                if rserver.maybe_rotate():
+                    (Path(args.tls_dir) / "server.pem").write_bytes(
+                        rotator.bundle.cert
+                    )
+                    print("server certificate renewed", flush=True)
+
+        threading.Thread(target=check_loop, daemon=True).start()
+        rserver._server.wait_for_termination()
+        return 0
+    server = serve(args.address)
+    print(f"placement service listening on {args.address} (plaintext)",
           flush=True)
     server.wait_for_termination()
     return 0

@@ -114,6 +114,7 @@ def load_or_create_ca(directory):
     """Persistent CA for a tls-dir (ca.pem + ca-key.pem): reuse when both
     exist so server restarts ROTATE the server cert under the SAME CA and
     existing client trust keeps working; create + persist otherwise."""
+    import os
     from pathlib import Path
 
     d = Path(directory)
@@ -127,6 +128,58 @@ def load_or_create_ca(directory):
         return ca_cert, ca_key
     ca_cert, ca_key = make_ca()
     cert_path.write_bytes(_pem_cert(ca_cert))
-    key_path.write_bytes(_pem_key(ca_key))
-    key_path.chmod(0o600)
+    # the key file is BORN 0600 (O_EXCL): a write-then-chmod leaves a
+    # umask-dependent window where a crash persists the CA key readable
+    # (advisor r3)
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    try:
+        os.write(fd, _pem_key(ca_key))
+    finally:
+        os.close(fd)
     return ca_cert, ca_key
+
+
+class CertRotator:
+    """Expiry-driven server-cert renewal — the reference's cert-controller
+    rotator loop (cert.go:36-70: regenerate before expiry, restart on
+    refresh). The CA is stable (clients keep trusting ca.pem); the SERVER
+    cert is re-issued once `now` enters the renewal window before
+    not_valid_after. `now_fn` is injectable so tests drive renewal from a
+    virtual clock (OpenSSL itself always sees real time; what the rotator
+    controls is WHEN a fresh cert exists)."""
+
+    def __init__(self, ca_cert, ca_key, hostname: str = "localhost",
+                 valid_days: int = 365, renew_before_days: float = 30.0,
+                 now_fn=None):
+        self.ca_cert = ca_cert
+        self.ca_key = ca_key
+        self.hostname = hostname
+        self.valid_days = valid_days
+        self.renew_before = datetime.timedelta(days=renew_before_days)
+        self._now_fn = now_fn or (
+            lambda: datetime.datetime.now(datetime.timezone.utc)
+        )
+        self.bundle = issue_server_cert(
+            ca_cert, ca_key, hostname=hostname, valid_days=valid_days
+        )
+        self.rotations = 0
+
+    @property
+    def not_valid_after(self) -> datetime.datetime:
+        cert = x509.load_pem_x509_certificate(self.bundle.cert)
+        return cert.not_valid_after_utc
+
+    def renewal_due(self) -> bool:
+        return self._now_fn() >= self.not_valid_after - self.renew_before
+
+    def maybe_renew(self) -> bool:
+        """Re-issue the server cert under the same CA when due. Returns
+        True when a fresh bundle was installed."""
+        if not self.renewal_due():
+            return False
+        self.bundle = issue_server_cert(
+            self.ca_cert, self.ca_key, hostname=self.hostname,
+            valid_days=self.valid_days,
+        )
+        self.rotations += 1
+        return True

