@@ -111,6 +111,15 @@ class Harness:
                     return
         raise RuntimeError("harness did not settle")
 
+    def debug_dump(self) -> dict:
+        """Runtime introspection (the pprof-dump analog; SURVEY §5):
+        per-controller reconcile stats + queue depths + store counts +
+        scheduler/engine cache state as one JSON-able dict. See
+        observability/debug.py and docs/operations.md."""
+        from ..observability.debug import harness_dump
+
+        return harness_dump(self)
+
     def advance(self, seconds: float) -> None:
         """Advance the virtual clock past timers (gang termination,
         scheduler retries) and settle."""

@@ -1,0 +1,134 @@
+"""Runtime introspection — the pprof/debug-endpoint analog.
+
+The reference serves pprof from its controller manager when profiling is
+enabled (operator/internal/controller/manager.go:42-44,114-119). grove_tpu
+is an in-process control plane plus one long-lived network service, so the
+same visibility ships as structured DUMPS instead of a sampling profiler:
+
+  Harness.debug_dump()         — controller-manager state: per-controller
+                                 reconcile totals/errors and duration
+                                 percentiles, workqueue/requeue depth,
+                                 event-log cursor + horizon, store object
+                                 counts, scheduler/engine cache state
+  grove.Placement/Debug (gRPC) — the placement service's state: cached
+                                 topology epochs + engine shapes, solve
+                                 counters, process uptime
+
+Both are plain JSON-able dicts; `docs/operations.md` documents the
+surfaces and `python -m grove_tpu.observability.debug --address ...`
+fetches the service dump from a shell.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def manager_dump(manager) -> dict[str, Any]:
+    """ControllerManager introspection: what the reference's workqueue +
+    controller-runtime metrics expose, read directly off the runtime."""
+    m = manager.metrics
+    per_controller: dict[str, Any] = {}
+    if m is not None:
+        totals = m.counter("grove_manager_reconcile_total")
+        errors = m.counter("grove_manager_reconcile_errors_total")
+        dur = m.histogram("grove_manager_reconcile_duration_seconds")
+        for c in manager.controllers:
+            series = dur._series.get((("controller", c.name),), [])
+            per_controller[c.name] = {
+                "reconciles": totals.value(controller=c.name),
+                "errors": errors.value(controller=c.name),
+                "duration_seconds": {
+                    "count": len(series),
+                    "p50": dur.percentile(50, controller=c.name),
+                    "p99": dur.percentile(99, controller=c.name),
+                },
+            }
+    return {
+        "controllers": per_controller,
+        "workqueue_depth": len(manager._queue),
+        "pending_requeues": len(manager._requeues),
+        "next_requeue_at": manager.next_requeue_at(),
+        "recorded_errors": len(manager.errors),
+        "event_cursor": manager._cursor,
+        "is_leader": (
+            manager.elector.is_leader() if manager.elector is not None
+            else True
+        ),
+    }
+
+
+def store_dump(store) -> dict[str, Any]:
+    return {
+        "objects_by_kind": {
+            kind: len(bucket)
+            for kind, bucket in sorted(store._objs.items())
+            if bucket
+        },
+        "event_log_length": len(store._events),
+        "last_seq": store.last_seq,
+        "compacted_seq": store._compacted_seq,
+        "label_index_buckets": len(store._label_idx),
+    }
+
+
+def scheduler_dump(scheduler) -> dict[str, Any]:
+    engine = scheduler._engine
+    return {
+        "dirty_gangs": len(scheduler._dirty),
+        "starved_gangs": len(scheduler._starved),
+        "gang_reservations": len(scheduler._reservations),
+        "vacated_pod_reservations": len(scheduler._vacated),
+        "preemption_attempted_for": len(scheduler._preempted_for),
+        # RemotePlacementEngine has no local DomainSpace/device state —
+        # its server-side twin shows up in the service's Debug dump
+        "engine": None if engine is None else {
+            "type": type(engine).__name__,
+            "num_nodes": engine.snapshot.num_nodes,
+            "num_domains": getattr(
+                getattr(engine, "space", None), "num_domains", None
+            ),
+            "device_statics_resident": (
+                getattr(engine, "_dev_static", None) is not None
+            ),
+        },
+    }
+
+
+def harness_dump(harness) -> dict[str, Any]:
+    """The full in-process debug surface (see module docstring)."""
+    return {
+        "manager": manager_dump(harness.manager),
+        "store": store_dump(harness.store),
+        "scheduler": scheduler_dump(harness.scheduler),
+        "virtual_clock": harness.clock.now(),
+    }
+
+
+def main() -> int:  # pragma: no cover - thin CLI
+    """Fetch the placement service's Debug dump from a shell:
+    python -m grove_tpu.observability.debug --address 127.0.0.1:7077"""
+    import argparse
+    import json
+
+    import grpc
+
+    ap = argparse.ArgumentParser(
+        description="dump grove placement-service debug state"
+    )
+    ap.add_argument("--address", default="127.0.0.1:7077")
+    ap.add_argument("--ca", default=None, help="ca.pem path for TLS")
+    args = ap.parse_args()
+    if args.ca:
+        with open(args.ca, "rb") as fh:
+            creds = grpc.ssl_channel_credentials(root_certificates=fh.read())
+        channel = grpc.secure_channel(args.address, creds)
+    else:
+        channel = grpc.insecure_channel(args.address)
+    debug = channel.unary_unary("/grove.Placement/Debug")
+    print(json.dumps(json.loads(debug(b"", timeout=10.0)), indent=2))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

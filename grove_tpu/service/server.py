@@ -51,6 +51,11 @@ class PlacementService:
         self.engine_kwargs = engine_kwargs
         self.max_epochs = max_epochs
         self._engines: dict[str, PlacementEngine] = {}
+        import time as _time
+
+        self._started_at = _time.time()
+        self._solves = 0
+        self._syncs = 0
         # the gRPC thread pool serves RPCs concurrently: the
         # check-evict-insert must be atomic (double-pop at capacity /
         # double engine build otherwise)
@@ -76,6 +81,7 @@ class PlacementService:
             codec.decode_topology_snapshot, request, "topology", context
         )
         epoch = snapshot_epoch(snapshot)
+        self._syncs += 1
         with self._lock:
             known = epoch in self._engines
         if not known:
@@ -117,7 +123,33 @@ class PlacementService:
             # mask widths, ...) must not surface as an opaque UNKNOWN
             self._abort(context, grpc.StatusCode.INVALID_ARGUMENT,
                         f"solve failed on payload: {err}", err)
+        self._solves += 1
         return codec.encode_solve_response(result)
+
+    def debug(self, request: bytes, context=None) -> bytes:
+        """The pprof-analog introspection surface (SURVEY §5; the
+        reference serves pprof from its manager, manager.go:114-119):
+        cached epochs + engine shapes, solve/sync counters, uptime —
+        as JSON bytes. Read-only; safe to expose alongside Solve."""
+        import json
+        import time as _time
+
+        with self._lock:
+            epochs = {
+                epoch: {
+                    "num_nodes": eng.snapshot.num_nodes,
+                    "num_domains": eng.space.num_domains,
+                    "device_statics_resident": eng._dev_static is not None,
+                }
+                for epoch, eng in self._engines.items()
+            }
+        return json.dumps({
+            "epochs": epochs,
+            "max_epochs": self.max_epochs,
+            "solves_total": self._solves,
+            "syncs_total": self._syncs,
+            "uptime_seconds": round(_time.time() - self._started_at, 3),
+        }).encode()
 
 
 def serve(address: str, service: PlacementService | None = None,
@@ -137,6 +169,9 @@ def serve(address: str, service: PlacementService | None = None,
                 response_serializer=identity),
             "Solve": grpc.unary_unary_rpc_method_handler(
                 service.solve, request_deserializer=identity,
+                response_serializer=identity),
+            "Debug": grpc.unary_unary_rpc_method_handler(
+                service.debug, request_deserializer=identity,
                 response_serializer=identity),
         },
     )

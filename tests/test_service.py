@@ -284,3 +284,108 @@ def test_ca_key_file_born_private(tmp_path):
     load_or_create_ca(tmp_path / "tls")
     mode = stat.S_IMODE((tmp_path / "tls" / "ca-key.pem").stat().st_mode)
     assert mode == 0o600
+
+
+def test_debug_endpoint_and_harness_dump(server_address):
+    """VERDICT r3 #6: the pprof-analog introspection surfaces. The
+    service's Debug RPC reports engine-cache state + counters; the
+    harness dump reports queue depths, store counts and per-controller
+    reconcile percentiles."""
+    import json
+
+    import grpc
+
+    from grove_tpu.service.codec import GRPC_MESSAGE_OPTIONS
+
+    snap = cluster()
+    eng = RemotePlacementEngine(snap, server_address, timeout_seconds=30.0)
+    eng.solve([gang("dbg", pods=1, cpu=1.0)])
+    with grpc.insecure_channel(
+        server_address, options=GRPC_MESSAGE_OPTIONS
+    ) as ch:
+        dump = json.loads(
+            ch.unary_unary("/grove.Placement/Debug")(b"", timeout=10.0)
+        )
+    assert dump["solves_total"] >= 1
+    assert dump["syncs_total"] >= 1
+    assert dump["uptime_seconds"] >= 0
+    assert eng.epoch in dump["epochs"]
+    assert dump["epochs"][eng.epoch]["num_nodes"] == snap.num_nodes
+
+    # harness dump: drive a tiny control plane and introspect it
+    from test_e2e_basic import clique, simple_pcs
+    from grove_tpu.controller import Harness
+    from grove_tpu.cluster import make_nodes
+
+    h = Harness(nodes=make_nodes(4))
+    h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    h.settle()
+    d = h.debug_dump()
+    json.dumps(d)  # the dump must be JSON-able as-is
+    assert d["store"]["objects_by_kind"]["Pod"] == 2
+    ctrl = d["manager"]["controllers"]
+    assert ctrl["podclique"]["reconciles"] >= 1
+    assert ctrl["scheduler"]["duration_seconds"]["count"] >= 1
+    assert ctrl["scheduler"]["duration_seconds"]["p99"] >= 0
+    assert d["manager"]["workqueue_depth"] == 0  # settled
+    assert d["scheduler"]["engine"]["num_nodes"] == 4
+    assert d["manager"]["is_leader"] is True
+
+
+def test_console_script_deployment(tmp_path):
+    """VERDICT r3 #9 (packaging): the documented deployment recipe works
+    end to end — spawn the service process with a tls-dir, verify the
+    TLS material appears, solve through the boundary, probe Debug as the
+    health check (docs/operations.md)."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import grpc
+
+    from grove_tpu.service.codec import GRPC_MESSAGE_OPTIONS
+
+    tls_dir = tmp_path / "tls"
+    address = f"127.0.0.1:{_free_port()}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "grove_tpu.service.server",
+         "--address", address, "--tls-dir", str(tls_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        for _ in range(20):
+            line = proc.stdout.readline()
+            if "listening" in line:
+                break
+            if not line or proc.poll() is not None:
+                raise RuntimeError("service failed to start")
+        # the recipe's TLS material exists, key born private
+        import stat
+
+        assert (tls_dir / "ca.pem").exists()
+        assert (tls_dir / "server.pem").exists()
+        mode = stat.S_IMODE((tls_dir / "ca-key.pem").stat().st_mode)
+        assert mode == 0o600
+        ca_pem = (tls_dir / "ca.pem").read_bytes()
+        snap = cluster()
+        eng = RemotePlacementEngine(snap, address, root_ca=ca_pem,
+                                    timeout_seconds=30.0)
+        assert eng.solve([gang("a", pods=1, cpu=1.0)]).num_placed == 1
+        # health probe per the docs: Debug answers and shows the epoch
+        creds = grpc.ssl_channel_credentials(root_certificates=ca_pem)
+        with grpc.secure_channel(
+            address, creds, options=GRPC_MESSAGE_OPTIONS
+        ) as ch:
+            dump = json.loads(
+                ch.unary_unary("/grove.Placement/Debug")(b"", timeout=10.0)
+            )
+        assert dump["epochs"], "synced epoch visible to the health probe"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
