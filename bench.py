@@ -226,6 +226,30 @@ def main() -> int:
         / max(g_iters, 1)
     )
 
+    # Scale-ceiling probe (VERDICT r3 #8): one datapoint at 2x the north
+    # star (2000 gangs / 10000 nodes) proving the bucketing/padding
+    # strategy and memory hold past the stress config.
+    probe = {}
+    if not args.small and args.nodes >= 5000:
+        p_snapshot = make_cluster(args.nodes * 2)
+        p_gangs = make_gangs(args.gangs * 2)
+        p_engine = PlacementEngine(p_snapshot)  # single-device probe
+        p_engine.solve(p_gangs)  # warm-up: new shapes compile
+        p_walls = []
+        p_placed = 0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            p_placed = p_engine.solve(p_gangs).num_placed
+            p_walls.append(time.perf_counter() - t0)
+        p_walls.sort()
+        probe = {
+            "scale2x_nodes": args.nodes * 2,
+            "scale2x_gangs": args.gangs * 2,
+            "scale2x_placed": p_placed,
+            "scale2x_p50_backlog_bind_seconds": round(p_walls[1], 4),
+            "scale2x_gangs_per_sec": round(args.gangs * 2 / p_walls[1], 1),
+        }
+
     # Control-plane bench (VERDICT r1 #4): the FULL path — apply one PCS
     # with N replicas of an 8-pod clique against the same-size inventory,
     # reconcile to quiescence (gated pods -> deferred gangs -> scheduler ->
@@ -265,6 +289,7 @@ def main() -> int:
         "grouped_gangs_per_sec": round(args.gangs / g_wall, 1),
         "grouped_placed": g_placed,
         "grouped_repair_fallbacks": g_fallbacks,
+        **probe,
         "backend": __import__("jax").default_backend(),
         "engine": "sharded" if args.sharded else "single",
         **({"mesh": dict(mesh.shape)} if args.sharded else {}),
