@@ -105,6 +105,9 @@ class GangScheduler:
         #: leaves), so topology-infeasible preemptors cannot thrash the
         #: same victims every retry tick
         self._preempted_for: set[tuple[str, str]] = set()
+        #: gangs bound in the CURRENT reconcile (phase freshly written by
+        #: _bind); cleared per reconcile
+        self._just_bound: set[tuple[str, str]] = set()
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == PodGang.KIND:
@@ -261,8 +264,13 @@ class GangScheduler:
             requeue = self.retry_seconds
         # the full examine set: a previously-starved gang whose pods were
         # just bound best-effort must get its phase/Ready refresh in THIS
-        # reconcile, not via follow-on pod events (advisor r2)
-        self._update_phases(examine | set(backlog_keys))
+        # reconcile, not via follow-on pod events (advisor r2). Gangs
+        # _bind wrote THIS round are skipped (their conditions continue on
+        # the next pod-event round).
+        self._update_phases(
+            (examine | set(backlog_keys)) - self._just_bound
+        )
+        self._just_bound = set()
         return Result(requeue_after=requeue)
 
     def _update_phases(self, keys: set[tuple[str, str]]) -> None:
@@ -653,29 +661,39 @@ class GangScheduler:
             sorted(set(placement.pod_to_node.values()))
         )
         self._preempted_for.discard((ns, gang.metadata.name))
-        gang.status.placement_score = placement.placement_score
-        gang.status.phase = PodGangPhase.STARTING
-        set_condition(
-            gang.status.conditions,
-            PodGangConditionType.SCHEDULED.value,
-            "True",
-            reason="Placed",
-            now=self.store.clock.now(),
-        )
-        if get_condition(
-            gang.status.conditions,
-            PodGangConditionType.DISRUPTION_TARGET.value,
-        ) is not None:
-            # a previously-preempted (or disruption-marked) gang that
-            # re-places is no longer a disruption target
+        now = self.store.clock.now()
+
+        def mutate(status):
+            status.placement_score = placement.placement_score
+            status.phase = PodGangPhase.STARTING
             set_condition(
-                gang.status.conditions,
-                PodGangConditionType.DISRUPTION_TARGET.value,
-                "False",
+                status.conditions,
+                PodGangConditionType.SCHEDULED.value,
+                "True",
                 reason="Placed",
-                now=self.store.clock.now(),
+                now=now,
             )
-        self.store.update_status(gang)
+            if get_condition(
+                status.conditions,
+                PodGangConditionType.DISRUPTION_TARGET.value,
+            ) is not None:
+                # a previously-preempted (or disruption-marked) gang that
+                # re-places is no longer a disruption target
+                set_condition(
+                    status.conditions,
+                    PodGangConditionType.DISRUPTION_TARGET.value,
+                    "False",
+                    reason="Placed",
+                    now=now,
+                )
+
+        self.store.patch_status(
+            PodGang.KIND, ns, gang.metadata.name, mutate
+        )
+        # phase/conditions were just written: the same-round
+        # _update_phases sweep can skip this gang (its Ready/Unhealthy
+        # conditions land on the next pod event round regardless)
+        self._just_bound.add((ns, gang.metadata.name))
         self.metrics.counter(
             "grove_scheduler_gangs_scheduled_total", "gangs bound to nodes"
         ).inc()
