@@ -38,6 +38,20 @@ class PCSGReconciler:
 
     def __init__(self, store: ObjectStore):
         self.store = store
+        #: PCSGs with a rollout in flight: only then do POD events feed
+        #: this reconciler (clique_updated reads pod hashes/readiness);
+        #: outside rollouts pod churn is the PodClique controller's job.
+        #: The generation-change predicate analog, like the PCS/PodClique
+        #: reconcilers.
+        self._rollout_active: set[tuple[str, str]] = set()
+        #: own-write event echoes (clique creates/spec updates) — the
+        #: expectations analog; deletes stay live (scale-in rides them)
+        self._own_events: set[int] = set()
+
+    def _mark_own(self) -> None:
+        self._own_events.add(self.store.last_seq)
+        if len(self._own_events) > 100_000:  # safety: undrained leak
+            self._own_events.clear()
 
     def record_error(self, request: Request, err: GroveError) -> None:
         """Every kind surfaces its own controller errors
@@ -48,17 +62,50 @@ class PCSGReconciler:
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
+            # own status writes / metadata-only bumps feed nothing here
+            if (
+                event.type == "Modified"
+                and event.old is not None
+                and event.obj.metadata.generation
+                == event.old.metadata.generation
+                and event.obj.metadata.deletion_timestamp
+                == event.old.metadata.deletion_timestamp
+            ):
+                return []
             return [Request(event.namespace, event.name)]
-        if event.kind in (PodClique.KIND, "Pod"):
+        if event.kind == PodClique.KIND:
+            if event.seq in self._own_events:
+                self._own_events.discard(event.seq)
+                return []
             pcsg = event.obj.metadata.labels.get(constants.LABEL_PCSG)
             if pcsg:
                 return [Request(event.namespace, pcsg)]
+            return []
+        if event.kind == "Pod":
+            # pods only matter while a rollout is advancing (hash/ready
+            # checks in clique_updated); clique status events carry the
+            # availability rollup otherwise
+            pcsg = event.obj.metadata.labels.get(constants.LABEL_PCSG)
+            if pcsg and (event.namespace, pcsg) in self._rollout_active:
+                return [Request(event.namespace, pcsg)]
+            return []
         if event.kind == PodCliqueSet.KIND:
             # the PCS rolling update pointing at this PCSG's replica is a
-            # status-level trigger (reconcilespec.go:70-117)
+            # status-level trigger (reconcilespec.go:70-117); only spec
+            # changes or rolling-progress movement matter — names only,
+            # no-copy scan
+            if (
+                event.type == "Modified"
+                and event.old is not None
+                and event.obj.metadata.generation
+                == event.old.metadata.generation
+                and event.obj.status.rolling_update_progress
+                == event.old.status.rolling_update_progress
+            ):
+                return []
             return [
                 Request(event.namespace, g.metadata.name)
-                for g in self.store.list(
+                for g in self.store.scan(
                     KIND,
                     namespace=event.namespace,
                     labels={constants.LABEL_PART_OF: event.name},
@@ -76,6 +123,15 @@ class PCSGReconciler:
             KIND, request.namespace, request.name, constants.FINALIZER_PCSG
         )
         self._sync_rolling_update(pcsg)
+        # pod events feed this reconciler only while a rollout advances
+        # (see map_event); track it off the just-written live status
+        key = (request.namespace, request.name)
+        live = self.store.peek(KIND, request.namespace, request.name)
+        prog = live.status.rolling_update_progress if live else None
+        if prog is not None and not prog.completed:
+            self._rollout_active.add(key)
+        else:
+            self._rollout_active.discard(key)
         self._sync_podcliques(pcsg)
         self._reconcile_status(pcsg)
         return Result()
@@ -180,7 +236,9 @@ class PCSGReconciler:
         return Result()
 
     def _owned_pclqs(self, pcsg: PodCliqueScalingGroup) -> list[PodClique]:
-        return self.store.list(
+        """Read-only scan: callers inspect labels/conditions and act
+        through the store API."""
+        return self.store.scan(
             PodClique.KIND,
             namespace=pcsg.metadata.namespace,
             labels={constants.LABEL_PCSG: pcsg.metadata.name},
@@ -190,7 +248,10 @@ class PCSGReconciler:
         name = pcsg.metadata.labels.get(constants.LABEL_PART_OF)
         if not name:
             return None
-        return self.store.get(PodCliqueSet.KIND, pcsg.metadata.namespace, name)
+        # read-only peek: callers read template/rolling progress only
+        return self.store.peek(
+            PodCliqueSet.KIND, pcsg.metadata.namespace, name
+        )
 
     def _sync_podcliques(self, pcsg: PodCliqueScalingGroup) -> None:
         pcs = self._owner_pcs(pcsg)
@@ -218,14 +279,16 @@ class PCSGReconciler:
         )
         for pclq_name, (j, clique_name) in expected.items():
             template = templates.get(clique_name)
-            existing = self.store.get(PodClique.KIND, ns, pclq_name)
+            existing = self.store.peek(PodClique.KIND, ns, pclq_name)
             if existing is not None:
                 if j == updating_replica and template is not None:
                     new_spec = clone(template.spec)
                     new_spec.replicas = existing.spec.replicas
                     if existing.spec != new_spec:
-                        existing.spec = new_spec
-                        self.store.update(existing)
+                        fresh = self.store.get(PodClique.KIND, ns, pclq_name)
+                        fresh.spec = new_spec
+                        self.store.update(fresh)
+                        self._mark_own()
                 continue
             if template is None:
                 continue
@@ -255,6 +318,7 @@ class PCSGReconciler:
                 ),
                 owned=True,
             )
+            self._mark_own()
         # scale-in: drop highest replica indices (components/podclique/
         # podclique.go scale-in path)
         for pclq in self._owned_pclqs(pcsg):
