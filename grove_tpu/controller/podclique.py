@@ -25,6 +25,7 @@ from ..api.types import (
     CliqueStartupType,
     Pod,
     PodClique,
+    PodCliqueRollingUpdateProgress,
     PodCliqueSet,
     PodPhase,
 )
@@ -689,8 +690,6 @@ class PodCliqueReconciler:
         pods exist, rolling_update_progress records which pods are done and
         which one the pod-at-a-time rollout (_rolling_replace) targets next,
         and flips completed once the last pod matches."""
-        from ..api.types import PodCliqueRollingUpdateProgress
-
         current = status.current_pod_template_hash
         updated = sorted(
             p.metadata.name

@@ -51,7 +51,7 @@ from ..api.types import (
     PodCliqueSpec,
     TopologyConstraintSpec,
 )
-from ..cluster.store import Event, ObjectStore, clone
+from ..cluster.store import Event, ObjectStore, _shallow, clone
 from ..observability.events import (
     EventRecorder,
     REASON_GANG_TERMINATED,
@@ -942,6 +942,4 @@ def _shallow_spec(spec: PodCliqueSpec) -> PodCliqueSpec:
     """Independent PodCliqueSpec shell (scalar fields like replicas may be
     written by HPA updates via get-clone-update) sharing the frozen
     template substructure."""
-    from ..cluster.store import _shallow
-
     return _shallow(spec)
