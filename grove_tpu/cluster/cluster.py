@@ -90,6 +90,10 @@ class Cluster:
         #: topology_snapshot static-encoding cache (see topology_snapshot)
         self._snapshot_key: tuple | None = None
         self._snapshot_cache: TopologySnapshot | None = None
+        #: incremental usage accounting (see usage())
+        self._usage: dict[str, dict[str, float]] | None = None
+        self._usage_cursor = 0
+        self._req_cache: dict[int, tuple] = {}
 
     # -- node ops ----------------------------------------------------------
     def cordon(self, name: str) -> None:
@@ -103,20 +107,79 @@ class Cluster:
         self.store.update(node)
 
     # -- solver input ------------------------------------------------------
+    @staticmethod
+    def _counted(pod) -> bool:
+        """A pod holds node capacity iff bound, non-terminal and not
+        marked deleting (kube-scheduler's accounting)."""
+        return bool(
+            pod.node_name
+            and pod.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+            and pod.metadata.deletion_timestamp is None
+        )
+
+    def _pod_requests(self, pod) -> dict[str, float]:
+        """total_requests() memoized by the CONTAINER LIST identity: the
+        MVCC store shares container lists across pod versions (and the
+        frozen template shares them across a whole clique's pods), so one
+        entry serves thousands of pods. Entries hold the keyed object so
+        its id cannot be recycled while cached."""
+        key = id(pod.spec.containers)
+        hit = self._req_cache.get(key)
+        if hit is not None and hit[0] is pod.spec.containers:
+            return hit[1]
+        req = pod.spec.total_requests()
+        if len(self._req_cache) > 65536:
+            self._req_cache.clear()
+        self._req_cache[key] = (pod.spec.containers, req)
+        return req
+
     def usage(self) -> dict[str, dict[str, float]]:
         """Per-node resource usage from bound, non-terminal pods (terminal
-        Succeeded/Failed pods release their requests, as in kube-scheduler's
-        accounting)."""
-        out: dict[str, dict[str, float]] = {}
-        terminal = (PodPhase.SUCCEEDED, PodPhase.FAILED)
-        for pod in self.store.scan(Pod.KIND):  # read-only accounting scan
-            if not pod.node_name or pod.status.phase in terminal:
+        Succeeded/Failed pods release their requests). INCREMENTAL: an
+        informer-style cursor over the store's event log adjusts the
+        accounting per pod transition instead of re-scanning every pod per
+        scheduler reconcile (O(pods) per solve round at stress scale);
+        falls back to a full rebuild past a compaction horizon. Returned
+        dict is the live cache — callers read, never mutate."""
+        from .store import StoreError
+
+        try:
+            events = self.store.events_since(self._usage_cursor)
+        except StoreError:
+            events = None  # compacted past the cursor: rebuild below
+        if events is None or self._usage is None:
+            self._usage_cursor = self.store.last_seq
+            self._usage = out = {}
+            for pod in self.store.scan(Pod.KIND):
+                if self._counted(pod):
+                    per_node = out.setdefault(pod.node_name, {})
+                    for res, amount in self._pod_requests(pod).items():
+                        per_node[res] = per_node.get(res, 0.0) + amount
+            return self._usage
+        if events:
+            self._usage_cursor = events[-1].seq
+        out = self._usage
+        for ev in events:
+            if ev.kind != Pod.KIND:
                 continue
-            if pod.metadata.deletion_timestamp is not None:
+            was = (
+                ev.type != "Added"
+                and ev.old is not None
+                and self._counted(ev.old)
+            )
+            if ev.type == "Deleted":
+                now_ = False
+                # Deleted events carry no old; the final snapshot IS it
+                was = self._counted(ev.obj)
+            else:
+                now_ = self._counted(ev.obj)
+            if was == now_:
                 continue
+            pod = ev.obj if now_ else (ev.old if ev.old is not None else ev.obj)
             per_node = out.setdefault(pod.node_name, {})
-            for res, amount in pod.spec.total_requests().items():
-                per_node[res] = per_node.get(res, 0.0) + amount
+            sign = 1.0 if now_ else -1.0
+            for res, amount in self._pod_requests(pod).items():
+                per_node[res] = per_node.get(res, 0.0) + sign * amount
         return out
 
     def live_topology(self) -> ClusterTopology:

@@ -364,3 +364,55 @@ class TestEventCompaction:
         # second settle produced fresh events, so the compact drops them
         assert h.manager.compact_processed_events() > 0
         assert len(h.store._events) == 0
+
+
+def test_incremental_usage_matches_full_scan():
+    """usage() is maintained incrementally off the watch log; after
+    arbitrary churn (binds, failures, deletes, compaction-forced relist)
+    it must match a from-scratch accounting scan."""
+    from grove_tpu.api.types import Pod, PodPhase
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+    from test_e2e_basic import clique, simple_pcs
+
+    def scratch(cluster):
+        out = {}
+        for pod in cluster.store.scan(Pod.KIND):
+            if not cluster._counted(pod):
+                continue
+            per_node = out.setdefault(pod.node_name, {})
+            for res, amount in pod.spec.total_requests().items():
+                per_node[res] = per_node.get(res, 0.0) + amount
+        return out
+
+    def assert_match(cluster):
+        inc, full = cluster.usage(), scratch(cluster)
+        nodes = set(inc) | set(full)
+        for n in nodes:
+            a, b = inc.get(n, {}), full.get(n, {})
+            for res in set(a) | set(b):
+                assert a.get(res, 0.0) == pytest.approx(
+                    b.get(res, 0.0), abs=1e-9
+                ), (n, res)
+
+    h = Harness(nodes=make_nodes(8))
+    h.apply(simple_pcs(cliques=[clique("w", replicas=4)]))
+    h.settle()
+    assert_match(h.cluster)
+    assert h.cluster.usage(), "bound pods must be accounted"
+    # failure churn: eviction releases capacity, replacement re-binds
+    h.kubelet.evict_pod("default", "simple1-0-w-0")
+    h.settle()
+    assert_match(h.cluster)
+    # direct delete
+    h.store.delete(Pod.KIND, "default", "simple1-0-w-1")
+    h.settle()
+    assert_match(h.cluster)
+    # compaction pushes the cursor past the horizon: rebuild path
+    h.manager.compact_processed_events()
+    h.store.compact_events(h.store.last_seq)
+    assert_match(h.cluster)
+    # and the cache keeps tracking after the rebuild
+    h.apply(simple_pcs(name="second", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert_match(h.cluster)
