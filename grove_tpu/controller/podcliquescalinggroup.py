@@ -226,6 +226,9 @@ class PCSGReconciler:
         ]
 
     def _reconcile_delete(self, pcsg: PodCliqueScalingGroup) -> Result:
+        self._rollout_active.discard(
+            (pcsg.metadata.namespace, pcsg.metadata.name)
+        )
         ns = pcsg.metadata.namespace
         for pclq in self._owned_pclqs(pcsg):
             if pclq.metadata.deletion_timestamp is None:
