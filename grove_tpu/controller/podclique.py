@@ -191,6 +191,10 @@ class PodCliqueReconciler:
 
     def _reconcile_delete(self, pclq: PodClique) -> Result:
         ns = pclq.metadata.namespace
+        # hole-filled names recur after scale-in/out: a stale rollout
+        # entry would misclassify the successor's readiness churn
+        self._rollout_active.discard((ns, pclq.metadata.name))
+        self._pods_dirty.discard((ns, pclq.metadata.name))
         for pod in self._owned_pods(pclq):
             if pod.metadata.deletion_timestamp is None:
                 self.store.delete(Pod.KIND, ns, pod.metadata.name)
