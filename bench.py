@@ -211,9 +211,9 @@ def main() -> int:
     # constraint groups + preferred levels — the native repair must take
     # it (0 fallbacks) at full speed.
     grouped_gangs = make_gangs(args.gangs, grouped=True)
+    mk_engine(**{}).solve(grouped_gangs)  # warm-up (new jit shapes possible)
     g_registry = MetricsRegistry()
     g_engine = mk_engine(metrics=g_registry)
-    g_engine.solve(grouped_gangs)  # warm-up (new jit shapes possible)
     g_placed = 0
     g_iters = max(3, args.iters // 3)
     for _ in range(g_iters):

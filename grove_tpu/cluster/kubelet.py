@@ -75,6 +75,17 @@ class SimKubelet:
         }
         for pod in self.store.scan(Pod.KIND):
             self._observe_pod(pod)
+            # the Node Deleted events may be behind the compaction
+            # horizon: pods bound to a now-absent node must still be
+            # swept to Failed, so their nodes re-enter the lost set
+            if (
+                pod.node_name
+                and pod.node_name not in self._nodes
+                and pod.metadata.deletion_timestamp is None
+                and pod.status.phase not in (PodPhase.FAILED,
+                                             PodPhase.SUCCEEDED)
+            ):
+                self._nodes_lost.add(pod.node_name)
 
     def _observe_pod(self, pod: Pod) -> None:
         key = (pod.metadata.namespace, pod.metadata.name)

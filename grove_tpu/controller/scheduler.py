@@ -348,20 +348,20 @@ class GangScheduler:
         SKIPS gangs without a usable reservation instead of stopping at
         the first one (advisor r3: one high-priority unreserved gang used
         to silently disable reuse for the whole backlog). No priority
-        inversion: before committing a reservation, the remaining
-        schedulable free capacity minus this gang's demand must still
-        cover the AGGREGATE demand of every higher-priority gang skipped
-        so far — a reserved gang never consumes capacity a skipped gang
-        may need."""
+        inversion — EXACTLY: when strictly-higher-priority gangs were
+        skipped, a reservation only commits after a trial placement shows
+        every one of them still places on the residual capacity (an
+        aggregate-capacity guard misses per-node fragmentation — the same
+        flaw the preemption trial fixed). More than TRIAL_CAP higher
+        skipped gangs falls back to not committing (conservative)."""
         from ..solver.fit import place_gang_in_domain, placement_score_for_nodes
         from ..solver.result import GangPlacement
-        from ..solver.serial import gang_sort_key
+        from ..solver.serial import _place_one, gang_sort_key
 
+        TRIAL_CAP = 8
         order = sorted(solver_gangs, key=gang_sort_key)
         node_index = snapshot.node_index
-        sched = snapshot.schedulable
-        free_agg = free[sched].sum(axis=0)
-        skipped_demand = np.zeros_like(free_agg)
+        sched_nodes = np.flatnonzero(snapshot.schedulable)
         remaining: list = []
         for sg in order:
             pg = by_name.get(sg.name)
@@ -371,21 +371,8 @@ class GangScheduler:
                 if ref is not None and not sg.unschedulable_reason
                 else None
             )
-
-            def skip(sg=sg):
-                remaining.append(sg)
-                return sg.total_demand()
-
             if not reserved:
-                skipped_demand = skipped_demand + skip()
-                continue
-            gang_total = sg.total_demand()
-            if np.any(
-                free_agg - gang_total + 1e-9 < skipped_demand
-            ):
-                # committing here could starve a skipped higher-priority
-                # gang: leave this one to the general solve as well
-                skipped_demand = skipped_demand + skip()
+                remaining.append(sg)
                 continue
             idx = np.asarray(
                 [
@@ -403,7 +390,29 @@ class GangScheduler:
             if level >= 0 and len(idx):
                 ids = snapshot.domain_ids[level, idx]
                 if not (ids == ids[0]).all():
-                    skipped_demand = skipped_demand + skip()
+                    remaining.append(sg)
+                    continue
+            higher = [
+                g for g in remaining if g.priority > sg.priority
+            ]
+            if higher:
+                if len(higher) > TRIAL_CAP:
+                    remaining.append(sg)  # unverifiable cheaply: general
+                    continue
+                # exact no-inversion check: commit only if the skipped
+                # higher-priority gangs all still place AFTER this
+                # reservation, on a trial copy of free
+                trial = free.copy()
+                if (
+                    not len(idx)
+                    or place_gang_in_domain(sg, snapshot, trial, idx, level)
+                    is None
+                    or any(
+                        _place_one(g, snapshot, trial, sched_nodes) is None
+                        for g in higher
+                    )
+                ):
+                    remaining.append(sg)
                     continue
             assign = (
                 place_gang_in_domain(sg, snapshot, free, idx, level)
@@ -412,9 +421,8 @@ class GangScheduler:
             )
             if assign is None:
                 # reservation gone/too small: general solve handles it
-                skipped_demand = skipped_demand + skip()
+                remaining.append(sg)
                 continue
-            free_agg = free_agg - gang_total
             self._bind(
                 pg,
                 GangPlacement(

@@ -64,14 +64,20 @@ class RemotePlacementEngine:
         #: forever
         self.timeout_seconds = timeout_seconds
         self._root_ca = root_ca
-        self._bind_channel()
         self.epoch = snapshot_epoch(snapshot)
         self._register()
 
-    def _bind_channel(self) -> None:
-        channel = _channel_for(self.address, self._root_ca)
-        self._sync = channel.unary_unary(f"/{SERVICE}/Sync")
-        self._solve = channel.unary_unary(f"/{SERVICE}/Solve")
+    # Stubs are resolved PER CALL through the shared-channel cache: after
+    # a _rechannel() every engine on this address (not just the one that
+    # noticed the outage) transparently picks up the fresh channel on its
+    # next call — cached stub objects would pin the closed transport.
+    def _sync(self, request: bytes, **kw) -> bytes:
+        ch = _channel_for(self.address, self._root_ca)
+        return ch.unary_unary(f"/{SERVICE}/Sync")(request, **kw)
+
+    def _solve(self, request: bytes, **kw) -> bytes:
+        ch = _channel_for(self.address, self._root_ca)
+        return ch.unary_unary(f"/{SERVICE}/Solve")(request, **kw)
 
     def _rechannel(self) -> None:
         """Tear down and rebuild the shared channel for this address —
@@ -83,7 +89,6 @@ class RemotePlacementEngine:
         ch = _channels.pop(key, None)
         if ch is not None:
             ch.close()
-        self._bind_channel()
 
     def _register(self) -> None:
         server_epoch = self._sync(
@@ -105,19 +110,22 @@ class RemotePlacementEngine:
         try:
             response = self._solve(request, timeout=self.timeout_seconds,
                                    wait_for_ready=True)
-        except grpc.RpcError as err:
-            if err.code() == grpc.StatusCode.FAILED_PRECONDITION:
+        except (grpc.RpcError, ValueError) as err:
+            code = err.code() if isinstance(err, grpc.RpcError) else None
+            if code == grpc.StatusCode.FAILED_PRECONDITION:
                 # the service restarted (or evicted this epoch): re-Sync
                 # and retry once — without this the scheduler's cached
                 # engine would fail every reconcile until the topology
                 # changed
                 self._register()
-            elif err.code() in (
+            elif code in (
                 grpc.StatusCode.UNAVAILABLE,
                 grpc.StatusCode.DEADLINE_EXCEEDED,
-            ):
-                # transport-level outage — e.g. the server hot-restarted
-                # its listener for a cert rotation: rebuild the channel
+            ) or isinstance(err, ValueError):
+                # transport-level outage — the server hot-restarted its
+                # listener for a cert rotation, or a sibling engine
+                # already tore the shared channel down (grpc raises
+                # ValueError on a closed channel): rebuild the channel
                 # (fresh handshake against the renewed cert), re-Sync,
                 # retry once
                 self._rechannel()

@@ -277,8 +277,14 @@ def main() -> int:  # pragma: no cover - thin CLI
                     print("server certificate renewed", flush=True)
 
         threading.Thread(target=check_loop, daemon=True).start()
-        rserver._server.wait_for_termination()
-        return 0
+        # wait across hot-restarts: a rotation stops the OLD server and
+        # installs a new one; only an externally-stopped CURRENT server
+        # (still the same object after the wait returns) means shutdown
+        while True:
+            server = rserver._server
+            server.wait_for_termination()
+            if rserver._server is server:
+                return 0
     server = serve(args.address)
     print(f"placement service listening on {args.address} (plaintext)",
           flush=True)

@@ -127,15 +127,19 @@ def load_or_create_ca(directory):
         )
         return ca_cert, ca_key
     ca_cert, ca_key = make_ca()
-    cert_path.write_bytes(_pem_cert(ca_cert))
-    # the key file is BORN 0600 (O_EXCL): a write-then-chmod leaves a
-    # umask-dependent window where a crash persists the CA key readable
-    # (advisor r3)
+    # a half-written dir (crash between the two writes, or an operator
+    # forcing a new CA by deleting one file) regenerates BOTH files; the
+    # key is written FIRST so cert+key existing together implies a
+    # persisted key, and it is BORN 0600 (O_EXCL after removing any stale
+    # file) — a write-then-chmod leaves a umask-dependent window where a
+    # crash persists the CA key readable (advisor r3)
+    key_path.unlink(missing_ok=True)
     fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
     try:
         os.write(fd, _pem_key(ca_key))
     finally:
         os.close(fd)
+    cert_path.write_bytes(_pem_cert(ca_cert))
     return ca_cert, ca_key
 
 

@@ -371,6 +371,65 @@ class TestRR_ReservationReuse:
         assert sorted(g.name for g in remaining2) == ["hi", "lo"]
         np.testing.assert_allclose(free2, before2)
 
+    def test_rr4_reservation_guard_sees_fragmentation(self):
+        """The no-inversion guard is an EXACT trial placement, not
+        aggregate math: a reserved gang whose commit would take the only
+        node a skipped higher-priority gang fits on must fall through to
+        the general solve, even when aggregate capacity looks ample."""
+        import numpy as np
+
+        from grove_tpu.api.meta import NamespacedName, ObjectMeta
+        from grove_tpu.api.podgang import PodGang, PodGangSpec
+        from grove_tpu.api.types import Node
+        from grove_tpu.solver import SolverGang
+
+        # node-0 has 4 cpu; nodes 1-3 have 1 cpu (aggregate 7)
+        nodes = []
+        for i, cpu in enumerate((4.0, 1.0, 1.0, 1.0)):
+            nodes.append(Node(
+                metadata=ObjectMeta(name=f"node-{i}"),
+                allocatable={"cpu": cpu, "memory": 8.0, "tpu": 0.0},
+            ))
+        h = Harness(nodes=nodes)
+        sched = h.scheduler
+        snapshot = h.cluster.topology_snapshot()
+        free = snapshot.free.copy()
+        before = free.copy()
+
+        def sg(name, priority, cpu, pods=1):
+            return SolverGang(
+                name=name, namespace="default",
+                demand=np.tile(
+                    np.asarray([[cpu, 0.0, 0.0]], np.float32), (pods, 1)
+                ),
+                pod_names=[f"{name}-p{i}" for i in range(pods)],
+                group_ids=np.zeros(pods, np.int32), group_names=["g0"],
+                group_required_level=np.array([-1], np.int32),
+                group_preferred_level=np.array([-1], np.int32),
+                priority=priority,
+            )
+
+        def pg(name, ref=None):
+            g = PodGang(metadata=ObjectMeta(name=name, namespace="default"))
+            if ref:
+                g.spec = PodGangSpec(reuse_reservation_ref=NamespacedName(
+                    namespace="default", name=ref))
+            return h.store.create(g)
+
+        # lo's reservation (4 pods on node-0) would consume the ONLY node
+        # hi's 3-cpu pod fits on; aggregate 7 - 4 >= 3 lies
+        sched._reservations[("default", "lo")] = ("node-0",)
+        by_name = {
+            "hi": pg("hi"),
+            "lo": pg("lo", ref="lo"),
+        }
+        remaining = sched._try_reserved(
+            [sg("hi", 10.0, cpu=3.0), sg("lo", 0.0, cpu=1.0, pods=4)],
+            by_name, snapshot, free,
+        )
+        assert sorted(g.name for g in remaining) == ["hi", "lo"]
+        np.testing.assert_allclose(free, before)
+
 
 class TestOR_OperatorRestart:
     """Checkpoint/resume analog (SURVEY §5): all orchestration progress
