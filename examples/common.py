@@ -49,8 +49,13 @@ def pcs(name: str, template: PodCliqueSetTemplateSpec,
                                               template=template))
 
 
-def run(workload: PodCliqueSet, nodes: int = 32) -> Harness:
-    h = Harness(nodes=make_nodes(nodes, racks_per_block=4, hosts_per_rack=4))
+def run(workload: PodCliqueSet, nodes: int = 32, **harness_kwargs) -> Harness:
+    """harness_kwargs pass through (e.g. engine_cls for the remote
+    placement-service engine — see operations_tour.py)."""
+    h = Harness(
+        nodes=make_nodes(nodes, racks_per_block=4, hosts_per_rack=4),
+        **harness_kwargs,
+    )
     h.apply(workload)
     h.settle()
     return h

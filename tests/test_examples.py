@@ -32,3 +32,14 @@ def test_example_converges(module, pods, gangs):
     from grove_tpu.api.podgang import PodGangPhase
 
     assert all(g.status.phase == PodGangPhase.RUNNING for g in gang_objs)
+
+
+def test_operations_tour_runs(capsys):
+    """The ops example end to end: service boundary, TLS rotation,
+    introspection surfaces."""
+    import operations_tour
+
+    operations_tour.main()
+    out = capsys.readouterr().out
+    assert "service Debug probe" in out
+    assert "ROTATED listener (rotations=1)" in out
