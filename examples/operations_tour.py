@@ -78,7 +78,13 @@ def main() -> None:
 
         # 4. live certificate rotation: re-issue under the same CA and
         # hot-restart the listener; the next solve reconnects on its own
-        rotator.renew_before = rotator.not_valid_after - rotator._now_fn()
+        import datetime
+
+        # widen the renewal window past the cert's whole validity: renewal
+        # is immediately due (the public knob; tests inject now_fn instead)
+        rotator.renew_before = datetime.timedelta(
+            days=rotator.valid_days + 1
+        )
         assert server.maybe_rotate(), "rotation was due"
         harness.apply(pcs("after-rotation", PodCliqueSetTemplateSpec(
             cliques=[clique("w", replicas=2, cpu=0.5)],

@@ -395,25 +395,9 @@ class GangScheduler:
             higher = [
                 g for g in remaining if g.priority > sg.priority
             ]
-            if higher:
-                if len(higher) > TRIAL_CAP:
-                    remaining.append(sg)  # unverifiable cheaply: general
-                    continue
-                # exact no-inversion check: commit only if the skipped
-                # higher-priority gangs all still place AFTER this
-                # reservation, on a trial copy of free
-                trial = free.copy()
-                if (
-                    not len(idx)
-                    or place_gang_in_domain(sg, snapshot, trial, idx, level)
-                    is None
-                    or any(
-                        _place_one(g, snapshot, trial, sched_nodes) is None
-                        for g in higher
-                    )
-                ):
-                    remaining.append(sg)
-                    continue
+            if higher and len(higher) > TRIAL_CAP:
+                remaining.append(sg)  # unverifiable cheaply: general
+                continue
             assign = (
                 place_gang_in_domain(sg, snapshot, free, idx, level)
                 if len(idx)
@@ -423,6 +407,20 @@ class GangScheduler:
                 # reservation gone/too small: general solve handles it
                 remaining.append(sg)
                 continue
+            if higher:
+                # exact no-inversion check: commit only if the skipped
+                # higher-priority gangs all still place AFTER this
+                # reservation. The placement is already committed into
+                # `free` (one search, not two); trial the higher gangs on
+                # a copy and roll the commitment back on failure.
+                trial = free.copy()
+                if any(
+                    _place_one(g, snapshot, trial, sched_nodes) is None
+                    for g in higher
+                ):
+                    np.add.at(free, assign, sg.demand)
+                    remaining.append(sg)
+                    continue
             self._bind(
                 pg,
                 GangPlacement(

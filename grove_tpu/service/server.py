@@ -203,6 +203,8 @@ class RotatingTLSServer:
     def __init__(self, address: str, rotator,
                  service: PlacementService | None = None,
                  max_workers: int = 4):
+        import threading as _threading
+
         self.address = address
         self.rotator = rotator
         #: ONE engine-cache shared across restarts: a cert rotation must
@@ -210,6 +212,10 @@ class RotatingTLSServer:
         self.service = service or PlacementService()
         self.max_workers = max_workers
         self._server = None
+        #: set ONLY by stop(): distinguishes deliberate shutdown from a
+        #: rotation's hot restart (checking server identity instead races
+        #: the rotator thread's reassignment)
+        self._stopped = _threading.Event()
 
     def start(self) -> None:
         self._server = serve(
@@ -227,7 +233,20 @@ class RotatingTLSServer:
         self.start()
         return True
 
+    def wait_for_termination(self) -> None:
+        """Block until stop() — across any number of cert-rotation hot
+        restarts (each replaces the underlying grpc server)."""
+        while not self._stopped.is_set():
+            server = self._server
+            if server is None:
+                self._stopped.wait(0.1)
+                continue
+            server.wait_for_termination()
+            # a rotation stopped this server; loop onto the replacement
+            self._stopped.wait(0.05)
+
     def stop(self, grace=None) -> None:
+        self._stopped.set()
         if self._server is not None:
             self._server.stop(grace=grace)
 
@@ -277,14 +296,8 @@ def main() -> int:  # pragma: no cover - thin CLI
                     print("server certificate renewed", flush=True)
 
         threading.Thread(target=check_loop, daemon=True).start()
-        # wait across hot-restarts: a rotation stops the OLD server and
-        # installs a new one; only an externally-stopped CURRENT server
-        # (still the same object after the wait returns) means shutdown
-        while True:
-            server = rserver._server
-            server.wait_for_termination()
-            if rserver._server is server:
-                return 0
+        rserver.wait_for_termination()  # survives rotation hot-restarts
+        return 0
     server = serve(args.address)
     print(f"placement service listening on {args.address} (plaintext)",
           flush=True)

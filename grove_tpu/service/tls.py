@@ -133,6 +133,7 @@ def load_or_create_ca(directory):
     # persisted key, and it is BORN 0600 (O_EXCL after removing any stale
     # file) — a write-then-chmod leaves a umask-dependent window where a
     # crash persists the CA key readable (advisor r3)
+    cert_path.unlink(missing_ok=True)
     key_path.unlink(missing_ok=True)
     fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
     try:

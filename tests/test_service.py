@@ -370,8 +370,10 @@ def test_console_script_deployment(tmp_path):
         assert mode == 0o600
         ca_pem = (tls_dir / "ca.pem").read_bytes()
         snap = cluster()
+        # generous deadline: the spawned server cold-compiles its jit on
+        # the first solve, and the shared dev tunnel can be congested
         eng = RemotePlacementEngine(snap, address, root_ca=ca_pem,
-                                    timeout_seconds=30.0)
+                                    timeout_seconds=120.0)
         assert eng.solve([gang("a", pods=1, cpu=1.0)]).num_placed == 1
         # health probe per the docs: Debug answers and shows the epoch
         creds = grpc.ssl_channel_credentials(root_certificates=ca_pem)
