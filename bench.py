@@ -434,10 +434,16 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
     h.apply(pcs("cpwarm"))
     h.settle()
     cold = time.perf_counter() - t0
+    solve_h = h.cluster.metrics.histogram("grove_solver_backlog_bind_seconds")
+    solve_before = solve_h.sum
     t0 = time.perf_counter()
     h.apply(pcs("cpbench"))
     h.settle()
     warm = time.perf_counter() - t0
+    # solver-vs-controllers attribution: how much of the warm settle was
+    # accelerator solve wall (the rest is the host-side control plane —
+    # store writes, watch fan-out, reconciles; see BASELINE.md)
+    solve_wall = solve_h.sum - solve_before
     bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
     if bound != 2 * replicas * 8:  # not assert: must survive python -O
         raise RuntimeError(
@@ -449,6 +455,8 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
         "controlplane_settle_seconds": round(warm, 2),
         "controlplane_cold_settle_seconds": round(cold, 2),
         "controlplane_gangs_per_sec": round(replicas / warm, 1),
+        "controlplane_solve_seconds": round(solve_wall, 3),
+        "controlplane_host_seconds": round(warm - solve_wall, 3),
     }
 
 
