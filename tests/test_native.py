@@ -255,3 +255,21 @@ class TestNativeGroupedParity:
             _assert_identical(
                 solve_serial_native(snap, gangs), solve_serial(snap, gangs)
             )
+
+
+def test_multiple_constraint_groups_parity():
+    """Two disjoint constraint groups in one gang (e.g. prefill-pair +
+    decode-pair co-location islands) place identically to fit.py."""
+    snap = cluster(blocks=2, racks=3, hosts=4, cpu=10.0)
+    gangs = [
+        grouped_gang(
+            "multi", [2, 2, 2, 2],
+            cg=[([0, 1], 0, 1), ([2, 3], 0, -1)],
+            group_req=[1, 1, 1, 1],
+            cpu=2.0,
+        ),
+        grouped_gang("bg", [3], cpu=1.0),
+    ]
+    _assert_identical(
+        solve_serial_native(snap, gangs), solve_serial(snap, gangs)
+    )
