@@ -27,7 +27,6 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-from ..api.meta import ObjectMeta
 from .clock import SimClock
 
 

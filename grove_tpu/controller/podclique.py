@@ -29,7 +29,7 @@ from ..api.types import (
     PodCliqueSet,
     PodPhase,
 )
-from ..cluster.store import Event, ObjectStore, _shallow, clone
+from ..cluster.store import Event, ObjectStore, _shallow
 from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
 from .concurrency import run_with_slow_start
 from ..observability.events import EventRecorder, REASON_CREATE_SUCCESSFUL
