@@ -203,8 +203,6 @@ class RotatingTLSServer:
     def __init__(self, address: str, rotator,
                  service: PlacementService | None = None,
                  max_workers: int = 4):
-        import threading as _threading
-
         self.address = address
         self.rotator = rotator
         #: ONE engine-cache shared across restarts: a cert rotation must
@@ -215,7 +213,11 @@ class RotatingTLSServer:
         #: set ONLY by stop(): distinguishes deliberate shutdown from a
         #: rotation's hot restart (checking server identity instead races
         #: the rotator thread's reassignment)
-        self._stopped = _threading.Event()
+        self._stopped = threading.Event()
+        #: serializes the stop/start pair against a concurrent stop(), so
+        #: a rotation in flight can never re-bind a listener AFTER
+        #: shutdown (a leaked server nothing would ever stop)
+        self._lifecycle = threading.Lock()
 
     def start(self) -> None:
         self._server = serve(
@@ -227,10 +229,13 @@ class RotatingTLSServer:
         """Renew + restart the listener when the rotator says so."""
         if not self.rotator.maybe_renew():
             return False
-        old = self._server
-        if old is not None:
-            old.stop(grace=1.0)
-        self.start()
+        with self._lifecycle:
+            if self._stopped.is_set():
+                return False  # shut down mid-renewal: do not re-bind
+            old = self._server
+            if old is not None:
+                old.stop(grace=1.0)
+            self.start()
         return True
 
     def wait_for_termination(self) -> None:
@@ -247,8 +252,9 @@ class RotatingTLSServer:
 
     def stop(self, grace=None) -> None:
         self._stopped.set()
-        if self._server is not None:
-            self._server.stop(grace=grace)
+        with self._lifecycle:
+            if self._server is not None:
+                self._server.stop(grace=grace)
 
 
 def main() -> int:  # pragma: no cover - thin CLI
