@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from .meta import ObjectMeta
 
 
-@dataclass
+@dataclass(slots=True)
 class Service:
     """Headless service per PCS replica (components/service/service.go:119-204)."""
 
@@ -28,14 +28,14 @@ class Service:
     KIND = "Service"
 
 
-@dataclass
+@dataclass(slots=True)
 class ServiceAccount:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
 
     KIND = "ServiceAccount"
 
 
-@dataclass
+@dataclass(slots=True)
 class Role:
     """Pods list/watch only (components/role/)."""
 
@@ -45,7 +45,7 @@ class Role:
     KIND = "Role"
 
 
-@dataclass
+@dataclass(slots=True)
 class RoleBinding:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     role_name: str = ""
@@ -54,7 +54,7 @@ class RoleBinding:
     KIND = "RoleBinding"
 
 
-@dataclass
+@dataclass(slots=True)
 class Secret:
     """Service-account token secret for the startup-barrier watcher
     (components/satokensecret/)."""
@@ -66,7 +66,7 @@ class Secret:
     KIND = "Secret"
 
 
-@dataclass
+@dataclass(slots=True)
 class PriorityClass:
     """scheduling.k8s.io/v1 PriorityClass equivalent. PodGang's
     PriorityClassName (podgang.go:62-64) is an opaque reference to one of
@@ -81,7 +81,7 @@ class PriorityClass:
     KIND = "PriorityClass"
 
 
-@dataclass
+@dataclass(slots=True)
 class HPASpec:
     target_kind: str = ""     # PodClique | PodCliqueScalingGroup
     target_name: str = ""
@@ -91,14 +91,14 @@ class HPASpec:
     target_utilization: float = 0.8
 
 
-@dataclass
+@dataclass(slots=True)
 class HPAStatus:
     current_replicas: int = 0
     desired_replicas: int = 0
     last_scale_time: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class HorizontalPodAutoscaler:
     """autoscaling/v2 HPA equivalent (components/hpa/hpa.go)."""
 

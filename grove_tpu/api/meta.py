@@ -20,7 +20,7 @@ def new_uid() -> str:
     return f"uid-{next(_uid_counter)}"
 
 
-@dataclass
+@dataclass(slots=True)
 class OwnerReference:
     """Reference from a child object to its controlling owner."""
 
@@ -30,7 +30,7 @@ class OwnerReference:
     controller: bool = True
 
 
-@dataclass
+@dataclass(slots=True)
 class ObjectMeta:
     """Subset of k8s ObjectMeta the framework needs.
 
@@ -51,7 +51,7 @@ class ObjectMeta:
     owner_references: list[OwnerReference] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class Condition:
     """Mirror of metav1.Condition semantics."""
 

@@ -19,7 +19,7 @@ from typing import Optional
 from .meta import Condition, NamespacedName, ObjectMeta
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyPackConstraint:
     """Pack constraint by node-label *key* (podgang.go:102-118).
 
@@ -33,12 +33,12 @@ class TopologyPackConstraint:
     preferred: Optional[str] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyConstraint:
     pack_constraint: Optional[TopologyPackConstraint] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodGroup:
     """A set of pods sharing one PodTemplateSpec (podgang.go:76-90)."""
 
@@ -50,7 +50,7 @@ class PodGroup:
     topology_constraint: Optional[TopologyConstraint] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyConstraintGroupConfig:
     """Constraint over a strict subset of PodGroups (podgang.go:121-132) —
     used to express PCSG co-location inside a base PodGang."""
@@ -60,7 +60,7 @@ class TopologyConstraintGroupConfig:
     topology_constraint: Optional[TopologyConstraint] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodGangSpec:
     """podgang.go:51-73."""
 
@@ -92,7 +92,7 @@ class PodGangConditionType(str, enum.Enum):
     DISRUPTION_TARGET = "DisruptionTarget"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodGangStatus:
     """podgang.go:171-181."""
 
@@ -103,7 +103,7 @@ class PodGangStatus:
     placement_score: Optional[float] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodGang:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodGangSpec = field(default_factory=PodGangSpec)

@@ -27,7 +27,7 @@ from .meta import Condition, ObjectMeta
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Container:
     """One container. resources maps resource name -> requested quantity
     (e.g. {"cpu": 4.0, "memory": 8e9, "tpu": 4})."""
@@ -39,7 +39,7 @@ class Container:
     command: list[str] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class PodSpec:
     """Subset of corev1.PodSpec the framework schedules on."""
 
@@ -73,7 +73,7 @@ class PodPhase(str, enum.Enum):
     FAILED = "Failed"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodStatus:
     phase: PodPhase = PodPhase.PENDING
     ready: bool = False
@@ -86,7 +86,7 @@ class PodStatus:
     restart_count: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class Pod:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
@@ -102,7 +102,7 @@ class Pod:
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyPackConstraintSpec:
     """User-facing pack constraint, by topology *domain name* (e.g. "rack").
 
@@ -116,7 +116,7 @@ class TopologyPackConstraintSpec:
     preferred: Optional[str] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyConstraintSpec:
     pack_constraint: Optional[TopologyPackConstraintSpec] = None
 
@@ -126,7 +126,7 @@ class TopologyConstraintSpec:
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class AutoScalingConfig:
     """Per-clique / per-scaling-group HPA config
     (reference: podclique.go:82-101)."""
@@ -151,7 +151,7 @@ class CliqueStartupType(str, enum.Enum):
     EXPLICIT = "CliqueStartupTypeExplicit"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueSpec:
     """reference: podclique.go:54-79."""
 
@@ -168,14 +168,14 @@ class PodCliqueSpec:
     topology_constraint: Optional[TopologyConstraintSpec] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueRollingUpdateProgress:
     updated_pods: list[str] = field(default_factory=list)
     current_pod: Optional[str] = None
     completed: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueStatus:
     """reference: podclique.go:104-137."""
 
@@ -195,7 +195,7 @@ class PodCliqueStatus:
     last_operation: Optional["LastOperation"] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodClique:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodCliqueSpec = field(default_factory=PodCliqueSpec)
@@ -204,7 +204,7 @@ class PodClique:
     KIND = "PodClique"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueTemplateSpec:
     """Named clique template inside a PodCliqueSet."""
 
@@ -219,7 +219,7 @@ class PodCliqueTemplateSpec:
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueScalingGroupConfig:
     """Template-side scaling group config (reference: podcliqueset.go:203)."""
 
@@ -231,7 +231,7 @@ class PodCliqueScalingGroupConfig:
     topology_constraint: Optional[TopologyConstraintSpec] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueScalingGroupSpec:
     """reference: scalinggroup.go:51-71."""
 
@@ -241,7 +241,7 @@ class PodCliqueScalingGroupSpec:
     topology_constraint: Optional[TopologyConstraintSpec] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PCSGRollingUpdateProgress:
     current_replica_index: Optional[int] = None
     updated_replica_indices: list[int] = field(default_factory=list)
@@ -251,7 +251,7 @@ class PCSGRollingUpdateProgress:
     target_generation_hash: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueScalingGroupStatus:
     """reference: scalinggroup.go:74-103."""
 
@@ -269,7 +269,7 @@ class PodCliqueScalingGroupStatus:
     last_operation: Optional["LastOperation"] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueScalingGroup:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodCliqueScalingGroupSpec = field(default_factory=PodCliqueScalingGroupSpec)
@@ -283,12 +283,12 @@ class PodCliqueScalingGroup:
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class HeadlessServiceConfig:
     publish_not_ready_addresses: bool = True
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueSetTemplateSpec:
     """reference: podcliqueset.go:126."""
 
@@ -306,7 +306,7 @@ class PodCliqueSetTemplateSpec:
     scheduler_name: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueSetSpec:
     """reference: podcliqueset.go:52."""
 
@@ -314,7 +314,7 @@ class PodCliqueSetSpec:
     template: PodCliqueSetTemplateSpec = field(default_factory=PodCliqueSetTemplateSpec)
 
 
-@dataclass
+@dataclass(slots=True)
 class PCSRollingUpdateProgress:
     update_started_at: float = 0.0
     current_replica_index: Optional[int] = None
@@ -325,7 +325,7 @@ class PCSRollingUpdateProgress:
     target_generation_hash: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class LastError:
     """reference: podcliqueset.go:288-333 (GroveError surfaced to status)."""
 
@@ -334,7 +334,7 @@ class LastError:
     observed_at: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class LastOperation:
     type: str = ""  # Reconcile | Delete
     state: str = ""  # Processing | Succeeded | Error
@@ -342,7 +342,7 @@ class LastOperation:
     last_update_time: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueSetStatus:
     """reference: podcliqueset.go (status block)."""
 
@@ -358,7 +358,7 @@ class PodCliqueSetStatus:
     selector: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class PodCliqueSet:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodCliqueSetSpec = field(default_factory=PodCliqueSetSpec)
@@ -388,7 +388,7 @@ CLUSTER_TOPOLOGY_NAME = "grove-topology"
 MAX_TOPOLOGY_LEVELS = 7
 
 
-@dataclass
+@dataclass(slots=True)
 class TopologyLevel:
     """Maps a provider-agnostic domain to a node label key
     (clustertopology.go:72-87)."""
@@ -397,12 +397,12 @@ class TopologyLevel:
     key: str
 
 
-@dataclass
+@dataclass(slots=True)
 class ClusterTopologySpec:
     levels: list[TopologyLevel] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class ClusterTopology:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ClusterTopologySpec = field(default_factory=ClusterTopologySpec)
@@ -429,7 +429,7 @@ def sort_topology_levels(levels: list[TopologyLevel]) -> list[TopologyLevel]:
 # --------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Node:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     # allocatable resource name -> capacity

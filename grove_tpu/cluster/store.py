@@ -47,7 +47,7 @@ class Forbidden(StoreError):
     operator/internal/webhook/admission/pcs/authorization/)."""
 
 
-@dataclass
+@dataclass(slots=True)
 class Event:
     """Watch event. seq is a global total order (the 'resource version' of
     the event stream)."""
@@ -142,12 +142,37 @@ def clone(obj: Any) -> Any:
     return c(obj)
 
 
+#: per-class generated shallow-copiers (slots-compatible: the hot
+#: dataclasses use slots=True, which have no __dict__ to bulk-update)
+_SHALLOWERS: dict[type, Callable[[Any], Any]] = {}
+
+
+def _make_shallower(cls: type) -> Callable[[Any], Any]:
+    if dataclasses.is_dataclass(cls):
+        lines = ["def _s(o, _new=_new, _cls=_cls):", "    n = _new(_cls)"]
+        for f in dataclasses.fields(cls):
+            lines.append(f"    n.{f.name} = o.{f.name}")
+        lines.append("    return n")
+        ns = {"_new": object.__new__, "_cls": cls}
+        exec("\n".join(lines), ns)
+        fn = ns["_s"]
+    else:
+        def fn(o, _cls=cls):
+            n = object.__new__(_cls)
+            n.__dict__.update(o.__dict__)
+            return n
+    _SHALLOWERS[cls] = fn
+    return fn
+
+
 def _shallow(obj: Any) -> Any:
     """New instance sharing every field with obj (MVCC version bump:
     the caller replaces the fields that change, e.g. metadata/status)."""
-    new = object.__new__(obj.__class__)
-    new.__dict__.update(obj.__dict__)
-    return new
+    cls = obj.__class__
+    f = _SHALLOWERS.get(cls)
+    if f is None:
+        f = _make_shallower(cls)
+    return f(obj)
 
 
 def _bump_meta(meta: Any) -> Any:

@@ -27,13 +27,13 @@ from typing import Optional, Protocol
 from ..cluster.store import Event, ObjectStore, StoreError
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class Request:
     namespace: str
     name: str
 
 
-@dataclass
+@dataclass(slots=True)
 class Result:
     """Reconcile outcome. requeue_after: seconds (virtual) until the same
     request should be retried even without new events."""

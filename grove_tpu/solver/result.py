@@ -9,7 +9,7 @@ import numpy as np
 from .problem import SolverGang
 
 
-@dataclass
+@dataclass(slots=True)
 class GangPlacement:
     """All-or-nothing outcome for one gang."""
 
@@ -19,7 +19,7 @@ class GangPlacement:
     placement_score: float             # (0, 1], podgang.go:177-179
 
 
-@dataclass
+@dataclass(slots=True)
 class SolveResult:
     placed: dict[str, GangPlacement] = field(default_factory=dict)
     unplaced: dict[str, str] = field(default_factory=dict)  # gang -> reason
