@@ -434,6 +434,12 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
     h.apply(pcs("cpwarm"))
     h.settle()
     cold = time.perf_counter() - t0
+    # production process posture for the warm measurement (and for the
+    # real server, service/server.py:main): freeze the steady-state object
+    # graph, stop paying ~630 stop-the-world GC runs per settle
+    from grove_tpu.tuning import tune_gc
+
+    tune_gc()
     solve_h = h.cluster.metrics.histogram("grove_solver_backlog_bind_seconds")
     solve_before = solve_h.sum
     t0 = time.perf_counter()

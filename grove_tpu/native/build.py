@@ -1,4 +1,10 @@
-"""Lazy, cached g++ build of the native library (ctypes, no pybind11)."""
+"""Lazy, cached g++ build of the native libraries (no pybind11).
+
+One content-hashed compile-and-cache helper serves both native artifacts:
+the ctypes serial scorer (serial_scorer.cpp) and the CPython storecore
+extension (storecore.c, loaded by storecore.py). Failures always degrade
+to the pure-Python implementations — returning None, never raising.
+"""
 
 from __future__ import annotations
 
@@ -8,20 +14,46 @@ import os
 import subprocess
 import tempfile
 from pathlib import Path
-from typing import Optional
+from typing import Optional, Sequence
 
 _SRC = Path(__file__).with_name("serial_scorer.cpp")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _cache_path() -> Path:
-    src_hash = hashlib.sha1(_SRC.read_bytes()).hexdigest()[:12]
-    cache_dir = Path(
-        os.environ.get("GROVE_TPU_NATIVE_CACHE", tempfile.gettempdir())
-    ) / "grove_tpu_native"
-    cache_dir.mkdir(parents=True, exist_ok=True)
-    return cache_dir / f"serial_scorer-{src_hash}.so"
+def compile_cached(
+    src: Path, stem: str, extra_flags: Sequence[str] = ()
+) -> Optional[Path]:
+    """Compile `src` once into a content-hash-named .so; None on any
+    failure (missing toolchain, unwritable cache, compile error).
+
+    The hash covers source + flags, so editing either rebuilds. The
+    temp file is per-pid and installed with os.replace, so concurrent
+    processes racing the first build each produce a whole file and the
+    rename is atomic.
+    """
+    try:
+        h = hashlib.sha1(
+            src.read_bytes() + "\0".join(extra_flags).encode()
+        ).hexdigest()[:12]
+        cache_dir = Path(
+            os.environ.get("GROVE_TPU_NATIVE_CACHE", tempfile.gettempdir())
+        ) / "grove_tpu_native"
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        so = cache_dir / f"{stem}-{h}.so"
+        if not so.exists():
+            tmp = so.with_suffix(f".tmp{os.getpid()}.so")
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", *extra_flags,
+                 str(src), "-o", str(tmp)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, so)
+        return so
+    except (OSError, subprocess.SubprocessError):
+        return None
 
 
 def load_library() -> Optional[ctypes.CDLL]:
@@ -30,22 +62,14 @@ def load_library() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    so = _cache_path()
+    so = compile_cached(_SRC, "serial_scorer", ["-O3", "-std=c++17"])
+    if so is None:
+        return None
     try:
-        if not so.exists():
-            tmp = so.with_suffix(".tmp.so")
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 str(_SRC), "-o", str(tmp)],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-            os.replace(tmp, so)
         lib = ctypes.CDLL(str(so))
         lib.solve_serial.restype = ctypes.c_int32
         _lib = lib
-    except (OSError, subprocess.SubprocessError):
+    except OSError:
         _lib = None
     return _lib
 

@@ -1,0 +1,58 @@
+"""Lazy, cached build + load of the _grove_storecore CPython extension.
+
+Shares build.py's compile_cached helper (content-hashed cache, graceful
+None when the toolchain is missing), but loads a real extension module
+instead of a ctypes library: clone/shallow manipulate PyObjects directly,
+which a plain C ABI cannot. Consumed by cluster/store.py — see
+storecore.c for what and why.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import sys
+import sysconfig
+from pathlib import Path
+from typing import Any, Optional
+
+from .build import compile_cached
+
+_SRC = Path(__file__).with_name("storecore.c")
+_mod: Optional[Any] = None
+_tried = False
+
+
+def load_storecore() -> Optional[Any]:
+    """Compile (once) and import; None when g++ or the Python headers are
+    unavailable or the cache is unwritable — callers keep the pure-Python
+    path. Never raises."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("GROVE_TPU_NO_NATIVE_STORE"):
+        return None
+    try:
+        include = sysconfig.get_paths()["include"]
+        if not (Path(include) / "Python.h").exists():
+            return None
+        # the ABI tag keys the cache alongside the source hash: an .so
+        # built against another interpreter must never load into this one
+        tag = str(sysconfig.get_config_var("SOABI") or sys.version)
+        so = compile_cached(
+            _SRC, f"storecore-{tag}", [f"-I{include}"]
+        )
+        if so is None:
+            return None
+        loader = importlib.machinery.ExtensionFileLoader(
+            "_grove_storecore", str(so)
+        )
+        spec = importlib.util.spec_from_loader("_grove_storecore", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        _mod = mod
+    except (OSError, ImportError):
+        _mod = None
+    return _mod

@@ -269,6 +269,11 @@ def main() -> int:  # pragma: no cover - thin CLI
                     help="interval of the cert-renewal check loop "
                     "(TLS mode only)")
     args = ap.parse_args()
+    # long-lived server process: adopt the control-plane GC posture (see
+    # grove_tpu/tuning.py). Deferred to just before serving so the frozen
+    # set is the INITIALIZED graph (server, TLS machinery, engine), not
+    # the post-argparse near-empty heap.
+    from ..tuning import tune_gc
     if args.tls_dir:
         import threading
         import time as _time
@@ -302,11 +307,13 @@ def main() -> int:  # pragma: no cover - thin CLI
                     print("server certificate renewed", flush=True)
 
         threading.Thread(target=check_loop, daemon=True).start()
+        tune_gc()
         rserver.wait_for_termination()  # survives rotation hot-restarts
         return 0
     server = serve(args.address)
     print(f"placement service listening on {args.address} (plaintext)",
           flush=True)
+    tune_gc()
     server.wait_for_termination()
     return 0
 

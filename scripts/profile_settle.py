@@ -1,0 +1,84 @@
+"""Profile the warm control-plane settle at the stress config on CPU.
+
+Usage: python scripts/profile_settle.py [replicas] [nodes] [--cumtime]
+"""
+import cProfile
+import pstats
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, ".")
+from bench import bench_controlplane  # noqa: E402
+import bench as bench_mod  # noqa: E402
+
+
+def main():
+    replicas = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5000
+    sort = "cumtime" if "--cumtime" in sys.argv else "tottime"
+
+    from grove_tpu.api.types import Pod
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+
+    # reproduce bench_controlplane's warm path under the profiler
+    h = Harness(
+        nodes=make_nodes(
+            nodes, allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0}
+        )
+    )
+    pcs = None
+    # reuse bench's pcs builder via bench_controlplane internals: inline it
+    from grove_tpu.api.meta import ObjectMeta as Meta
+    from grove_tpu.api.types import (
+        Container, PodCliqueSet, PodCliqueSetSpec, PodCliqueSetTemplateSpec,
+        PodCliqueSpec, PodCliqueTemplateSpec, PodSpec,
+    )
+
+    def mk(name):
+        return PodCliqueSet(
+            metadata=Meta(name=name),
+            spec=PodCliqueSetSpec(
+                replicas=replicas,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[
+                        PodCliqueTemplateSpec(
+                            name="w",
+                            spec=PodCliqueSpec(
+                                replicas=8,
+                                pod_spec=PodSpec(
+                                    containers=[
+                                        Container(name="m", resources={"cpu": 1.0})
+                                    ]
+                                ),
+                            ),
+                        )
+                    ]
+                ),
+            ),
+        )
+
+    t0 = time.perf_counter()
+    h.apply(mk("cpwarm"))
+    h.settle()
+    print(f"cold settle: {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    pr = cProfile.Profile()
+    t0 = time.perf_counter()
+    pr.enable()
+    h.apply(mk("cpbench"))
+    h.settle()
+    pr.disable()
+    warm = time.perf_counter() - t0
+    bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
+    print(f"warm settle: {warm:.2f}s bound={bound}", file=sys.stderr)
+    st = pstats.Stats(pr, stream=sys.stderr)
+    st.sort_stats(sort).print_stats(45)
+
+
+if __name__ == "__main__":
+    main()

@@ -273,3 +273,154 @@ def test_multiple_constraint_groups_parity():
     _assert_identical(
         solve_serial_native(snap, gangs), solve_serial(snap, gangs)
     )
+
+
+# -- storecore: native clone/shallow for the object-store hot path --------
+# (VERDICT r4 #1: the per-object write path in C behind the identical
+# store API; these tests pin semantic parity with the Python cloners)
+
+
+def _sample_pod():
+    from grove_tpu.api.meta import ObjectMeta, OwnerReference
+    from grove_tpu.api.types import Container, Pod, PodSpec
+
+    return Pod(
+        metadata=ObjectMeta(
+            name="p0",
+            namespace="ns",
+            labels={"a": "b", "grove.io/x": "y"},
+            finalizers=["f1"],
+            owner_references=[OwnerReference(kind="K", name="o", uid="u1")],
+        ),
+        spec=PodSpec(
+            containers=[Container(name="c", resources={"cpu": 1.0})],
+            scheduling_gates=["g"],
+        ),
+    )
+
+
+def test_storecore_builds_and_is_active():
+    """The extension must build in this image (g++ + headers are baked
+    in); if this fails the control plane silently runs the slow path."""
+    from grove_tpu.cluster import store
+
+    assert store.NATIVE_STORE_ACTIVE
+
+
+def test_storecore_clone_parity_deep():
+    from grove_tpu.cluster import store
+
+    p = _sample_pod()
+    for clone_fn in (store.clone, store._make_cloner(type(p))):
+        c = clone_fn(p)
+        assert c is not p
+        assert c.metadata is not p.metadata
+        assert c.metadata.labels == p.metadata.labels
+        assert c.metadata.labels is not p.metadata.labels
+        assert c.metadata.owner_references[0].uid == "u1"
+        assert c.spec.containers[0].resources == {"cpu": 1.0}
+        assert c.spec.containers[0].resources is not (
+            p.spec.containers[0].resources
+        )
+        # deep independence: mutating the clone never reaches the source
+        c.metadata.labels["a"] = "mutated"
+        c.spec.containers[0].resources["cpu"] = 9.0
+        assert p.metadata.labels["a"] == "b"
+        assert p.spec.containers[0].resources["cpu"] == 1.0
+
+
+def test_storecore_shallow_shares_fields():
+    from grove_tpu.cluster import store
+
+    p = _sample_pod()
+    s = store._shallow(p)
+    assert s is not p
+    assert s.metadata is p.metadata
+    assert s.spec is p.spec
+
+
+def test_storecore_scalar_and_fallback_classes():
+    from enum import Enum
+
+    import numpy as np
+
+    from grove_tpu.api.meta import NamespacedName
+    from grove_tpu.cluster import store
+
+    class Phase(str, Enum):
+        RUNNING = "Running"
+
+    # str-subclass scalars are identity (immutable), like the Python path
+    assert store.clone(Phase.RUNNING) is Phase.RUNNING
+    # frozen non-slots dataclass falls back to the generated Python cloner
+    nn = NamespacedName("ns", "nm")
+    assert store.clone(nn) == nn
+    # exotic payloads (ndarray) fall back to deepcopy
+    arr = np.arange(4)
+    ca = store.clone(arr)
+    assert ca is not arr and (ca == arr).all()
+    # containers of mixed content
+    tree = {"k": [1, "s", {"n": None}], "t": (1.0, True)}
+    ct = store.clone(tree)
+    assert ct == tree and ct is not tree and ct["k"] is not tree["k"]
+
+
+def test_storecore_env_kill_switch(monkeypatch):
+    """GROVE_TPU_NO_NATIVE_STORE=1 must keep the pure-Python path usable
+    (bisection + toolchain-less deploys)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['GROVE_TPU_NO_NATIVE_STORE']='1';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "from grove_tpu.cluster import store;"
+        "assert not store.NATIVE_STORE_ACTIVE;"
+        "from grove_tpu.api.meta import ObjectMeta;"
+        "from grove_tpu.api.types import Container, Pod, PodSpec;"
+        "p=Pod(metadata=ObjectMeta(name='p', labels={'a': 'b'}),"
+        "      spec=PodSpec(containers=[Container(name='c')]));"
+        "c=store.clone(p);"
+        "assert c.metadata.labels == p.metadata.labels;"
+        "assert c.metadata.labels is not p.metadata.labels"
+    )
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).resolve().parents[1])
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo_root, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_storecore_deep_nesting_raises_not_crashes():
+    """A pathologically nested caller-supplied tree must surface
+    RecursionError (like the Python cloners), never a C stack overflow."""
+    from grove_tpu.cluster import store
+
+    deep: list = []
+    cur = deep
+    for _ in range(100_000):
+        nxt: list = []
+        cur.append(nxt)
+        cur = nxt
+    with pytest.raises(RecursionError):
+        store.clone(deep)
+
+
+def test_tune_gc_smoke():
+    """tune_gc adjusts thresholds and survives repeated calls; restore the
+    defaults so the rest of the suite keeps the stock posture."""
+    import gc
+
+    from grove_tpu.tuning import tune_gc
+
+    old = gc.get_threshold()
+    try:
+        tune_gc(freeze=False)
+        assert gc.get_threshold()[0] == 100_000
+        tune_gc(freeze=False, gen0_threshold=50_000)
+        assert gc.get_threshold()[0] == 50_000
+    finally:
+        gc.set_threshold(*old)
