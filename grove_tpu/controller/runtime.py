@@ -199,6 +199,32 @@ class ControllerManager:
         # full-device solve round at stress scale).
         rank = {c.name: i for i, c in enumerate(self.controllers)}
         batch.sort(key=lambda cr: rank[cr[0]])
+        # Advisory pre_round hook: a controller with work queued THIS round
+        # may begin read-only asynchronous preparation (the gang scheduler
+        # dispatches its accelerator solve here) that overlaps with the
+        # reconciles running ahead of it in the batch. Contract: pre_round
+        # must not write to the store, and the controller must re-validate
+        # whatever it prepared when its reconcile runs — earlier reconciles
+        # in the same round may invalidate it. Failures are recorded but
+        # never fail the round (reconcile does the authoritative work).
+        if batch:
+            in_batch = {cname for cname, _ in batch}
+            for c in self.controllers:
+                hook = getattr(c, "pre_round", None)
+                if hook is None or c.name not in in_batch:
+                    continue
+                try:
+                    if self.identity is not None:
+                        with self.store.impersonate(self.identity):
+                            hook()
+                    else:
+                        hook()
+                except Exception as exc:  # advisory: reconcile still runs
+                    if self.logger is not None:
+                        self.logger.error(
+                            "pre_round failed", controller=c.name,
+                            error=str(exc),
+                        )
         m = self.metrics
         if m is not None:
             # set unconditionally: an idle round must read 0, not the last

@@ -114,6 +114,12 @@ class GangScheduler:
         #: per settle was measurable at stress scale. Any PriorityClass
         #: write bumps the serial and invalidates.
         self._prio_cache: tuple[int, dict[str, float], float] | None = None
+        #: async solve prepared by pre_round: (event-log seq at dispatch,
+        #: backlog keys, PodGang copies, encoded SolverGangs,
+        #: engine.SolveDispatch — whose free0 carries the free matrix).
+        #: Consumed (or discarded as stale) by the same round's
+        #: _reconcile — see pre_round.
+        self._pending = None
 
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == PodGang.KIND:
@@ -140,6 +146,114 @@ class GangScheduler:
             # capacity/encoding shift: retry the backlog (scan finds it)
             return [_SINGLETON_REQ]
         return []
+
+    def _dispatch_unaffected(self, seq0: int) -> bool:
+        """True when every store write since seq0 is provably irrelevant
+        to a dispatched solve's inputs (gang specs, pod demand/eligibility,
+        free capacity, topology, priorities). The expected in-between
+        traffic of a bulk-apply round — scheduling-gate removals (which
+        share spec containers/selector/tolerations with the prior version
+        by identity) and PodClique/PCS status rollups — passes; anything
+        that could move capacity or change the encode rejects."""
+        try:
+            events = self.store.events_since(seq0)
+        except Exception:
+            return False  # compacted past the dispatch point
+        for ev in events:
+            k = ev.kind
+            if k == Pod.KIND:
+                old = ev.old
+                if ev.type != "Modified" or old is None:
+                    return False  # pod added/deleted: backlog/free moved
+                new = ev.obj
+                if new.node_name != old.node_name:
+                    return False  # bind/unbind: free moved
+                s, os_ = new.spec, old.spec
+                if s is not os_ and (
+                    s.containers is not os_.containers
+                    or s.node_selector is not os_.node_selector
+                    or s.tolerations is not os_.tolerations
+                ):
+                    return False  # spec change beyond a gate drop
+                if (
+                    new.status.phase != old.status.phase
+                    or new.metadata.deletion_timestamp
+                    != old.metadata.deletion_timestamp
+                ):
+                    return False  # lifecycle flip: capacity/membership
+            elif k == PodGang.KIND:
+                if ev.type != "Modified" or ev.old is None:
+                    return False
+                if ev.obj.spec is not ev.old.spec and (
+                    ev.obj.spec != ev.old.spec
+                ):
+                    return False  # gang spec changed under the dispatch
+            elif k in (
+                Node.KIND, ClusterTopology.KIND, PriorityClass.KIND
+            ):
+                return False  # capacity / encoding / priority moved
+            # every other kind (PodClique/PCS/PCSG/Service/Event/...) has
+            # no bearing on solve inputs
+        return True
+
+    def _engine_for(self, snapshot):
+        """Engine bound to the snapshot, reused while the static encoding
+        is unchanged (identity check against the cluster cache) — rebuilding
+        the domain index over 5k nodes per reconcile was measurable."""
+        if getattr(self._engine, "snapshot", None) is not snapshot:
+            self._engine = self.engine_cls(snapshot, **self._engine_kwargs)
+        return self._engine
+
+    def pre_round(self) -> None:
+        """Manager pre_round hook (runtime.run_once): when a backlog is
+        ready — or will be, once the podclique reconciles running ahead of
+        the scheduler in this round drop the scheduling gates — encode it
+        and DISPATCH the accelerator solve before those reconciles run.
+        Device compute + result transfer then overlap the round's host
+        work instead of the scheduler's reconcile blocking on the full
+        round trip. Read-only: nothing is written here.
+
+        The gate speculation mirrors podclique._remove_gates' rule
+        (referenced-in-gang pods ungate; scaled gangs wait for their base
+        to schedule), so the dispatched gang set predicts the consume-time
+        backlog exactly in the bulk-apply shape. Correctness never rests
+        on the prediction: _reconcile adopts the dispatch only if the
+        backlog keys match AND every store write since dispatch was
+        provably irrelevant to solve inputs (_dispatch_unaffected), and
+        engine.solve re-verifies gang identity + free-matrix content.
+        Any staleness falls back to a fresh synchronous solve."""
+        self._pending = None
+        seq0 = self.store.last_seq
+        backlog_keys: list[tuple[str, str]] = []
+        for gang in self.store.scan(PodGang.KIND):
+            if gang.metadata.deletion_timestamp is not None:
+                continue
+            if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+                continue
+            if self._gang_ready_to_schedule(gang, speculate_gates=True):
+                backlog_keys.append(
+                    (gang.metadata.namespace, gang.metadata.name)
+                )
+        if not backlog_keys:
+            return
+        snapshot = self.cluster.topology_snapshot()
+        engine = self._engine_for(snapshot)
+        if getattr(engine, "dispatch", None) is None:
+            return  # custom engine without async support (tests)
+        backlog = [
+            self.store.get(PodGang.KIND, ns, name)
+            for ns, name in backlog_keys
+        ]
+        encoded = encode_podgangs(
+            backlog, snapshot,
+            self.cluster.pod_demand_fn(snapshot.resource_names),
+            priority_of=self._priority_of,
+            pod_scheduling=self.cluster.pod_scheduling_fn(),
+        )
+        dispatch = engine.dispatch(encoded, free=snapshot.free.copy())
+        if dispatch is not None:
+            self._pending = (seq0, backlog_keys, backlog, encoded,
+                             dispatch)
 
     def reconcile(self, request: Request) -> Result:
         dirty, self._dirty = self._dirty, set()
@@ -186,36 +300,58 @@ class GangScheduler:
             return Result()
 
         snapshot = self.cluster.topology_snapshot()
-        if getattr(self._engine, "snapshot", None) is snapshot:
-            # unchanged static encoding (cluster snapshot cache hit):
-            # reuse the engine and its DomainSpace — rebuilding the domain
-            # index over 5k nodes per reconcile was measurable at scale
-            engine = self._engine
-        else:
-            engine = self._engine = self.engine_cls(
-                snapshot, **self._engine_kwargs
-            )
+        engine = self._engine_for(snapshot)
         free = snapshot.free.copy()
         demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
         sched_fn = self.cluster.pod_scheduling_fn()
 
         requeue: Optional[float] = None
         if backlog_keys:
-            # mutation ahead (status writes): fetch real copies
-            backlog = [
-                self.store.get(PodGang.KIND, ns, name)
-                for ns, name in backlog_keys
-            ]
-            encoded = encode_podgangs(
-                backlog, snapshot, demand_fn, priority_of=self._priority_of,
-                pod_scheduling=sched_fn,
-            )
+            pending, self._pending = self._pending, None
+            dispatch = None
+            if (
+                pending is not None
+                and pending[1] == backlog_keys
+                and self._dispatch_unaffected(pending[0])
+            ):
+                # nothing the dispatched scores depend on was written since
+                # pre_round: adopt its fetches + encode + in-flight device
+                # phase (engine.solve still verifies gang identity + free)
+                _, _, backlog, encoded, dispatch = pending
+            else:
+                # mutation ahead (status writes): fetch real copies
+                backlog = [
+                    self.store.get(PodGang.KIND, ns, name)
+                    for ns, name in backlog_keys
+                ]
+                encoded = encode_podgangs(
+                    backlog, snapshot, demand_fn,
+                    priority_of=self._priority_of, pod_scheduling=sched_fn,
+                )
             solver_by_name = {g.name: g for g in encoded}
             by_name = {g.metadata.name: g for g in backlog}
             solver_gangs = self._try_reserved(
                 encoded, by_name, snapshot, free
             )
-            result = engine.solve(solver_gangs, free=free)
+            result = (
+                engine.solve(solver_gangs, free=free, dispatch=dispatch)
+                if dispatch is not None
+                else engine.solve(solver_gangs, free=free)
+            )
+            # counted AFTER the solve: engine.solve may still reject the
+            # dispatch (e.g. _try_reserved bound a reservation, mutating
+            # free and shrinking the gang list) — only its own stats say
+            # whether the in-flight result was actually adopted
+            self.metrics.counter(
+                "grove_scheduler_solve_dispatch_total",
+                "pre_round solve dispatches by outcome at consume time",
+            ).inc(
+                outcome=(
+                    "overlapped"
+                    if result.stats.get("dispatch_overlap")
+                    else "fresh"
+                )
+            )
             self.log.debug(
                 "backlog solved", gangs=len(backlog),
                 placed=result.num_placed, unplaced=len(result.unplaced),
@@ -311,19 +447,51 @@ class GangScheduler:
         name = pod.spec.scheduler_name
         return not name or name == constants.SCHEDULER_NAME
 
-    def _gang_ready_to_schedule(self, gang: PodGang) -> bool:
+    def _gang_ready_to_schedule(
+        self, gang: PodGang, speculate_gates: bool = False
+    ) -> bool:
         """Every min-replica pod exists, is ungated, and is OURS to
         schedule (the operator's gate removal is the admission signal;
         scaled gangs stay gated until their base gang schedules, so they
-        naturally stay out of the backlog)."""
+        naturally stay out of the backlog).
+
+        speculate_gates (pre_round only): a still-gated pod counts as
+        ready when its gate is REMOVABLE under podclique._remove_gates'
+        rule — referenced in its gang (every pod walked here is), and for
+        a scaled gang the base is already scheduled — because the clique
+        reconciles running ahead of the scheduler in the same round will
+        drop it. A wrong prediction only costs the overlap (the consume
+        path re-derives the real backlog and falls back to a fresh
+        solve), never correctness."""
+        base_ok: bool | None = None
         for group in gang.spec.pod_groups:
             refs = group.pod_references[: group.min_replicas]
             if len(refs) < group.min_replicas:
                 return False
             for ref in refs:
                 pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
-                if pod is None or pod.spec.scheduling_gates or pod.node_name:
+                if pod is None or pod.node_name:
                     return False
+                if pod.spec.scheduling_gates:
+                    if not speculate_gates:
+                        return False
+                    if base_ok is None:
+                        base_name = gang.metadata.labels.get(
+                            constants.LABEL_BASE_PODGANG
+                        )
+                        if base_name:
+                            base = self.store.peek(
+                                PodGang.KIND,
+                                gang.metadata.namespace,
+                                base_name,
+                            )
+                            base_ok = base is not None and _cond_true(
+                                base, PodGangConditionType.SCHEDULED.value
+                            )
+                        else:
+                            base_ok = True
+                    if not base_ok:
+                        return False  # scaled gang: base not scheduled yet
                 if not self._ours(pod):
                     return False  # a foreign scheduler owns this gang
         return True

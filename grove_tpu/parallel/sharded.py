@@ -158,7 +158,7 @@ class ShardedPlacementEngine(PlacementEngine):
             gdom, ((0, 0), (0, pad)), constant_values=self.space.num_domains
         )
 
-    def _device_phase(self, dev_free, total_demand, sig, required_level,
+    def _device_begin(self, dev_free, total_demand, sig, required_level,
                       preferred_level, valid, cap_scale):
         nodes_axis = self.mesh.shape["nodes"]
         gangs_axis = self.mesh.shape["gangs"]
@@ -191,4 +191,10 @@ class ShardedPlacementEngine(PlacementEngine):
             pad_g(valid),
             cap_scale,
         )
+        top_val.copy_to_host_async()
+        top_dom.copy_to_host_async()
+        return top_val, top_dom, g
+
+    def _device_end(self, token):
+        top_val, top_dom, g = token
         return np.asarray(top_val)[:g], np.asarray(top_dom)[:g]

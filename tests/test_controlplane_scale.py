@@ -9,6 +9,7 @@ from grove_tpu.api.meta import ObjectMeta
 from grove_tpu.api.podgang import PodGang, PodGangPhase
 from grove_tpu.api.types import (
     Container,
+    Node,
     Pod,
     PodCliqueSet,
     PodCliqueSetSpec,
@@ -155,3 +156,60 @@ class TestIncrementality:
         total = m.counter("grove_manager_reconcile_total")
         delta = total.value(controller="podclique") - before["podclique"]
         assert delta <= 4, f"gang event fanned out to {delta} clique reconciles"
+
+    def test_pre_round_dispatch_overlaps_the_settle_solve(self):
+        # the manager's pre_round hook lets the scheduler dispatch the
+        # accelerator solve before the round's other reconciles; in a
+        # clean bulk-apply settle (no writes land between dispatch and
+        # consume) the reconcile must ADOPT the in-flight result, and
+        # the outcome must be identical to the synchronous path
+        h = Harness(nodes=make_nodes(60, allocatable={"cpu": 32.0,
+                                                      "memory": 128.0,
+                                                      "tpu": 8.0}))
+        h.apply(wide_pcs("ovl", 10))
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert len(pods) == 40
+        assert all(p.node_name and p.status.ready for p in pods)
+        c = h.cluster.metrics.counter(
+            "grove_scheduler_solve_dispatch_total",
+            "pre_round solve dispatches by outcome at consume time",
+        )
+        assert c.value(outcome="overlapped") >= 1
+        assert c.value(outcome="fresh") == 0
+
+    def test_stale_pre_round_dispatch_falls_back_to_fresh_solve(self):
+        # a write to a watched kind between dispatch and consume must
+        # discard the pending dispatch - the reconcile re-fetches and
+        # solves fresh, and still binds everything
+        h = Harness(nodes=make_nodes(60, allocatable={"cpu": 32.0,
+                                                      "memory": 128.0,
+                                                      "tpu": 8.0}))
+        h.apply(wide_pcs("stale", 4))
+        # invalidate every pending dispatch with a capacity-moving write
+        # (a Node create) landing between dispatch and consume
+        sched = h.scheduler
+        orig = sched.pre_round
+        seq = iter(range(10_000))
+
+        def poisoned_pre_round():
+            orig()
+            if sched._pending is not None:
+                h.store.create(
+                    Node(
+                        metadata=ObjectMeta(name=f"late-{next(seq)}"),
+                        allocatable={"cpu": 32.0, "memory": 128.0,
+                                     "tpu": 8.0},
+                    )
+                )
+
+        sched.pre_round = poisoned_pre_round
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert len(pods) == 16
+        assert all(p.node_name and p.status.ready for p in pods)
+        c = h.cluster.metrics.counter(
+            "grove_scheduler_solve_dispatch_total",
+            "pre_round solve dispatches by outcome at consume time",
+        )
+        assert c.value(outcome="fresh") >= 1

@@ -498,3 +498,54 @@ class TestNodeEligibility:
         # untolerated taint -> real mask
         mask = pod_eligibility_mask(tsnap, ({}, []), tsnap.has_taints)
         np.testing.assert_array_equal(mask, [False, True])
+
+
+class TestAsyncDispatch:
+    """engine.dispatch() + solve(dispatch=) must be bitwise what a fresh
+    solve computes (same encode, same jitted fn), and stale hints must be
+    rejected, never silently adopted (scheduler.pre_round overlap path)."""
+
+    def test_dispatch_matches_fresh_solve(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            gang("a", pods=2, cpu=2.0),
+            gang("b", pods=4, cpu=6.0, required=1),
+            gang("c", pods=3, cpu=3.0, preferred=2),
+        ]
+        eng = PlacementEngine(snap)
+        fresh = eng.solve(gangs)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        adopted = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert adopted.stats.get("dispatch_overlap") == 1.0
+        assert set(adopted.placed) == set(fresh.placed)
+        for name in fresh.placed:
+            np.testing.assert_array_equal(
+                adopted.placed[name].node_indices,
+                fresh.placed[name].node_indices,
+            )
+
+    def test_stale_free_matrix_rejected(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng = PlacementEngine(snap)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[0] -= 1.0  # capacity moved since dispatch
+        res = eng.solve(gangs, free=free, dispatch=handle)
+        assert "dispatch_overlap" not in res.stats
+        assert res.num_placed == 1
+
+    def test_different_gang_list_rejected(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap)
+        handle = eng.dispatch([gang("a", pods=2, cpu=2.0)],
+                              free=snap.free.copy())
+        # same names, RE-ENCODED objects: identity check must reject
+        res = eng.solve([gang("a", pods=2, cpu=2.0)],
+                        free=snap.free.copy(), dispatch=handle)
+        assert "dispatch_overlap" not in res.stats
+        assert res.num_placed == 1
+
+    def test_dispatch_empty_backlog_returns_none(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        assert PlacementEngine(snap).dispatch([]) is None
