@@ -196,8 +196,9 @@ class SimKubelet:
                     to_lose.append(
                         (pod.metadata.namespace, pod.metadata.name)
                     )
+        pod_bucket = self.store.kind_bucket(Pod.KIND)  # read-only
         for key in sorted(self._candidates):
-            pod = self.store.peek(Pod.KIND, *key)
+            pod = pod_bucket.get(key)
             if (
                 pod is None
                 or not pod.node_name

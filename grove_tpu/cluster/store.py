@@ -451,14 +451,25 @@ class ObjectStore:
 
     # -- reads -------------------------------------------------------------
     def get(self, kind: str, namespace: str, name: str) -> Any | None:
-        obj = self._objs.get(kind, {}).get(_key(namespace, name))
+        bucket = self._objs.get(kind)
+        obj = bucket.get((namespace, name)) if bucket is not None else None
         return clone(obj) if obj is not None else None
 
     def peek(self, kind: str, namespace: str, name: str) -> Any | None:
         """Read-only, NO-COPY access for hot scan paths (the informer-cache
         read analog). The returned object is live store state: callers MUST
         NOT mutate it — fetch with get() before any write-back."""
-        return self._objs.get(kind, {}).get(_key(namespace, name))
+        bucket = self._objs.get(kind)
+        return bucket.get((namespace, name)) if bucket is not None else None
+
+    def kind_bucket(self, kind: str) -> dict[tuple[str, str], Any]:
+        """The LIVE (namespace, name) -> object mapping for a kind: peek()
+        without the per-call overhead, for loops doing thousands of
+        lookups per reconcile (scheduler phase sweeps, kubelet tick).
+        Same contract as peek(): strictly read-only — callers must not
+        mutate the dict or the objects. The dict stays live (creates and
+        deletes show through)."""
+        return self._objs.setdefault(kind, {})
 
     def scan(
         self,

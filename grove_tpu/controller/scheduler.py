@@ -120,32 +120,70 @@ class GangScheduler:
         #: Consumed (or discarded as stale) by the same round's
         #: _reconcile — see pre_round.
         self._pending = None
+        #: seqs of OUR OWN PodGang status writes (bind/evict/phase/
+        #: unschedulable): gang-status output never feeds gang-status
+        #: input (phases derive from POD state), so re-dirtying on our own
+        #: writes re-ran a full no-op phase sweep — 10^4 pod peeks per
+        #: settle at stress scale — one round after every real one. Same
+        #: expectations-style pattern as podclique._own_events.
+        self._own_events: set[int] = set()
+
+    def _mark_own(self) -> None:
+        """Record the seq of a PodGang status write this scheduler just
+        made so map_event can ignore it (see _own_events)."""
+        self._own_events.add(self.store.last_seq)
+        if len(self._own_events) > 100_000:  # safety: undrained leak
+            self._own_events.clear()
 
     def map_event(self, event: Event) -> list[Request]:
-        if event.kind == PodGang.KIND:
-            self._dirty.add((event.namespace, event.name))
-            return [_SINGLETON_REQ]
-        if event.kind == Pod.KIND:
-            # new/ungated/deleted pods change the backlog or free capacity;
-            # only their OWN gang needs re-examination
-            gang = event.obj.metadata.labels.get(constants.LABEL_PODGANG)
-            if gang:
-                self._dirty.add((event.namespace, gang))
-            if event.type == "Deleted" and event.obj.node_name:
-                # bounded LRU (advisor r3): evict the OLDEST entry instead
-                # of dropping all pod-level reservation memory mid-churn;
-                # dict insertion order is the recency order (re-inserts
-                # refresh it)
-                key = (event.namespace, event.name)
-                self._vacated.pop(key, None)
-                if len(self._vacated) >= self.VACATED_LRU_MAX:
-                    self._vacated.pop(next(iter(self._vacated)))
-                self._vacated[key] = event.obj.node_name
-            return [_SINGLETON_REQ]
-        if event.kind == Node.KIND or event.kind == ClusterTopology.KIND:
-            # capacity/encoding shift: retry the backlog (scan finds it)
-            return [_SINGLETON_REQ]
-        return []
+        """Single-event watch predicate, expressed via the batched path
+        (runtime drains through map_events; this remains for direct
+        callers/tests)."""
+        out: list[Request] = []
+        self.map_events((event,), lambda _name, req: out.append(req))
+        return out
+
+    def map_events(self, events, enqueue) -> None:
+        """Batched watch predicate (one call per runtime drain round —
+        the per-event map_event call + list-return overhead was
+        measurable at 10^4-event settle scale).
+
+        Pod events: new/ungated/deleted pods change the backlog or free
+        capacity; only their OWN gang needs re-examination. Deleted bound
+        pods feed the vacated-node memory as a bounded LRU (advisor r3):
+        evict the OLDEST entry instead of dropping all pod-level
+        reservation memory mid-churn; dict insertion order is the recency
+        order (re-inserts refresh it). PodGang events: re-examine that
+        gang — unless the write was our own (see _own_events).
+        Node/ClusterTopology events: capacity/encoding shift — retry the
+        backlog (the reconcile scan finds it)."""
+        dirty = self._dirty
+        own = self._own_events
+        vacated = self._vacated
+        queued = False
+        for event in events:
+            kind = event.kind
+            if kind == Pod.KIND:
+                gang = event.obj.metadata.labels.get(constants.LABEL_PODGANG)
+                if gang:
+                    dirty.add((event.namespace, gang))
+                if event.type == "Deleted" and event.obj.node_name:
+                    key = (event.namespace, event.name)
+                    vacated.pop(key, None)
+                    if len(vacated) >= self.VACATED_LRU_MAX:
+                        vacated.pop(next(iter(vacated)))
+                    vacated[key] = event.obj.node_name
+                queued = True
+            elif kind == PodGang.KIND:
+                if event.seq in own:
+                    own.discard(event.seq)
+                else:
+                    dirty.add((event.namespace, event.name))
+                    queued = True
+            elif kind == Node.KIND or kind == ClusterTopology.KIND:
+                queued = True
+        if queued:
+            enqueue(self.name, _SINGLETON_REQ)
 
     def _dispatch_unaffected(self, seq0: int) -> bool:
         """True when every store write since seq0 is provably irrelevant
@@ -204,6 +242,23 @@ class GangScheduler:
             self._engine = self.engine_cls(snapshot, **self._engine_kwargs)
         return self._engine
 
+    def _fetch_and_encode(self, backlog_keys, snapshot):
+        """Backlog fetch (real copies — status writes follow) + solver
+        encoding. ONE code path shared by pre_round and the reconcile
+        fallback: the adoption guards trust that pre_round's encode equals
+        what the reconcile would compute, so the two must never diverge."""
+        backlog = [
+            self.store.get(PodGang.KIND, ns, name)
+            for ns, name in backlog_keys
+        ]
+        encoded = encode_podgangs(
+            backlog, snapshot,
+            self.cluster.pod_demand_fn(snapshot.resource_names),
+            priority_of=self._priority_of,
+            pod_scheduling=self.cluster.pod_scheduling_fn(),
+        )
+        return backlog, encoded
+
     def pre_round(self) -> None:
         """Manager pre_round hook (runtime.run_once): when a backlog is
         ready — or will be, once the podclique reconciles running ahead of
@@ -225,12 +280,15 @@ class GangScheduler:
         self._pending = None
         seq0 = self.store.last_seq
         backlog_keys: list[tuple[str, str]] = []
+        pod_bucket = self.store.kind_bucket(Pod.KIND)
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
                 continue
             if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
                 continue
-            if self._gang_ready_to_schedule(gang, speculate_gates=True):
+            if self._gang_ready_to_schedule(
+                gang, speculate_gates=True, pod_bucket=pod_bucket
+            ):
                 backlog_keys.append(
                     (gang.metadata.namespace, gang.metadata.name)
                 )
@@ -240,16 +298,7 @@ class GangScheduler:
         engine = self._engine_for(snapshot)
         if getattr(engine, "dispatch", None) is None:
             return  # custom engine without async support (tests)
-        backlog = [
-            self.store.get(PodGang.KIND, ns, name)
-            for ns, name in backlog_keys
-        ]
-        encoded = encode_podgangs(
-            backlog, snapshot,
-            self.cluster.pod_demand_fn(snapshot.resource_names),
-            priority_of=self._priority_of,
-            pod_scheduling=self.cluster.pod_scheduling_fn(),
-        )
+        backlog, encoded = self._fetch_and_encode(backlog_keys, snapshot)
         dispatch = engine.dispatch(encoded, free=snapshot.free.copy())
         if dispatch is not None:
             self._pending = (seq0, backlog_keys, backlog, encoded,
@@ -277,6 +326,7 @@ class GangScheduler:
         examine = dirty | self._starved
         backlog_keys: list[tuple[str, str]] = []
         dirty_scheduled: list[PodGang] = []
+        pod_bucket = self.store.kind_bucket(Pod.KIND)
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
                 continue
@@ -284,7 +334,7 @@ class GangScheduler:
             if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
                 if key in examine:
                     dirty_scheduled.append(gang)
-            elif self._gang_ready_to_schedule(gang):
+            elif self._gang_ready_to_schedule(gang, pod_bucket=pod_bucket):
                 backlog_keys.append(key)
         # one preemption attempt per BACKLOG STAY: a gang that left the
         # backlog (deleted, or scheduled elsewhere, or pods gone) gets a
@@ -319,14 +369,8 @@ class GangScheduler:
                 # phase (engine.solve still verifies gang identity + free)
                 _, _, backlog, encoded, dispatch = pending
             else:
-                # mutation ahead (status writes): fetch real copies
-                backlog = [
-                    self.store.get(PodGang.KIND, ns, name)
-                    for ns, name in backlog_keys
-                ]
-                encoded = encode_podgangs(
-                    backlog, snapshot, demand_fn,
-                    priority_of=self._priority_of, pod_scheduling=sched_fn,
+                backlog, encoded = self._fetch_and_encode(
+                    backlog_keys, snapshot
                 )
             solver_by_name = {g.name: g for g in encoded}
             by_name = {g.metadata.name: g for g in backlog}
@@ -338,20 +382,24 @@ class GangScheduler:
                 if dispatch is not None
                 else engine.solve(solver_gangs, free=free)
             )
-            # counted AFTER the solve: engine.solve may still reject the
-            # dispatch (e.g. _try_reserved bound a reservation, mutating
-            # free and shrinking the gang list) — only its own stats say
-            # whether the in-flight result was actually adopted
-            self.metrics.counter(
-                "grove_scheduler_solve_dispatch_total",
-                "pre_round solve dispatches by outcome at consume time",
-            ).inc(
-                outcome=(
-                    "overlapped"
-                    if result.stats.get("dispatch_overlap")
-                    else "fresh"
+            # counted AFTER the solve (engine.solve may still reject the
+            # dispatch — e.g. _try_reserved bound a reservation, mutating
+            # free and shrinking the gang list — so only its own stats say
+            # whether the in-flight result was adopted), and only when a
+            # dispatch EXISTED: solves with no pre_round dispatch at all
+            # (custom engine, empty speculative backlog) must not inflate
+            # the hit-rate denominator
+            if pending is not None:
+                self.metrics.counter(
+                    "grove_scheduler_solve_dispatch_total",
+                    "pre_round solve dispatches by outcome at consume time",
+                ).inc(
+                    outcome=(
+                        "overlapped"
+                        if result.stats.get("dispatch_overlap")
+                        else "fresh"
+                    )
                 )
-            )
             self.log.debug(
                 "backlog solved", gangs=len(backlog),
                 placed=result.num_placed, unplaced=len(result.unplaced),
@@ -376,6 +424,7 @@ class GangScheduler:
                 )
                 if gang.status != before:
                     self.store.update_status(gang)
+                    self._mark_own()
                 if entered:  # count state TRANSITIONS, not message churn
                     self.metrics.counter(
                         "grove_scheduler_gangs_unschedulable_total",
@@ -416,10 +465,15 @@ class GangScheduler:
         return Result(requeue_after=requeue)
 
     def _update_phases(self, keys: set[tuple[str, str]]) -> None:
-        for ns, name in sorted(keys):
-            gang = self.store.peek(PodGang.KIND, ns, name)  # read-only;
+        # live kind buckets (read-only): the sweep peeks 8 pods per gang
+        # per examined key, and per-peek call overhead was measurable at
+        # 10^3-gang scale
+        gangs = self.store.kind_bucket(PodGang.KIND)
+        pods = self.store.kind_bucket(Pod.KIND)
+        for key in sorted(keys):
+            gang = gangs.get(key)
             if gang is not None:  # _update_phase writes via patch_status
-                self._update_phase(gang)
+                self._update_phase(gang, pods)
 
     def _has_unbound_referenced_pod(self, gang: PodGang) -> bool:
         for group in gang.spec.pod_groups:
@@ -448,7 +502,10 @@ class GangScheduler:
         return not name or name == constants.SCHEDULER_NAME
 
     def _gang_ready_to_schedule(
-        self, gang: PodGang, speculate_gates: bool = False
+        self,
+        gang: PodGang,
+        speculate_gates: bool = False,
+        pod_bucket: dict | None = None,
     ) -> bool:
         """Every min-replica pod exists, is ungated, and is OURS to
         schedule (the operator's gate removal is the admission signal;
@@ -464,12 +521,14 @@ class GangScheduler:
         path re-derives the real backlog and falls back to a fresh
         solve), never correctness."""
         base_ok: bool | None = None
+        if pod_bucket is None:
+            pod_bucket = self.store.kind_bucket(Pod.KIND)
         for group in gang.spec.pod_groups:
             refs = group.pod_references[: group.min_replicas]
             if len(refs) < group.min_replicas:
                 return False
             for ref in refs:
-                pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
+                pod = pod_bucket.get((ref.namespace, ref.name))
                 if pod is None or pod.node_name:
                     return False
                 if pod.spec.scheduling_gates:
@@ -818,7 +877,10 @@ class GangScheduler:
                 now=now,
             )
 
-        self.store.patch_status(PodGang.KIND, ns, gang.metadata.name, mutate)
+        if self.store.patch_status(
+            PodGang.KIND, ns, gang.metadata.name, mutate
+        ):
+            self._mark_own()
         for group in gang.spec.pod_groups:
             for ref in group.pod_references:
                 pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
@@ -873,9 +935,10 @@ class GangScheduler:
                     now=now,
                 )
 
-        self.store.patch_status(
+        if self.store.patch_status(
             PodGang.KIND, ns, gang.metadata.name, mutate
-        )
+        ):
+            self._mark_own()
         # phase/conditions were just written: the same-round
         # _update_phases sweep can skip this gang (its Ready/Unhealthy
         # conditions land on the next pod event round regardless)
@@ -968,17 +1031,18 @@ class GangScheduler:
                 self.store.bind_pod(ns, pod_name, node_name)
 
     # -- phase/health (podgang.go:147-169) ----------------------------------
-    def _update_phase(self, gang: PodGang) -> None:
-        """`gang` is a live peek: reads only; the write goes through
-        patch_status (clones just the status, writes only on change) —
-        phase refresh runs for every examined gang every reconcile, so the
-        full-object get() clone here dominated settle at 10^3-gang scale."""
+    def _update_phase(self, gang: PodGang, pod_bucket: dict) -> None:
+        """`gang` is a live peek and `pod_bucket` the live Pod kind bucket:
+        reads only; the write goes through patch_status (clones just the
+        status, writes only on change) — phase refresh runs for every
+        examined gang every reconcile, so the full-object get() clone here
+        dominated settle at 10^3-gang scale."""
         if not _cond_true(gang, PodGangConditionType.SCHEDULED.value):
             return
         pods = []
         for group in gang.spec.pod_groups:
             for ref in group.pod_references[: group.min_replicas]:
-                pods.append(self.store.peek(Pod.KIND, ref.namespace, ref.name))
+                pods.append(pod_bucket.get((ref.namespace, ref.name)))
         missing_or_failed = any(
             p is None or p.status.phase == PodPhase.FAILED
             or (p.status.restart_count > 0 and not p.status.ready)
@@ -1009,9 +1073,10 @@ class GangScheduler:
                 now=now,
             )
 
-        self.store.patch_status(
+        if self.store.patch_status(
             PodGang.KIND, gang.metadata.namespace, gang.metadata.name, mutate
-        )
+        ):
+            self._mark_own()
 
 
 def _cond_true(gang: PodGang, cond_type: str) -> bool:
