@@ -318,6 +318,12 @@ class GangScheduler:
             self._starved |= starved_prev
             raise
 
+    def _count_dispatch(self, outcome: str) -> None:
+        self.metrics.counter(
+            "grove_scheduler_solve_dispatch_total",
+            "pre_round solve dispatches by outcome at consume time",
+        ).inc(outcome=outcome)
+
     def _reconcile(self, dirty: set[tuple[str, str]]) -> Result:
         # No-copy scan: backlog membership is re-derived every round (it is
         # what retry timers act on), but per-pod re-examination of SCHEDULED
@@ -344,6 +350,12 @@ class GangScheduler:
         needs_solve = bool(backlog_keys) or any(
             self._has_unbound_referenced_pod(g) for g in dirty_scheduled
         )
+        if not backlog_keys and self._pending is not None:
+            # a pre_round dispatch whose speculative backlog evaporated
+            # (gangs deleted mid-round): count the wasted dispatch so the
+            # overlap hit-rate stays honest under deletion churn
+            self._pending = None
+            self._count_dispatch("abandoned")
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
             self._update_phases(examine)
@@ -390,15 +402,10 @@ class GangScheduler:
             # (custom engine, empty speculative backlog) must not inflate
             # the hit-rate denominator
             if pending is not None:
-                self.metrics.counter(
-                    "grove_scheduler_solve_dispatch_total",
-                    "pre_round solve dispatches by outcome at consume time",
-                ).inc(
-                    outcome=(
-                        "overlapped"
-                        if result.stats.get("dispatch_overlap")
-                        else "fresh"
-                    )
+                self._count_dispatch(
+                    "overlapped"
+                    if result.stats.get("dispatch_overlap")
+                    else "fresh"
                 )
             self.log.debug(
                 "backlog solved", gangs=len(backlog),
