@@ -56,8 +56,17 @@ def compile_cached(
         return None
 
 
+#: Expected grove_native_abi() value. The content-hashed cache already
+#: rebuilds on source edits; this handshake additionally rejects a
+#: foreign or hand-copied .so whose constraint model / signatures don't
+#: match this caller — mismatch degrades to the Python reference paths
+#: instead of marshalling into undefined behavior.
+EXPECTED_ABI = 3
+
+
 def load_library() -> Optional[ctypes.CDLL]:
-    """Compile (once, content-hashed cache) and dlopen; None if no g++."""
+    """Compile (once, content-hashed cache) and dlopen; None if no g++ or
+    the library fails the ABI handshake."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
@@ -67,9 +76,12 @@ def load_library() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(str(so))
+        lib.grove_native_abi.restype = ctypes.c_int32
+        if lib.grove_native_abi() != EXPECTED_ABI:
+            return None  # stale/foreign library: Python fallback
         lib.solve_serial.restype = ctypes.c_int32
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
         _lib = None
     return _lib
 

@@ -224,11 +224,11 @@ def repair_native(
     free: np.ndarray,
 ):
     """Native commit phase for the accelerator path. Returns
-    (placements dict, fallback count) or None if the library is missing.
-    MUTATES free in place (like the Python repair loop).
-
-    Only called for native-compatible backlogs: no constraint groups and no
-    group-preferred levels (PlacementEngine gates on gang_native_compatible).
+    (placements dict, fallback count) or None if the library is missing
+    or fails the ABI handshake (build.EXPECTED_ABI). MUTATES free in
+    place (like the Python repair loop). Covers the full fit.py
+    constraint model: gang/group required+preferred levels, constraint
+    groups, per-pod eligibility masks.
     """
     lib = load_library()
     if lib is None:
@@ -298,11 +298,9 @@ def repair_native(
     return placements, int(fallbacks.value)
 
 
-def gang_native_compatible(gang: SolverGang) -> bool:
-    """Full coverage since round 4: the C++ unit tree implements the whole
-    fit.py constraint model — gang/group required AND preferred pack
-    levels, constraint groups (PCSG co-location), and per-pod
-    node-eligibility masks. Kept as an API seam for future constraint
-    kinds; equivalence against the Python reference is asserted by
-    tests/test_native.py incl. the grouped fuzz suite."""
-    return True
+# (The former gang_native_compatible per-gang gate is gone: the C++ unit
+# tree has implemented the whole fit.py constraint model since round 4 —
+# gang/group required AND preferred pack levels, constraint groups,
+# per-pod node-eligibility masks — so the seam it guarded is now the
+# library-level ABI handshake in build.load_library, which tests
+# something observable: grove_native_abi() of the loaded .so.)

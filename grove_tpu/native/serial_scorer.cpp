@@ -338,6 +338,15 @@ bool place_in_domain(const Ctx& ctx, const Gang& g, const float* demand,
 
 extern "C" {
 
+// ABI/capability handshake: the Python loader (native/build.py) refuses
+// any library whose version differs from its expected constant, so a
+// stale or foreign .so degrades to the Python reference implementation
+// instead of marshalling arguments into undefined behavior. Bump on ANY
+// signature or constraint-model change. v3 = full fit.py model:
+// gang/group required+preferred levels, constraint groups, eligibility
+// masks.
+int32_t grove_native_abi(void) { return 3; }
+
 // Returns number of gangs placed. assign[P_total] gets the node index per
 // pod (-1 if the owning gang is unplaced). gang_order: priority order is
 // the caller's array order (Python pre-sorts, same as serial.py).

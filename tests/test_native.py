@@ -199,7 +199,6 @@ class TestNativeGroupedParity:
         """The engine's native repair must accept grouped gangs (no
         Python-path fallback) and match the Python repair placements."""
         from grove_tpu.solver import PlacementEngine
-        from grove_tpu.native.serial_native import gang_native_compatible
 
         snap = cluster(blocks=2, racks=4, hosts=4, cpu=8.0)
         gangs = [
@@ -210,7 +209,6 @@ class TestNativeGroupedParity:
             grouped_gang(f"pref{i}", [4], required=0, preferred=1, cpu=2.0)
             for i in range(4)
         ]
-        assert all(gang_native_compatible(g) for g in gangs)
         nat = PlacementEngine(snap, native_repair=True).solve(gangs)
         py = PlacementEngine(snap, native_repair=False).solve(gangs)
         assert set(nat.placed) == set(py.placed) == {g.name for g in gangs}
@@ -424,3 +422,38 @@ def test_tune_gc_smoke():
         assert gc.get_threshold()[0] == 50_000
     finally:
         gc.set_threshold(*old)
+
+
+class TestAbiHandshake:
+    """The loader must refuse a library whose grove_native_abi() differs
+    from build.EXPECTED_ABI (stale/foreign .so -> Python fallback, never
+    undefined marshalling), and accept the current one."""
+
+    def test_current_library_passes_handshake(self):
+        from grove_tpu.native import build
+
+        lib = build.load_library()
+        if lib is None:
+            pytest.skip("no native toolchain")
+        assert lib.grove_native_abi() == build.EXPECTED_ABI
+
+    def test_mismatched_abi_rejected(self, monkeypatch):
+        from grove_tpu.native import build
+
+        if build.load_library() is None:
+            pytest.skip("no native toolchain")
+        # reset the memoized loader and demand an ABI no library provides
+        monkeypatch.setattr(build, "_lib", None)
+        monkeypatch.setattr(build, "_tried", False)
+        monkeypatch.setattr(build, "EXPECTED_ABI", 10**9)
+        assert build.load_library() is None
+        # and repair/solve degrade to the Python paths instead of crashing
+        from grove_tpu.native import solve_serial_native
+
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=8.0)
+        assert solve_serial_native(snap, [gang("a", pods=2, cpu=1.0)]) is None
+        # restore the real loader state for later tests in this process
+        monkeypatch.undo()
+        monkeypatch.setattr(build, "_lib", None)
+        monkeypatch.setattr(build, "_tried", False)
+        assert build.load_library() is not None

@@ -176,12 +176,10 @@ def test_sharded_engine_satisfies_hard_contract(seed):
 @pytest.mark.parametrize("seed", (0, 2, 5, 7))
 def test_native_serial_matches_python_on_random_problems(seed):
     from grove_tpu.native import native_available, solve_serial_native
-    from grove_tpu.native.serial_native import gang_native_compatible
 
     if not native_available():
         pytest.skip("no native toolchain")
     snap, gangs = random_problem(seed)
-    gangs = [g for g in gangs if gang_native_compatible(g)]
     for g in gangs:
         # the C++ baseline does not implement gang-level PREFERRED packing
         # (a soft node-choice policy); strip it so both paths make
