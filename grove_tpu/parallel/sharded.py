@@ -65,7 +65,18 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
     """Build the jitted, mesh-sharded equivalent of solver.engine's
     _device_score. Inputs must be padded: G divisible by the gangs axis,
     N by the nodes axis (PlacementEngine pads gangs; ShardedPlacementEngine
-    pads nodes with zero-capacity dummies)."""
+    pads nodes with zero-capacity dummies).
+
+    Structure (VERDICT r4 #8 — check_vma is ON): shard_map covers only
+    the genuinely sharded scoring — the [G, N]-shaped fit/membership
+    products reduced over "nodes" by psum, producing the gangs-sharded
+    value matrix — with clean varying-axes typing the tracker verifies.
+    The sequential commit scan (cheap [D, R] arithmetic per gang that
+    needs the GLOBAL priority order) runs in the enclosing jit on the
+    global value matrix, where the SPMD partitioner inserts the gather —
+    replacing the previous hand-written tiled all_gathers whose outputs
+    the tracker could only mark gangs-varying (forcing check_vma=False
+    and leaving replication asserted by parity tests alone)."""
 
     @partial(
         jax.shard_map,
@@ -74,7 +85,6 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             P("nodes", None),    # free        [N, R]
             P(None, "nodes"),    # gdom        [L+1, N]
             P(),                 # dom_level   [D]
-            P(),                 # anc_ids     [D, L+1]
             P("gangs", None),    # total_demand[G, R]
             P(),                 # u_sig_demand [U, R] (unique rows, replicated)
             P(),                 # u_sig_mask  [U]
@@ -85,16 +95,12 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             P("gangs"),          # valid       [G]
             P(),                 # cap_scale   [R]
         ),
-        out_specs=(P(), P()),    # replicated top_val/top_dom [G, K]
-        # tiled all_gather over "gangs" yields device-identical values, but
-        # the varying-manual-axes tracker still marks them gangs-varying and
-        # would reject the invariant carry/out_specs; the replication is
-        # asserted instead by test_sharded_matches_single_device.
-        check_vma=False,
+        out_specs=(P("gangs", None), P()),  # value [G, D], dom_free [D, R]
+        check_vma=True,
     )
-    def fn(free, gdom, dom_level, anc_ids, total_demand, u_sig_demand,
-           u_sig_mask, elig_masks, sig_idx, required_level, preferred_level,
-           valid, cap_scale):
+    def score(free, gdom, dom_level, total_demand, u_sig_demand,
+              u_sig_mask, elig_masks, sig_idx, required_level,
+              preferred_level, valid, cap_scale):
         m = membership_matrix(gdom, num_domains)             # [Nl, D]
         dom_free = jax.lax.psum(m.T @ free, "nodes")         # [D, R]
         node_fits = jnp.all(
@@ -107,14 +113,21 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             dom_free, cnt_fit, dom_level, total_demand, required_level,
             preferred_level, valid, cap_scale,
         )                                                    # [Gl, D]
-        # Gather full value/demand so the sequential commit scan sees the
-        # global priority order; it is cheap [D, R] arithmetic per gang and
-        # runs replicated (bitwise-identical on every device).
-        value = jax.lax.all_gather(value_l, "gangs", axis=0, tiled=True)
-        td = jax.lax.all_gather(total_demand, "gangs", axis=0, tiled=True)
-        return commit_scan(value, dom_free, anc_ids, td, top_k, chunk)
+        return value_l, dom_free
 
-    return jax.jit(fn)
+    @jax.jit
+    def fn(free, gdom, dom_level, anc_ids, total_demand, u_sig_demand,
+           u_sig_mask, elig_masks, sig_idx, required_level, preferred_level,
+           valid, cap_scale):
+        value, dom_free = score(
+            free, gdom, dom_level, total_demand, u_sig_demand, u_sig_mask,
+            elig_masks, sig_idx, required_level, preferred_level, valid,
+            cap_scale,
+        )
+        return commit_scan(value, dom_free, anc_ids, total_demand,
+                           top_k, chunk)
+
+    return fn
 
 
 class ShardedPlacementEngine(PlacementEngine):
