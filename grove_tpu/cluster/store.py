@@ -424,6 +424,30 @@ class ObjectStore:
     def last_seq(self) -> int:
         return self._events[-1].seq if self._events else self._compacted_seq
 
+    # -- public introspection (consumed by observability.debug) ------------
+    def object_counts(self) -> dict[str, int]:
+        """Live object count per kind (non-empty kinds only)."""
+        return {
+            kind: len(bucket)
+            for kind, bucket in sorted(self._objs.items())
+            if bucket
+        }
+
+    @property
+    def event_log_length(self) -> int:
+        """Events currently retained (post-compaction)."""
+        return len(self._events)
+
+    @property
+    def compaction_horizon(self) -> int:
+        """Seq below which history was compacted (0 = never compacted)."""
+        return self._compacted_seq
+
+    @property
+    def label_index_size(self) -> int:
+        """Number of (kind, label, value) index buckets."""
+        return len(self._label_idx)
+
     def _emit(self, type_: str, obj: Any, old: Any = None) -> None:
         """Append a watch event. The store is MVCC — every write REPLACES
         the stored object with a new version and never mutates old versions

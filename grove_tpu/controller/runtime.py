@@ -179,6 +179,25 @@ class ControllerManager:
     def next_requeue_at(self) -> Optional[float]:
         return self._requeues[0][0] if self._requeues else None
 
+    # -- public introspection (consumed by observability.debug; the
+    # controller-runtime workqueue-metrics analog). Keep debug surfaces on
+    # these, not on _-prefixed internals, so a runtime refactor can't
+    # silently break (or falsify) the dumps. -------------------------------
+    @property
+    def workqueue_depth(self) -> int:
+        """Requests currently queued for the next round."""
+        return len(self._queue)
+
+    @property
+    def pending_requeue_count(self) -> int:
+        """Timer-held requests waiting on the requeue heap."""
+        return len(self._requeues)
+
+    @property
+    def event_cursor(self) -> int:
+        """Last store event seq this manager has drained."""
+        return self._cursor
+
     def compact_processed_events(self) -> int:
         """Drop store events this manager has already drained. Safe when
         the manager is the only event consumer (the production shape);

@@ -318,6 +318,34 @@ class GangScheduler:
             self._starved |= starved_prev
             raise
 
+    def debug_state(self) -> dict:
+        """Public introspection read by observability.debug (the pprof-
+        dump analog): incremental-tracking set sizes, reservation-memory
+        occupancy, and a summary of the cached engine. Read-only."""
+        engine = self._engine
+        if engine is None:
+            summary = None
+        elif hasattr(engine, "debug_summary"):
+            summary = engine.debug_summary()
+        else:
+            # RemotePlacementEngine (no local DomainSpace/device state —
+            # its server-side twin shows up in the service's Debug dump)
+            # and custom test engines: type + whatever shape they expose
+            summary = {
+                "type": type(engine).__name__,
+                "num_nodes": engine.snapshot.num_nodes,
+                "num_domains": None,
+                "device_statics_resident": False,
+            }
+        return {
+            "dirty_gangs": len(self._dirty),
+            "starved_gangs": len(self._starved),
+            "gang_reservations": len(self._reservations),
+            "vacated_pod_reservations": len(self._vacated),
+            "preemption_attempted_for": len(self._preempted_for),
+            "engine": summary,
+        }
+
     def _count_dispatch(self, outcome: str) -> None:
         self.metrics.counter(
             "grove_scheduler_solve_dispatch_total",

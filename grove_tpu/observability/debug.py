@@ -26,7 +26,10 @@ from typing import Any
 
 def manager_dump(manager) -> dict[str, Any]:
     """ControllerManager introspection: what the reference's workqueue +
-    controller-runtime metrics expose, read directly off the runtime."""
+    controller-runtime metrics expose, read through the runtime's PUBLIC
+    accessors only (workqueue_depth/pending_requeue_count/event_cursor;
+    VERDICT r4 #6) — a runtime refactor breaks these loudly at the
+    accessor, never silently in the dump."""
     m = manager.metrics
     per_controller: dict[str, Any] = {}
     if m is not None:
@@ -34,23 +37,22 @@ def manager_dump(manager) -> dict[str, Any]:
         errors = m.counter("grove_manager_reconcile_errors_total")
         dur = m.histogram("grove_manager_reconcile_duration_seconds")
         for c in manager.controllers:
-            series = dur._series.get((("controller", c.name),), [])
             per_controller[c.name] = {
                 "reconciles": totals.value(controller=c.name),
                 "errors": errors.value(controller=c.name),
                 "duration_seconds": {
-                    "count": len(series),
+                    "count": dur.series_count(controller=c.name),
                     "p50": dur.percentile(50, controller=c.name),
                     "p99": dur.percentile(99, controller=c.name),
                 },
             }
     return {
         "controllers": per_controller,
-        "workqueue_depth": len(manager._queue),
-        "pending_requeues": len(manager._requeues),
+        "workqueue_depth": manager.workqueue_depth,
+        "pending_requeues": manager.pending_requeue_count,
         "next_requeue_at": manager.next_requeue_at(),
         "recorded_errors": len(manager.errors),
-        "event_cursor": manager._cursor,
+        "event_cursor": manager.event_cursor,
         "is_leader": (
             manager.elector.is_leader() if manager.elector is not None
             else True
@@ -60,39 +62,16 @@ def manager_dump(manager) -> dict[str, Any]:
 
 def store_dump(store) -> dict[str, Any]:
     return {
-        "objects_by_kind": {
-            kind: len(bucket)
-            for kind, bucket in sorted(store._objs.items())
-            if bucket
-        },
-        "event_log_length": len(store._events),
+        "objects_by_kind": store.object_counts(),
+        "event_log_length": store.event_log_length,
         "last_seq": store.last_seq,
-        "compacted_seq": store._compacted_seq,
-        "label_index_buckets": len(store._label_idx),
+        "compacted_seq": store.compaction_horizon,
+        "label_index_buckets": store.label_index_size,
     }
 
 
 def scheduler_dump(scheduler) -> dict[str, Any]:
-    engine = scheduler._engine
-    return {
-        "dirty_gangs": len(scheduler._dirty),
-        "starved_gangs": len(scheduler._starved),
-        "gang_reservations": len(scheduler._reservations),
-        "vacated_pod_reservations": len(scheduler._vacated),
-        "preemption_attempted_for": len(scheduler._preempted_for),
-        # RemotePlacementEngine has no local DomainSpace/device state —
-        # its server-side twin shows up in the service's Debug dump
-        "engine": None if engine is None else {
-            "type": type(engine).__name__,
-            "num_nodes": engine.snapshot.num_nodes,
-            "num_domains": getattr(
-                getattr(engine, "space", None), "num_domains", None
-            ),
-            "device_statics_resident": (
-                getattr(engine, "_dev_static", None) is not None
-            ),
-        },
-    }
+    return scheduler.debug_state()
 
 
 def harness_dump(harness) -> dict[str, Any]:

@@ -72,6 +72,11 @@ class Histogram:
     def count(self) -> int:
         return sum(len(o) for o in self._series.values())
 
+    def series_count(self, **labels: str) -> int:
+        """Observation count of ONE label series (the () series when
+        unlabeled) — the public read debug dumps use."""
+        return len(self._obs_for(labels))
+
     @property
     def sum(self) -> float:
         return float(sum(sum(o) for o in self._series.values()))

@@ -136,11 +136,7 @@ class PlacementService:
 
         with self._lock:
             epochs = {
-                epoch: {
-                    "num_nodes": eng.snapshot.num_nodes,
-                    "num_domains": eng.space.num_domains,
-                    "device_statics_resident": eng._dev_static is not None,
-                }
+                epoch: eng.debug_summary()
                 for epoch, eng in self._engines.items()
             }
         return json.dumps({

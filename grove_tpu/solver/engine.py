@@ -653,6 +653,19 @@ class PlacementEngine:
         k = packed.shape[1] // 2
         return packed[:, :k], packed[:, k:].astype(np.int32)
 
+    def debug_summary(self) -> dict:
+        """Public introspection summary (consumed by the scheduler's
+        debug_state and the placement service's Debug RPC): engine type,
+        problem shape, and whether the static topology arrays are
+        device-resident. Keep debug surfaces on this, not on private
+        attributes, so an engine refactor can't silently falsify dumps."""
+        return {
+            "type": type(self).__name__,
+            "num_nodes": self.snapshot.num_nodes,
+            "num_domains": self.space.num_domains,
+            "device_statics_resident": self._dev_static is not None,
+        }
+
     def measure_device_split(
         self, gangs: list[SolverGang], free: np.ndarray | None = None,
         iters: int = 8,
