@@ -284,7 +284,9 @@ def main() -> int:
     # Control-plane bench (VERDICT r1 #4): the FULL path — apply one PCS
     # with N replicas of an 8-pod clique against the same-size inventory,
     # reconcile to quiescence (gated pods -> deferred gangs -> scheduler ->
-    # bound + ready). Reported warm (second PCS; first pays jit compile).
+    # bound + ready). Warm = p50 of 3 post-warmup runs against a
+    # constant-size store (the first apply pays jit compile and is
+    # reported as cold); see bench_controlplane.
     cp = {}
     if args.cp_replicas > 0:
         cp = bench_controlplane(args.nodes, args.cp_replicas)
@@ -483,22 +485,31 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
     from grove_tpu.tuning import tune_gc
 
     tune_gc()
+    # Median-of-3 warm settles, DELETING the workload between runs so the
+    # store population (and thus the scan/event cost) is identical each
+    # time: one congested device round trip on the shared tunnel moved a
+    # single-shot measurement by ±20%, the same treatment the solver wall
+    # gets (p50-of-iters). The delete+resettle between runs is excluded.
     solve_h = h.cluster.metrics.histogram("grove_solver_backlog_bind_seconds")
-    solve_before = solve_h.sum
-    t0 = time.perf_counter()
-    h.apply(pcs("cpbench"))
-    h.settle()
-    warm = time.perf_counter() - t0
-    # solver-vs-controllers attribution: how much of the warm settle was
-    # accelerator solve wall (the rest is the host-side control plane —
-    # store writes, watch fan-out, reconciles; see BASELINE.md)
-    solve_wall = solve_h.sum - solve_before
-    bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
-    if bound != 2 * replicas * 8:  # not assert: must survive python -O
-        raise RuntimeError(
-            f"controlplane bench invalid: {bound} pods bound, "
-            f"expected {2 * replicas * 8}"
-        )
+    runs: list[tuple[float, float]] = []
+    for i in range(3):
+        name = f"cpbench{i}"
+        solve_before = solve_h.sum
+        t0 = time.perf_counter()
+        h.apply(pcs(name))
+        h.settle()
+        wall = time.perf_counter() - t0
+        runs.append((wall, solve_h.sum - solve_before))
+        bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
+        if bound != 2 * replicas * 8:  # not assert: must survive python -O
+            raise RuntimeError(
+                f"controlplane bench invalid: {bound} pods bound, "
+                f"expected {2 * replicas * 8}"
+            )
+        h.store.delete("PodCliqueSet", "default", name)
+        h.settle()
+    runs.sort()
+    warm, solve_wall = runs[1]
     return {
         "controlplane_replicas": replicas,
         "controlplane_settle_seconds": round(warm, 2),
@@ -506,6 +517,7 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
         "controlplane_gangs_per_sec": round(replicas / warm, 1),
         "controlplane_solve_seconds": round(solve_wall, 3),
         "controlplane_host_seconds": round(warm - solve_wall, 3),
+        "controlplane_settle_basis": "p50_of_3",
     }
 
 
@@ -548,6 +560,9 @@ def churn_workload(
     from grove_tpu.api.podgang import PodGang, PodGangConditionType
 
     store = h.store
+    # name prefix unique per invocation (store seqs are monotonic), so
+    # repeated churn phases against one harness never collide on names
+    prefix = f"churn-{store.last_seq}"
     batch = max(1, int(round(rate * batch_dt)))
     n_batches = max(1, int(round(duration / batch_dt)))
     alive: collections.deque[str] = collections.deque()
@@ -577,7 +592,7 @@ def churn_workload(
         this_batch = batch if b >= 0 else warmup_sizes[b + len(warmup_sizes)]
         t0 = time.perf_counter()
         for _ in range(this_batch):
-            name = f"churn-{seq}"
+            name = f"{prefix}-{seq}"
             seq += 1
             h.apply(_churn_pcs(name))
             alive.append(name)

@@ -77,91 +77,109 @@ class PodCliqueReconciler:
         )
 
     def map_event(self, event: Event) -> list[Request]:
-        if event.kind == KIND:
-            # the clique's own status writes (and metadata-only bumps like
-            # finalizers) feed nothing this reconciler computes — only
-            # spec changes, lifecycle edges and deletion marks do
-            if (
-                event.type == "Modified"
-                and event.old is not None
-                and event.obj.metadata.generation
-                == event.old.metadata.generation
-                and event.obj.metadata.deletion_timestamp
-                == event.old.metadata.deletion_timestamp
-            ):
-                return []
-            self._pods_dirty.add((event.namespace, event.name))
-            return [Request(event.namespace, event.name)]
-        if event.kind == Pod.KIND:
-            if event.seq in self._own_events:
-                # our own write, already rolled up by the reconcile that
-                # made it (expectations analog — see __init__)
-                self._own_events.discard(event.seq)
-                return []
-            pclq = event.obj.metadata.labels.get(constants.LABEL_PODCLIQUE)
-            if not pclq:
-                return []
-            key = (event.namespace, pclq)
-            # pod component triggers: inventory changes (add/delete),
-            # spec changes (ungate bumps generation), active-ness flips
-            # (Failed/Succeeded pods get replaced). Pure phase/readiness
-            # churn only rolls up counts — unless a rollout is in flight,
-            # where readiness gates the next pod-at-a-time replacement.
-            if (
-                event.type != "Modified"
-                or event.old is None
-                or event.obj.metadata.generation
-                != event.old.metadata.generation
-                or is_pod_active(event.obj) != is_pod_active(event.old)
-                or (
-                    key in self._rollout_active
-                    and event.obj.status.ready != event.old.status.ready
+        """Single-event watch predicate, expressed via the batched path
+        (the runtime drains through map_events; this remains for direct
+        callers/tests)."""
+        out: list[Request] = []
+        self.map_events((event,), lambda _name, req: out.append(req))
+        return out
+
+    def map_events(self, events, enqueue) -> None:
+        """Batched watch predicate (one call per runtime drain round —
+        per-event call + return-list overhead was measurable at
+        10^4-event settle scale). Semantics are those the per-event
+        comments below describe; map_event is the 1-tuple view."""
+        name_ = self.name
+        pods_dirty = self._pods_dirty
+        own = self._own_events
+        rollout_active = self._rollout_active
+        for event in events:
+            kind = event.kind
+            if kind == KIND:
+                # the clique's own status writes (and metadata-only bumps
+                # like finalizers) feed nothing this reconciler computes —
+                # only spec changes, lifecycle edges and deletion marks do
+                if (
+                    event.type == "Modified"
+                    and event.old is not None
+                    and event.obj.metadata.generation
+                    == event.old.metadata.generation
+                    and event.obj.metadata.deletion_timestamp
+                    == event.old.metadata.deletion_timestamp
+                ):
+                    continue
+                pods_dirty.add((event.namespace, event.name))
+                enqueue(name_, Request(event.namespace, event.name))
+            elif kind == Pod.KIND:
+                if event.seq in own:
+                    # our own write, already rolled up by the reconcile
+                    # that made it (expectations analog — see __init__)
+                    own.discard(event.seq)
+                    continue
+                pclq = event.obj.metadata.labels.get(
+                    constants.LABEL_PODCLIQUE
                 )
-            ):
-                self._pods_dirty.add(key)
-            return [Request(event.namespace, pclq)]
-        if event.kind == PodGang.KIND:
-            # Gang creation/scheduling unblocks gate removal
-            # (register.go:49-120) — but only for cliques the gang actually
-            # references: its PodGroups are named after them, plus the
-            # scaled cliques holding this gang as their base. Mapping to
-            # every clique of the PCS (the r2 shape) turned each gang
-            # status write into an O(cliques) reconcile fan-out — the
-            # control-plane bottleneck at 1000-replica scale.
-            #
-            # Gate relevance (syncflow.go:242-394): a gang's EXISTENCE and
-            # pod_references (spec) gate its own cliques' pods; its
-            # SCHEDULED condition gates pods of scaled gangs based on it.
-            # Phase/score churn gates nothing — no reconcile at all.
-            ns = event.namespace
-            spec_changed = event.type != "Modified" or event.old is None or (
-                event.obj.metadata.generation
-                != event.old.metadata.generation
-            )
-            scheduled_changed = spec_changed or _is_scheduled(
-                event.obj
-            ) != _is_scheduled(event.old)
-            if not spec_changed and not scheduled_changed:
-                return []
-            reqs = []
-            if spec_changed:
-                reqs = [
-                    Request(ns, group.name)
-                    for group in event.obj.spec.pod_groups
-                ]
-            if scheduled_changed:
-                base_of = event.obj.metadata.name
-                reqs.extend(
-                    Request(ns, p.metadata.name)
+                if not pclq:
+                    continue
+                key = (event.namespace, pclq)
+                # pod component triggers: inventory changes (add/delete),
+                # spec changes (ungate bumps generation), active-ness
+                # flips (Failed/Succeeded pods get replaced). Pure phase/
+                # readiness churn only rolls up counts — unless a rollout
+                # is in flight, where readiness gates the next
+                # pod-at-a-time replacement.
+                if (
+                    event.type != "Modified"
+                    or event.old is None
+                    or event.obj.metadata.generation
+                    != event.old.metadata.generation
+                    or is_pod_active(event.obj) != is_pod_active(event.old)
+                    or (
+                        key in rollout_active
+                        and event.obj.status.ready != event.old.status.ready
+                    )
+                ):
+                    pods_dirty.add(key)
+                enqueue(name_, Request(event.namespace, pclq))
+            elif kind == PodGang.KIND:
+                # Gang creation/scheduling unblocks gate removal
+                # (register.go:49-120) — but only for cliques the gang
+                # actually references: its PodGroups are named after them,
+                # plus the scaled cliques holding this gang as their base.
+                # Mapping to every clique of the PCS (the r2 shape) turned
+                # each gang status write into an O(cliques) reconcile
+                # fan-out — the control-plane bottleneck at 1000-replica
+                # scale.
+                #
+                # Gate relevance (syncflow.go:242-394): a gang's EXISTENCE
+                # and pod_references (spec) gate its own cliques' pods;
+                # its SCHEDULED condition gates pods of scaled gangs based
+                # on it. Phase/score churn gates nothing — no reconcile.
+                ns = event.namespace
+                spec_changed = (
+                    event.type != "Modified" or event.old is None or (
+                        event.obj.metadata.generation
+                        != event.old.metadata.generation
+                    )
+                )
+                scheduled_changed = spec_changed or _is_scheduled(
+                    event.obj
+                ) != _is_scheduled(event.old)
+                if not spec_changed and not scheduled_changed:
+                    continue
+                if spec_changed:
+                    for group in event.obj.spec.pod_groups:
+                        pods_dirty.add((ns, group.name))
+                        enqueue(name_, Request(ns, group.name))
+                if scheduled_changed:
+                    base_of = event.obj.metadata.name
                     for p in self.store.scan(  # names only: no-copy scan
                         KIND,
                         namespace=ns,
                         labels={constants.LABEL_BASE_PODGANG: base_of},
-                    )
-                )
-            self._pods_dirty.update((r.namespace, r.name) for r in reqs)
-            return reqs
-        return []
+                    ):
+                        pods_dirty.add((ns, p.metadata.name))
+                        enqueue(name_, Request(ns, p.metadata.name))
 
     def reconcile(self, request: Request) -> Result:
         # peek: this reconciler never mutates the PodClique object itself —

@@ -326,16 +326,20 @@ class GangScheduler:
         if engine is None:
             summary = None
         elif hasattr(engine, "debug_summary"):
+            # PlacementEngine, ShardedPlacementEngine and
+            # RemotePlacementEngine all implement the contract
             summary = engine.debug_summary()
         else:
-            # RemotePlacementEngine (no local DomainSpace/device state —
-            # its server-side twin shows up in the service's Debug dump)
-            # and custom test engines: type + whatever shape they expose
+            # custom test engines: type + whatever shape they expose
             summary = {
                 "type": type(engine).__name__,
                 "num_nodes": engine.snapshot.num_nodes,
-                "num_domains": None,
-                "device_statics_resident": False,
+                "num_domains": getattr(
+                    getattr(engine, "space", None), "num_domains", None
+                ),
+                "device_statics_resident": (
+                    getattr(engine, "_dev_static", None) is not None
+                ),
             }
         return {
             "dirty_gangs": len(self._dirty),

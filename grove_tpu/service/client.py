@@ -67,6 +67,20 @@ class RemotePlacementEngine:
         self.epoch = snapshot_epoch(snapshot)
         self._register()
 
+    def debug_summary(self) -> dict:
+        """Public introspection summary (same contract as
+        PlacementEngine.debug_summary): this client holds no local
+        DomainSpace/device state — the server-side engine's shape shows
+        up in the service's Debug RPC under this epoch."""
+        return {
+            "type": type(self).__name__,
+            "num_nodes": self.snapshot.num_nodes,
+            "num_domains": None,
+            "device_statics_resident": False,
+            "address": self.address,
+            "epoch": self.epoch,
+        }
+
     # Stubs are resolved PER CALL through the shared-channel cache: after
     # a _rechannel() every engine on this address (not just the one that
     # noticed the outage) transparently picks up the fresh channel on its
