@@ -268,8 +268,12 @@ def main() -> int:  # pragma: no cover - thin CLI
     # long-lived server process: adopt the control-plane GC posture (see
     # grove_tpu/tuning.py). Deferred to just before serving so the frozen
     # set is the INITIALIZED graph (server, TLS machinery, engine), not
-    # the post-argparse near-empty heap.
-    from ..tuning import tune_gc
+    # the post-argparse near-empty heap. The persistent XLA compilation
+    # cache makes a restarted server's first solve reuse executables
+    # compiled by any earlier process on this machine.
+    from ..tuning import enable_compilation_cache, tune_gc
+
+    enable_compilation_cache()
     if args.tls_dir:
         import threading
         import time as _time

@@ -457,3 +457,21 @@ class TestAbiHandshake:
         monkeypatch.setattr(build, "_lib", None)
         monkeypatch.setattr(build, "_tried", False)
         assert build.load_library() is not None
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    """enable_compilation_cache points JAX's persistent cache at the
+    resolved directory (arg > env > tmp default) and returns it."""
+    from grove_tpu.tuning import enable_compilation_cache
+
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        explicit = enable_compilation_cache(str(tmp_path / "a"))
+        assert explicit == str(tmp_path / "a")
+        assert jax.config.jax_compilation_cache_dir == explicit
+        monkeypatch.setenv("GROVE_TPU_COMPILE_CACHE", str(tmp_path / "b"))
+        assert enable_compilation_cache() == str(tmp_path / "b")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
