@@ -467,6 +467,7 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
     import jax
 
     prev = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
     try:
         explicit = enable_compilation_cache(str(tmp_path / "a"))
         assert explicit == str(tmp_path / "a")
@@ -475,3 +476,6 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
         assert enable_compilation_cache() == str(tmp_path / "b")
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min
+        )
