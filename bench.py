@@ -656,6 +656,10 @@ def churn_workload(
                     crashes += 1
         h.clock.advance(batch_dt)
         h.settle()
+        # long-run hygiene: the steady stream would otherwise grow the
+        # append-only event log without bound (~3k events/batch), and
+        # every consumer's drain slices an ever-longer list
+        h.compact_events()
         now = time.perf_counter()
         if measuring:
             measured_wall += now - t0

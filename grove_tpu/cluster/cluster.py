@@ -133,6 +133,12 @@ class Cluster:
         self._req_cache[key] = (pod.spec.containers, req)
         return req
 
+    @property
+    def usage_cursor(self) -> int:
+        """Last store event seq the incremental usage accounting has
+        drained (public: feeds the harness's safe compaction horizon)."""
+        return self._usage_cursor
+
     def usage(self) -> dict[str, dict[str, float]]:
         """Per-node resource usage from bound, non-terminal pods (terminal
         Succeeded/Failed pods release their requests). INCREMENTAL: an

@@ -67,6 +67,12 @@ class SimKubelet:
         #: the scan-at-tick-start semantics
         self._nodes_lost: set[str] = set()
 
+    @property
+    def event_cursor(self) -> int:
+        """Last store event seq this kubelet has drained (public: feeds
+        the harness's safe compaction horizon)."""
+        return self._cursor
+
     def _relist(self) -> None:
         self._candidates.clear()
         self._ready.clear()

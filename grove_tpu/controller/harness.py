@@ -111,6 +111,22 @@ class Harness:
                     return
         raise RuntimeError("harness did not settle")
 
+    def compact_events(self) -> int:
+        """Long-run hygiene: drop store events every live consumer has
+        already drained — the manager, the kubelet, and the cluster's
+        incremental usage accounting each keep a watch cursor, and the
+        safe horizon is the MINIMUM of them (compacting past any one
+        would force it into a relist). Steady-state simulations (the
+        churn benchmark, long soaks) call this periodically so the
+        append-only log stays bounded; one-shot tests that inspect
+        history simply don't. Returns the number of events dropped."""
+        horizon = min(
+            self.manager.event_cursor,
+            self.kubelet.event_cursor,
+            self.cluster.usage_cursor,
+        )
+        return self.store.compact_events(horizon)
+
     def debug_dump(self) -> dict:
         """Runtime introspection (the pprof-dump analog; SURVEY §5):
         per-controller reconcile stats + queue depths + store counts +

@@ -264,6 +264,12 @@ def main() -> int:  # pragma: no cover - thin CLI
     ap.add_argument("--cert-check-seconds", type=float, default=3600.0,
                     help="interval of the cert-renewal check loop "
                     "(TLS mode only)")
+    ap.add_argument("--san", action="append", default=[],
+                    help="additional subject-alternative name for the "
+                    "server certificate (repeatable) — the names clients "
+                    "actually dial, e.g. a Kubernetes Service DNS name "
+                    "like grove-placement.grove-system(.svc); without "
+                    "them TLS verification of those targets fails")
     args = ap.parse_args()
     # long-lived server process: adopt the control-plane GC posture (see
     # grove_tpu/tuning.py). Deferred to just before serving so the frozen
@@ -288,7 +294,10 @@ def main() -> int:  # pragma: no cover - thin CLI
         # persistent CA: restarts re-issue the server cert (rotation)
         # under the SAME CA, so clients holding ca.pem keep trusting
         ca_cert, ca_key = load_or_create_ca(args.tls_dir)
-        rotator = CertRotator(ca_cert, ca_key, hostname=host)
+        rotator = CertRotator(
+            ca_cert, ca_key, hostname=host,
+            extra_sans=tuple(args.san),
+        )
         (Path(args.tls_dir) / "server.pem").write_bytes(rotator.bundle.cert)
         rserver = RotatingTLSServer(args.address, rotator)
         rserver.start()

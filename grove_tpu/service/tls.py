@@ -73,18 +73,24 @@ def make_ca(valid_days: int = 3650):
 
 
 def issue_server_cert(ca_cert, ca_key, hostname: str = "localhost",
-                      valid_days: int = 365) -> CertBundle:
+                      valid_days: int = 365,
+                      extra_sans: tuple[str, ...] = ()) -> CertBundle:
     """CA-signed server certificate; re-issuing IS the rotation. IP hosts
     get IPAddress SANs (gRPC/OpenSSL verifies an IP target against those,
-    never DNSName entries); DNS names are deduplicated."""
+    never DNSName entries); DNS names are deduplicated. extra_sans: the
+    names clients actually dial beyond the bind host — e.g. a Kubernetes
+    Service DNS name (deploy/placement-service.yaml passes --san) — each
+    classified as IP or DNS the same way as the primary hostname."""
     key = _key()
     now = datetime.datetime.now(datetime.timezone.utc)
-    entries: list = []
-    try:
-        entries.append(x509.IPAddress(ipaddress.ip_address(hostname)))
-        dns = {"localhost"}
-    except ValueError:
-        dns = {hostname, "localhost"}
+    ips = set()
+    dns = {"localhost"}
+    for name in (hostname, *extra_sans):
+        try:
+            ips.add(ipaddress.ip_address(name))
+        except ValueError:
+            dns.add(name)
+    entries: list = [x509.IPAddress(ip) for ip in sorted(ips, key=str)]
     entries.extend(x509.DNSName(n) for n in sorted(dns))
     san = x509.SubjectAlternativeName(entries)
     cert = (
@@ -155,17 +161,19 @@ class CertRotator:
 
     def __init__(self, ca_cert, ca_key, hostname: str = "localhost",
                  valid_days: int = 365, renew_before_days: float = 30.0,
-                 now_fn=None):
+                 now_fn=None, extra_sans: tuple[str, ...] = ()):
         self.ca_cert = ca_cert
         self.ca_key = ca_key
         self.hostname = hostname
+        self.extra_sans = tuple(extra_sans)
         self.valid_days = valid_days
         self.renew_before = datetime.timedelta(days=renew_before_days)
         self._now_fn = now_fn or (
             lambda: datetime.datetime.now(datetime.timezone.utc)
         )
         self.bundle = issue_server_cert(
-            ca_cert, ca_key, hostname=hostname, valid_days=valid_days
+            ca_cert, ca_key, hostname=hostname, valid_days=valid_days,
+            extra_sans=self.extra_sans,
         )
         self.rotations = 0
 
@@ -184,7 +192,7 @@ class CertRotator:
             return False
         self.bundle = issue_server_cert(
             self.ca_cert, self.ca_key, hostname=self.hostname,
-            valid_days=self.valid_days,
+            valid_days=self.valid_days, extra_sans=self.extra_sans,
         )
         self.rotations += 1
         return True

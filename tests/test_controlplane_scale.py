@@ -244,3 +244,14 @@ class TestIncrementality:
         from grove_tpu.api.types import Pod
         pods = h.store.scan(Pod.KIND)
         assert all(p.node_name for p in pods)
+        # long-run hygiene: churn compacts the event log each batch, so
+        # retention stays bounded by one batch's traffic, not the run
+        assert h.store.event_log_length < 2000, (
+            f"event log leaked: {h.store.event_log_length} retained"
+        )
+        # and the consumers survived compaction without relisting churn:
+        # one more wave settles cleanly
+        h.apply(bench_mod._churn_pcs("after-compact", 2))
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert all(p.node_name and p.status.ready for p in pods)
