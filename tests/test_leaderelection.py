@@ -101,3 +101,40 @@ def test_standby_autoscale_is_a_noop():
     leader.autoscale()
     pcsg = leader.store.get(PodCliqueScalingGroup.KIND, "default", "as-0-grp")
     assert pcsg.spec.replicas == 4
+
+
+def test_failover_with_in_flight_solve_dispatch():
+    """A leader that dies AFTER pre_round dispatched its accelerator
+    solve (pending state held in ITS scheduler instance) must not leak
+    that work into the successor: the standby's scheduler has its own
+    clean state, re-derives the backlog, and binds everything — and the
+    dead leader's pending dispatch is simply garbage."""
+    leader, standby = ha_pair()
+    leader.settle()
+    assert leader.elector.is_leader()
+    # work arrives; drive the leader only as far as the dispatch: run
+    # rounds until its scheduler holds a pending solve, then "crash" it
+    leader.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    for _ in range(8):
+        leader.manager._drain_events()
+        leader.manager._pop_due_requeues()
+        # run pre_round by hand (what run_once does before reconciles)
+        leader.scheduler.pre_round()
+        if leader.scheduler._pending is not None:
+            break  # dispatched; now the leader dies mid-round
+        leader.manager.run_once()
+    assert leader.scheduler._pending is not None, (
+        "setup failed: the leader never reached a dispatched solve"
+    )
+    # the standby takes over after lease expiry and finishes the job
+    standby.clock.advance(16.0)
+    standby.settle()
+    assert standby.elector.is_leader()
+    pods = standby.store.list(Pod.KIND)
+    assert len(pods) == 2
+    assert all(p.node_name and p.status.ready for p in pods)
+    # the dead leader's pending dispatch never reached the store: every
+    # bind is attributed to the standby's scheduler
+    assert standby.cluster.metrics.counter(
+        "grove_scheduler_gangs_scheduled_total"
+    ).total() >= 1

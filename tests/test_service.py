@@ -3,6 +3,8 @@ full control plane driving a remote engine (the operator/external-
 scheduler split of the reference, with grove_tpu's own engine behind it).
 """
 
+import contextlib
+
 import numpy as np
 import pytest
 
@@ -332,14 +334,17 @@ def test_debug_endpoint_and_harness_dump(server_address):
     assert d["manager"]["is_leader"] is True
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _spawned_service(*extra_args, startup_timeout=60.0):
     """Spawn the placement server as a real subprocess, wait (bounded)
     for its listening banner, yield the process; SIGTERM + kill teardown.
-    Shared by every subprocess-boundary test in this file."""
+    Shared by every subprocess-boundary test in this file.
+
+    The banner wait reads the RAW pipe fd (select + os.read, no
+    TextIOWrapper): mixing select with buffered readline can strand the
+    banner in Python's internal buffer while select blocks on a drained
+    fd — a full startup_timeout flake."""
+    import os
     import select
     import signal
     import subprocess
@@ -348,22 +353,27 @@ def _spawned_service(*extra_args, startup_timeout=60.0):
 
     proc = subprocess.Popen(
         [sys.executable, "-m", "grove_tpu.service.server", *extra_args],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
     try:
         deadline = time.monotonic() + startup_timeout
-        while True:
+        fd = proc.stdout.fileno()
+        buf = ""
+        while "listening" not in buf:
+            if proc.poll() is not None:
+                raise RuntimeError(f"service failed to start:\n{buf}")
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise RuntimeError("service never reported listening")
-            ready, _, _ = select.select([proc.stdout], [], [], remaining)
+                raise RuntimeError(
+                    f"service never reported listening:\n{buf}"
+                )
+            ready, _, _ = select.select([fd], [], [], min(remaining, 1.0))
             if not ready:
-                raise RuntimeError("service never reported listening")
-            line = proc.stdout.readline()
-            if "listening" in line:
-                break
-            if not line or proc.poll() is not None:
-                raise RuntimeError("service failed to start")
+                continue  # re-check liveness + deadline
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                raise RuntimeError(f"service stdout closed:\n{buf}")
+            buf += chunk.decode(errors="replace")
         yield proc
     finally:
         proc.send_signal(signal.SIGTERM)
