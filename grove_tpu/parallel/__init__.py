@@ -1,5 +1,11 @@
-"""Multi-chip sharding for the placement engine."""
+"""Multi-chip / multi-host sharding for the placement engine."""
 
+from .multihost import initialize_multihost
 from .sharded import ShardedPlacementEngine, make_solver_mesh, sharded_score_fn
 
-__all__ = ["ShardedPlacementEngine", "make_solver_mesh", "sharded_score_fn"]
+__all__ = [
+    "ShardedPlacementEngine",
+    "initialize_multihost",
+    "make_solver_mesh",
+    "sharded_score_fn",
+]
