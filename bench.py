@@ -244,6 +244,32 @@ def main() -> int:
         / max(g_iters, 1)
     )
 
+    # Pipelined throughput: the dispatch/adopt API (engine.dispatch ->
+    # solve(dispatch=...)) lets a steady-arrival operator overlap solve
+    # k+1's device phase + result transfer with solve k's host repair,
+    # so the per-solve cost approaches max(transport, host) instead of
+    # their sum. This is the sustained-stream regime; the blocking
+    # p50/p99 above remain the single-backlog LATENCY numbers. Runs on
+    # the metrics-free warm engine so the bind histogram stays clean.
+    pipe_iters = max(5, args.iters)
+    handle = warm.dispatch(gangs, free=snapshot.free.copy())
+    pipe_adopted = 0
+    t0 = time.perf_counter()
+    for _ in range(pipe_iters):
+        nxt = warm.dispatch(gangs, free=snapshot.free.copy())
+        pr = warm.solve(gangs, free=handle.free0, dispatch=handle)
+        if pr.stats.get("dispatch_overlap"):
+            pipe_adopted += 1
+        handle = nxt
+    pipe_wall = (time.perf_counter() - t0) / pipe_iters
+    warm.solve(gangs, free=handle.free0, dispatch=handle)  # drain
+    # EVERY iteration must have adopted its in-flight dispatch, else the
+    # wall mixes synchronous solves and the number is not pipelined;
+    # pipelined_adopted_iters is always emitted so a 0.0 throughput is
+    # distinguishable from "bench not run"
+    if pipe_adopted != pipe_iters:
+        pipe_wall = 0.0
+
     # Device compute-vs-transport split (VERDICT r4 #3): dispatch-to-
     # dispatch over K iterations isolates device compute from the dev
     # tunnel's fixed round-trip latency, making the co-located projection
@@ -257,6 +283,11 @@ def main() -> int:
     )
     split["colocated_projection_gangs_per_sec"] = round(
         args.gangs / colocated_wall, 1
+    )
+    split["pipelined_adopted_iters"] = f"{pipe_adopted}/{pipe_iters}"
+    split["pipelined_iter_seconds"] = round(pipe_wall, 4)
+    split["pipelined_gangs_per_sec"] = (
+        round(args.gangs / pipe_wall, 1) if pipe_wall > 0 else 0.0
     )
 
     # Scale-ceiling probes (VERDICT r3 #8 + r4 #9): datapoints at 2x and
