@@ -384,9 +384,12 @@ class GangScheduler:
         )
         if not backlog_keys and self._pending is not None:
             # a pre_round dispatch whose speculative backlog evaporated
-            # (gangs deleted mid-round): count the wasted dispatch so the
-            # overlap hit-rate stays honest under deletion churn
-            self._pending = None
+            # (gangs deleted mid-round): cancel the in-flight work (a
+            # no-op locally; stops the RPC on a remote engine) and count
+            # it so the overlap hit-rate stays honest under deletion
+            # churn
+            pending, self._pending = self._pending, None
+            pending[4].cancel()
             self._count_dispatch("abandoned")
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
@@ -413,6 +416,8 @@ class GangScheduler:
                 # phase (engine.solve still verifies gang identity + free)
                 _, _, backlog, encoded, dispatch = pending
             else:
+                if pending is not None:
+                    pending[4].cancel()  # stale: stop in-flight RPC work
                 backlog, encoded = self._fetch_and_encode(
                     backlog_keys, snapshot
                 )

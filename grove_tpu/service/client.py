@@ -47,11 +47,38 @@ def _channel_for(address: str, root_ca: bytes | None = None) -> grpc.Channel:
     return ch
 
 
+class RemoteSolveDispatch:
+    """In-flight Solve RPC begun by RemotePlacementEngine.dispatch() —
+    the service-boundary twin of solver.engine.SolveDispatch. Carries the
+    gang list (identity-compared at consume time), the free matrix the
+    request encoded (content-compared), and the gRPC future whose result
+    streams back while the caller does other work."""
+
+    __slots__ = ("engine", "gangs", "free0", "future", "encode_seconds")
+
+    def __init__(self, engine, gangs, free0, future, encode_seconds):
+        self.engine = engine
+        self.gangs = gangs
+        self.free0 = free0
+        self.future = future
+        self.encode_seconds = encode_seconds
+
+    def cancel(self) -> None:
+        """Abandon the in-flight RPC: stops a not-yet-started server
+        handler and the response transfer (a dropped handle would let
+        the stale solve run to completion server-side right when the
+        caller is issuing its replacement)."""
+        self.future.cancel()
+
+
 class RemotePlacementEngine:
     """solve() over the placement service. Accepts (and forwards metrics
     for) the same constructor knobs as PlacementEngine so the scheduler
     can inject it via engine_cls unchanged; solver tuning knobs live
-    server-side with the engine."""
+    server-side with the engine. dispatch()/solve(dispatch=) mirror the
+    local engine's async API, so the scheduler's pre_round overlap works
+    identically through the service boundary — the RPC (server solve +
+    response transfer) rides under the reconcile round's host work."""
 
     def __init__(self, snapshot: TopologySnapshot, address: str,
                  metrics=None, timeout_seconds: float = 120.0,
@@ -114,40 +141,99 @@ class RemotePlacementEngine:
                 f"epoch mismatch: client {self.epoch} server {server_epoch}"
             )
 
-    def solve(self, gangs, free: np.ndarray | None = None) -> SolveResult:
+    def dispatch(
+        self, gangs, free: np.ndarray | None = None
+    ) -> RemoteSolveDispatch | None:
+        """Begin the Solve RPC asynchronously (gRPC future): the server
+        solves and the response streams back while the caller does host
+        work; a later solve(..., dispatch=handle) adopts the result.
+        Same contract as PlacementEngine.dispatch: `gangs` and `free`
+        must not be mutated in between; solve() verifies both and falls
+        back to a fresh RPC on any mismatch or on a failed future (the
+        fresh path carries the re-Sync / re-channel recovery)."""
         import time
 
         t0 = time.perf_counter()
         if free is None:
             free = self.snapshot.free.copy()
+        if not gangs:
+            return None
         request = codec.encode_solve_request(self.epoch, gangs, free)
-        try:
-            response = self._solve(request, timeout=self.timeout_seconds,
-                                   wait_for_ready=True)
-        except (grpc.RpcError, ValueError) as err:
-            code = err.code() if isinstance(err, grpc.RpcError) else None
-            if code == grpc.StatusCode.FAILED_PRECONDITION:
-                # the service restarted (or evicted this epoch): re-Sync
-                # and retry once — without this the scheduler's cached
-                # engine would fail every reconcile until the topology
-                # changed
-                self._register()
-            elif code in (
-                grpc.StatusCode.UNAVAILABLE,
-                grpc.StatusCode.DEADLINE_EXCEEDED,
-            ) or isinstance(err, ValueError):
-                # transport-level outage — the server hot-restarted its
-                # listener for a cert rotation, or a sibling engine
-                # already tore the shared channel down (grpc raises
-                # ValueError on a closed channel): rebuild the channel
-                # (fresh handshake against the renewed cert), re-Sync,
-                # retry once
-                self._rechannel()
-                self._register()
+        ch = _channel_for(self.address, self._root_ca)
+        future = ch.unary_unary(f"/{SERVICE}/Solve").future(
+            request, timeout=self.timeout_seconds, wait_for_ready=True
+        )
+        return RemoteSolveDispatch(
+            engine=self,
+            gangs=list(gangs),
+            free0=free,
+            future=future,
+            encode_seconds=time.perf_counter() - t0,
+        )
+
+    def solve(
+        self, gangs, free: np.ndarray | None = None, dispatch=None
+    ) -> SolveResult:
+        import time
+
+        t0 = time.perf_counter()
+        if free is None:
+            free = self.snapshot.free.copy()
+        # Try to adopt an in-flight dispatch; a rejected one is CANCELLED
+        # (stops a not-yet-started server handler + the response
+        # transfer), and a failed future falls through to the fresh path,
+        # which owns the re-Sync / re-channel recovery. Both paths share
+        # one decode/mirror/stats tail below so adoption stays bitwise
+        # what a fresh RPC returns.
+        response = None
+        adopted = False
+        if dispatch is not None:
+            if (
+                dispatch.engine is self
+                and len(dispatch.gangs) == len(gangs)
+                and all(a is b for a, b in zip(dispatch.gangs, gangs))
+                and np.array_equal(dispatch.free0, free)
+            ):
+                try:
+                    response = dispatch.future.result()
+                    adopted = True
+                except (grpc.RpcError, ValueError):
+                    response = None
             else:
-                raise
-            response = self._solve(request, timeout=self.timeout_seconds,
-                                   wait_for_ready=True)
+                dispatch.cancel()
+        if response is None:
+            request = codec.encode_solve_request(self.epoch, gangs, free)
+            try:
+                response = self._solve(
+                    request, timeout=self.timeout_seconds,
+                    wait_for_ready=True,
+                )
+            except (grpc.RpcError, ValueError) as err:
+                code = err.code() if isinstance(err, grpc.RpcError) else None
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    # the service restarted (or evicted this epoch):
+                    # re-Sync and retry once — without this the
+                    # scheduler's cached engine would fail every
+                    # reconcile until the topology changed
+                    self._register()
+                elif code in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ) or isinstance(err, ValueError):
+                    # transport-level outage — the server hot-restarted
+                    # its listener for a cert rotation, or a sibling
+                    # engine already tore the shared channel down (grpc
+                    # raises ValueError on a closed channel): rebuild
+                    # the channel (fresh handshake against the renewed
+                    # cert), re-Sync, retry once
+                    self._rechannel()
+                    self._register()
+                else:
+                    raise
+                response = self._solve(
+                    request, timeout=self.timeout_seconds,
+                    wait_for_ready=True,
+                )
         result = codec.decode_solve_response(
             response, {g.name: g for g in gangs}, self.snapshot.node_names
         )
@@ -157,6 +243,9 @@ class RemotePlacementEngine:
         for placement in result.placed.values():
             for p, ni in enumerate(placement.node_indices):
                 free[ni] -= placement.gang.demand[p]
+        if adopted:
+            result.stats["dispatch_overlap"] = 1.0
+            result.stats["encode_seconds"] = dispatch.encode_seconds
         # the north-star bind-latency metric must include what the
         # boundary ADDS (encode + RPC + decode), not just the server's
         # solve wall — keep the server number in stats for the breakdown

@@ -290,6 +290,11 @@ class SolveDispatch:
         self.token = token
         self.encode_seconds = encode_seconds
 
+    def cancel(self) -> None:
+        """No-op (uniform handle API with the service client's
+        RemoteSolveDispatch): the device work is already enqueued and
+        XLA has nothing to reclaim; dropping the handle is enough."""
+
 
 class PlacementEngine:
     """Batched TPU-path solver bound to one topology snapshot."""

@@ -551,3 +551,56 @@ def test_extra_sans_cover_service_dns_names(tmp_path):
         assert "grove-placement.grove-system" in san.get_values_for_type(
             x509.DNSName
         )
+
+
+def test_remote_dispatch_adopts_and_matches_fresh_solve(server_address):
+    """RemotePlacementEngine.dispatch + solve(dispatch=) — the service-
+    boundary twin of the local async API: adoption must be bitwise what
+    a fresh RPC returns, stale hints must be rejected, and a settle
+    through the harness must OVERLAP its solve via pre_round exactly as
+    with the local engine."""
+    snap = cluster()
+    eng = RemotePlacementEngine(snap, server_address, timeout_seconds=60.0)
+    gangs = [gang("d1", pods=2, cpu=1.0), gang("d2", pods=1, cpu=2.0)]
+    fresh = eng.solve(gangs, free=snap.free.copy())
+    handle = eng.dispatch(gangs, free=snap.free.copy())
+    adopted = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+    assert adopted.stats.get("dispatch_overlap") == 1.0
+    assert set(adopted.placed) == set(fresh.placed)
+    for name in fresh.placed:
+        np.testing.assert_array_equal(
+            adopted.placed[name].node_indices,
+            fresh.placed[name].node_indices,
+        )
+    # stale free -> rejected, fresh RPC still solves
+    handle = eng.dispatch(gangs, free=snap.free.copy())
+    moved = snap.free.copy()
+    moved[0] -= 1.0
+    res = eng.solve(gangs, free=moved, dispatch=handle)
+    assert "dispatch_overlap" not in res.stats
+    assert res.num_placed == len(gangs)
+    assert eng.dispatch([], free=snap.free.copy()) is None
+
+
+def test_remote_engine_settle_overlaps_via_pre_round(server_address):
+    from functools import partial
+
+    from grove_tpu.api.types import Pod
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+    from test_e2e_basic import clique, simple_pcs
+
+    h = Harness(
+        nodes=make_nodes(8, racks_per_block=2, hosts_per_rack=4),
+        engine_cls=partial(RemotePlacementEngine, address=server_address),
+    )
+    h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+    h.settle()
+    pods = h.store.list(Pod.KIND)
+    assert len(pods) == 3
+    assert all(p.node_name and p.status.ready for p in pods)
+    c = h.cluster.metrics.counter(
+        "grove_scheduler_solve_dispatch_total",
+        "pre_round solve dispatches by outcome at consume time",
+    )
+    assert c.value(outcome="overlapped") >= 1
