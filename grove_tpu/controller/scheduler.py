@@ -54,6 +54,11 @@ class GangScheduler:
     #: them; eviction drops the OLDEST entry, never the whole map)
     VACATED_LRU_MAX = 100_000
     RESERVATIONS_LRU_MAX = 100_000
+    #: best-effort singles at or below this count bind via the exact
+    #: serial path instead of a device solve — a crash-replacement
+    #: rebind must not pay the accelerator round trip (class attr so
+    #: tests can force either path)
+    SINGLES_SERIAL_MAX = 8
     watch_kinds = frozenset(
         (PodGang.KIND, Pod.KIND, Node.KIND, ClusterTopology.KIND)
     )
@@ -1068,7 +1073,22 @@ class GangScheduler:
                     )
         if not singles:
             return
-        result = engine.solve(singles, free=free)
+        if len(singles) <= self.SINGLES_SERIAL_MAX:
+            # a handful of replacement/excess singles does not warrant a
+            # device round trip (~0.1 s through the dev tunnel — the
+            # dominant cost of a crash-replacement rebind): place them
+            # with the EXACT serial path (the canonical solve_serial
+            # loop, same hard-feasibility primitives and sort order)
+            # against the residual capacity, and record the outcome into
+            # the same solver metrics so unplaced singles stay visible
+            # to monitoring. Larger waves amortize the device batch.
+            from ..solver.engine import record_solve_metrics
+            from ..solver.serial import solve_serial
+
+            result = solve_serial(snapshot, singles, free=free)
+            record_solve_metrics(self.metrics, result, len(singles))
+        else:
+            result = engine.solve(singles, free=free)
         for placement in result.placed.values():
             ns = placement.gang.namespace
             for pod_name, node_name in placement.pod_to_node.items():

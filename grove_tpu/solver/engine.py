@@ -272,6 +272,32 @@ def _device_score(
     return jnp.concatenate([top_val, top_dom.astype(jnp.float32)], axis=1)
 
 
+def record_solve_metrics(metrics, result: SolveResult, backlog: int) -> None:
+    """Feed one solve's outcome into the registry — the ONE place the
+    north-star solver metrics are written, shared by every solve path
+    (local engine, remote client, and the scheduler's serial fast path
+    for small singles waves) so no placement outcome is invisible to
+    monitoring."""
+    m = metrics
+    m.gauge("grove_solver_backlog_size",
+            "gangs entering the last solve").set(float(backlog))
+    m.histogram("grove_solver_backlog_bind_seconds",
+                "wall time to bind one full backlog").observe(
+        result.wall_seconds)
+    m.counter("grove_solver_gangs_placed_total",
+              "gangs placed across all solves").inc(result.num_placed)
+    m.counter("grove_solver_gangs_unplaced_total",
+              "gangs left unplaced across all solves").inc(
+        len(result.unplaced))
+    m.counter("grove_solver_repair_fallbacks_total",
+              "exact-repair serial fallbacks").inc(
+        result.stats.get("fallbacks", 0.0))
+    score_h = m.histogram("grove_solver_placement_score",
+                          "per-gang placement score (0,1]")
+    for p in result.placed.values():
+        score_h.observe(p.placement_score)
+
+
 class SolveDispatch:
     """In-flight device phase begun by PlacementEngine.dispatch().
 
@@ -444,24 +470,7 @@ class PlacementEngine:
         return result
 
     def _record_metrics(self, result: SolveResult, backlog: int) -> None:
-        m = self.metrics
-        m.gauge("grove_solver_backlog_size",
-                "gangs entering the last solve").set(float(backlog))
-        m.histogram("grove_solver_backlog_bind_seconds",
-                    "wall time to bind one full backlog").observe(
-            result.wall_seconds)
-        m.counter("grove_solver_gangs_placed_total",
-                  "gangs placed across all solves").inc(result.num_placed)
-        m.counter("grove_solver_gangs_unplaced_total",
-                  "gangs left unplaced across all solves").inc(
-            len(result.unplaced))
-        m.counter("grove_solver_repair_fallbacks_total",
-                  "exact-repair serial fallbacks").inc(
-            result.stats.get("fallbacks", 0.0))
-        score_h = m.histogram("grove_solver_placement_score",
-                              "per-gang placement score (0,1]")
-        for p in result.placed.values():
-            score_h.observe(p.placement_score)
+        record_solve_metrics(self.metrics, result, backlog)
 
     def _repair(self, order, top_val, top_dom, free):
         """Exact commit phase. Uses the native (C++) implementation when the

@@ -255,3 +255,43 @@ class TestIncrementality:
         h.settle()
         pods = h.store.scan(Pod.KIND)
         assert all(p.node_name and p.status.ready for p in pods)
+
+    def test_small_singles_rebind_skips_the_device(self):
+        # a crash-replacement rebind (a handful of best-effort singles)
+        # must bind via the exact serial path, not pay a device solve:
+        # the backlog-bind histogram gains NO new observation while the
+        # pod still lands back on a node
+        h = Harness(nodes=make_nodes(40, allocatable={"cpu": 32.0,
+                                                      "memory": 128.0,
+                                                      "tpu": 8.0}))
+        h.apply(wide_pcs("sg", 6))
+        h.settle()
+        solve_h = h.cluster.metrics.histogram(
+            "grove_solver_backlog_bind_seconds"
+        )
+        solves_before = solve_h.count
+        wall_before = solve_h.sum
+        victim = h.store.scan(Pod.KIND)[0]
+        prior_node = victim.node_name
+        h.kubelet.evict_pod(victim.metadata.namespace, victim.metadata.name)
+        # cordon the vacated node so the pod-level reservation fast path
+        # cannot shortcut the rebind: the replacement must SEARCH, and
+        # that search must be the serial path, not a device solve
+        node = h.store.get(Node.KIND, "default", prior_node)
+        node.unschedulable = True
+        h.store.update(node)
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert len(pods) == 24
+        assert all(p.node_name and p.status.ready for p in pods)
+        replacement = h.store.peek(
+            Pod.KIND, victim.metadata.namespace, victim.metadata.name
+        )
+        assert replacement.node_name != prior_node
+        # the rebind IS recorded (unplaced singles must stay visible to
+        # monitoring) but as serial-path observations, not device solves:
+        # the added wall must be far below one device round trip
+        assert solve_h.count > solves_before
+        assert solve_h.sum - wall_before < 0.05, (
+            "single-pod rebind paid a device solve"
+        )
