@@ -570,6 +570,7 @@ def churn_workload(
     measure: bool = True,
     scale_every: float = 10.0,
     crash_every: float = 7.0,
+    update_every: float = 25.0,
 ) -> dict:
     """Drive a steady gang-arrival stream against a WARM control plane:
     every batch_dt virtual seconds, rate*batch_dt single-replica 8-pod
@@ -609,7 +610,7 @@ def churn_workload(
     seq = 0
     crashed: str | None = None
     scale_dir = 1
-    created = deleted = scale_events = crashes = 0
+    created = deleted = scale_events = crashes = updates = 0
     deleted_before_bind = 0
     measured_wall = 0.0
 
@@ -650,20 +651,45 @@ def churn_workload(
             if measuring:
                 deleted += 1
         # mixed events on the standing workload (the reference's E2E fault
-        # model: scale churn + container crashes mid-stream)
+        # model: scale churn + container crashes + rolling updates
+        # mid-stream)
         vnow = h.clock.now()
-        if b >= 0 and int(vnow / scale_every) != int(
-            (vnow - batch_dt) / scale_every
-        ):
+
+        def crossed(period: float) -> bool:
+            return b >= 0 and int(vnow / period) != int(
+                (vnow - batch_dt) / period
+            )
+
+        if crossed(scale_every):
             pcs_obj = store.get("PodCliqueSet", "default", standing_name)
             if pcs_obj is not None:
                 pcs_obj.spec.replicas += 10 * scale_dir
                 scale_dir = -scale_dir
                 store.update(pcs_obj)
                 scale_events += 1
-        if b >= 0 and int(vnow / crash_every) != int(
-            (vnow - batch_dt) / crash_every
-        ):
+        if crossed(update_every):
+            # rolling update IN the stream: flip a small CANARY
+            # workload's template (cpu request), changing its hash — the
+            # replica-at-a-time / pod-at-a-time rollout then runs to
+            # completion inside the batch settle while arrivals keep
+            # flowing. The canary is deliberately small: the simulated
+            # kubelet makes pods ready instantly, so settle() drives a
+            # whole rollout to its fixpoint within one batch, and a
+            # full-standing-fleet rollout would blow the harness round
+            # budget rather than model anything realistic.
+            canary = f"{standing_name}-canary"
+            pcs_obj = store.get("PodCliqueSet", "default", canary)
+            if pcs_obj is None:
+                h.apply(_churn_pcs(canary, 2))  # born; first FLIP counts
+            else:
+                c = pcs_obj.spec.template.cliques[0].spec.pod_spec.containers[0]
+                cur = c.resources.get("cpu", 1.0)
+                c.resources = dict(c.resources, cpu=(
+                    1.05 if cur == 1.0 else 1.0
+                ))
+                store.update(pcs_obj)
+                updates += 1  # a real template change -> rollout ran
+        if crossed(crash_every):
             if crashed is not None:
                 h.kubelet.recover_pod("default", crashed)
                 crashed = None
@@ -732,6 +758,7 @@ def churn_workload(
         "deleted_before_bind": deleted_before_bind,
         "scale_events": scale_events,
         "crashes": crashes,
+        "updates": updates,
         "unbound_final": len(pending),
         "p50_bind_seconds": round(pct(0.50), 4),
         "p99_bind_seconds": round(pct(0.99), 4),

@@ -229,6 +229,7 @@ class TestIncrementality:
         stats = bench_mod.churn_workload(
             h, rate=16.0, duration=8.0, batch_dt=0.5, population=24,
             warmup_batches=1, scale_every=3.0, crash_every=2.5,
+            update_every=3.0,
         )
         assert stats["created"] == 16 * 8
         assert stats["unbound_final"] == 0
@@ -239,6 +240,7 @@ class TestIncrementality:
         assert stats["deleted"] > 0
         assert stats["scale_events"] >= 1
         assert stats["crashes"] >= 1
+        assert stats["updates"] >= 1  # rolling update advanced in-stream
         assert stats["p99_bind_seconds"] > 0
         # the plane quiesced: no leftover pending work
         from grove_tpu.api.types import Pod
