@@ -43,3 +43,17 @@ def test_operations_tour_runs(capsys):
     out = capsys.readouterr().out
     assert "service Debug probe" in out
     assert "ROTATED listener (rotations=1)" in out
+
+
+def test_readme_quickstart_runs_verbatim():
+    """The README's Quickstart block is executed exactly as printed —
+    a rotted snippet is the first thing a new user hits."""
+    import pathlib
+    import re
+
+    readme = (
+        pathlib.Path(__file__).resolve().parent.parent / "README.md"
+    ).read_text()
+    m = re.search(r"## Quickstart\n\n```python\n(.*?)```", readme, re.S)
+    assert m is not None, "README lost its Quickstart python block"
+    exec(compile(m.group(1), "README-quickstart", "exec"), {})
