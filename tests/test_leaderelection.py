@@ -138,3 +138,63 @@ def test_failover_with_in_flight_solve_dispatch():
     assert standby.cluster.metrics.counter(
         "grove_scheduler_gangs_scheduled_total"
     ).total() >= 1
+
+
+def test_randomized_ha_interleavings_never_split_brain():
+    """Randomized HA fuzz (CI-sized; a 20x40 sweep ran clean offline):
+    two managers over one store, random interleaving of which replica
+    runs, lease expiries, and workload ops. At no step may both hold the
+    lease, and after a final expiry + settles everything binds."""
+    import numpy as np
+
+    import bench as bench_mod
+    from grove_tpu.cluster import make_nodes
+
+    HA_CFG = {"leader_election": {"enabled": True,
+                                  "lease_duration_seconds": 15.0}}
+    for seed in (0, 5, 11):
+        rng = np.random.default_rng(seed)
+        a = Harness(
+            nodes=make_nodes(
+                20, allocatable={"cpu": 16.0, "memory": 64.0, "tpu": 8.0}
+            ),
+            config=dict(HA_CFG),
+        )
+        b = Harness(cluster=a.cluster)
+        alive = []
+        for step in range(25):
+            op = rng.choice(
+                ["apply", "delete", "scale", "runA", "runB", "expire"]
+            )
+            if op == "apply" and len(alive) < 4:
+                name = f"ha{seed}-{step}"
+                a.store.create(bench_mod._churn_pcs(name, 1))
+                alive.append(name)
+            elif op == "delete" and alive:
+                victim = alive.pop(int(rng.integers(0, len(alive))))
+                a.store.delete("PodCliqueSet", "default", victim)
+            elif op == "scale" and alive:
+                t = alive[int(rng.integers(0, len(alive)))]
+                pcs = a.store.get("PodCliqueSet", "default", t)
+                if pcs is not None and pcs.metadata.deletion_timestamp is None:
+                    pcs.spec.replicas = int(rng.integers(1, 4))
+                    a.store.update(pcs)
+            elif op == "runA":
+                a.manager.run_once()
+                a.kubelet.tick()
+            elif op == "runB":
+                b.manager.run_once()
+                b.kubelet.tick()
+            elif op == "expire":
+                a.clock.advance(float(rng.integers(8, 20)))
+            assert not (
+                a.elector.is_leader() and b.elector.is_leader()
+            ), f"split brain at seed {seed} step {step}"
+        a.clock.advance(30.0)
+        a.settle()
+        b.settle()
+        a.settle()
+        pods = a.store.scan(Pod.KIND)
+        assert all(p.node_name for p in pods), (
+            f"seed {seed}: unbound pods after final settles"
+        )
