@@ -44,6 +44,15 @@ class ControllerConfig:
     sync_retry_interval_seconds: float = 5.0
     settle_max_rounds: int = 256
     harness_max_rounds: int = 64
+    # Error-retry flow control (replaces the old fixed error interval):
+    # a failing (controller, request) requeues on exponential backoff with
+    # deterministic jitter; when one request burns through its retry
+    # budget, the controller's circuit breaker opens and its work parks
+    # for a cool-down of error_backoff_max_seconds (degraded state) before
+    # a half-open probe.
+    error_backoff_base_seconds: float = 1.0
+    error_backoff_max_seconds: float = 60.0
+    error_retry_budget: int = 8
 
 
 @dataclass
@@ -210,6 +219,23 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         v = getattr(cc, f)
         if not _int(v) or v < 1:
             errs.append(f"config.controllers.{f}: must be an int >= 1")
+    if not _num(cc.error_backoff_base_seconds) or cc.error_backoff_base_seconds <= 0:
+        errs.append(
+            "config.controllers.error_backoff_base_seconds: must be > 0"
+        )
+    if not _num(cc.error_backoff_max_seconds) or (
+        _num(cc.error_backoff_base_seconds)
+        and cc.error_backoff_base_seconds > 0
+        and cc.error_backoff_max_seconds < cc.error_backoff_base_seconds
+    ):
+        errs.append(
+            "config.controllers.error_backoff_max_seconds: must be >= "
+            "error_backoff_base_seconds"
+        )
+    if not _int(cc.error_retry_budget) or cc.error_retry_budget < 1:
+        errs.append(
+            "config.controllers.error_retry_budget: must be an int >= 1"
+        )
 
     sv = cfg.solver
     for f in ("top_k", "commit_chunk", "gang_bucket_minimum"):

@@ -1,0 +1,19 @@
+"""Deterministic chaos: seeded fault plans + a fault-injecting store proxy
++ the driver that runs a control plane through them (see plan.py,
+store.py, harness.py; docs/operations.md "Fault tolerance & chaos
+testing")."""
+
+from .harness import ChaosHarness, check_invariants, settled_fingerprint
+from .plan import FaultPlan
+from .store import ChaosStore, ConflictStorm, ManagerCrash, TransientFault
+
+__all__ = [
+    "ChaosHarness",
+    "ChaosStore",
+    "ConflictStorm",
+    "FaultPlan",
+    "ManagerCrash",
+    "TransientFault",
+    "check_invariants",
+    "settled_fingerprint",
+]

@@ -1,0 +1,241 @@
+"""ChaosHarness: drive a control plane to convergence THROUGH a fault plan.
+
+Builds a normal `controller.Harness` whose manager, reconcilers and
+scheduler all see the store through a `ChaosStore` (the kubelet and the
+test driver keep the raw store — chaos models the operator's apiserver
+view). `run_chaos()` then interleaves manager rounds, kubelet ticks and
+plan-scheduled infrastructure faults — manager crash-restarts (including
+mid-reconcile, via the ManagerCrash signal raised from inside a committed
+write), kubelet tick stalls, clock jumps and forced event-log compaction —
+for `plan.chaos_steps` steps, disarms, and settles to the recovered
+fixpoint.
+
+The convergence contract (tests/test_chaos.py): after faults stop, the
+workload-level fingerprint — which objects exist, which pods are bound and
+ready, per-clique ready counts, per-PCS availability, every status error
+cleared — must be IDENTICAL to a fault-free run of the same workload, and
+the capacity/orphan invariants the fuzz suite checks must hold. Node
+assignment is deliberately outside the fingerprint: a fault-displaced
+solve may legally pick a different (equally valid) placement.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api.types import Node, Pod, PodClique, PodCliqueSet
+from ..cluster.cluster import Cluster
+from ..controller import Harness
+from .plan import FaultPlan
+from .store import ChaosStore, ManagerCrash
+
+_TERMINAL = ("Failed", "Succeeded")
+
+
+def settled_fingerprint(store) -> dict[str, Any]:
+    """Workload-level convergence fingerprint of a settled store. Chaos
+    and fault-free runs of the same workload must produce EQUAL
+    fingerprints; placement (node names) and bookkeeping that legitimately
+    differs under faults (event counts, leases, resource versions) are
+    excluded."""
+    pods = {}
+    for p in store.scan(Pod.KIND):
+        pods[(p.metadata.namespace, p.metadata.name)] = (
+            bool(p.node_name),
+            p.status.phase.value,
+            p.status.ready,
+            len(p.spec.scheduling_gates),
+            p.metadata.deletion_timestamp is not None,
+        )
+    cliques = {}
+    for c in store.scan(PodClique.KIND):
+        cliques[(c.metadata.namespace, c.metadata.name)] = (
+            c.status.replicas,
+            c.status.ready_replicas,
+            c.status.scheduled_replicas,
+            len(c.status.last_errors),
+        )
+    sets = {}
+    for s in store.scan(PodCliqueSet.KIND):
+        sets[(s.metadata.namespace, s.metadata.name)] = (
+            s.status.replicas,
+            s.status.available_replicas,
+            len(s.status.last_errors),
+            s.status.last_operation.state
+            if s.status.last_operation is not None
+            else None,
+        )
+    counts = {
+        kind: n
+        for kind, n in store.object_counts().items()
+        if kind not in ("Event", "Lease")
+    }
+    return {"pods": pods, "cliques": cliques, "sets": sets, "counts": counts}
+
+
+def check_invariants(store) -> list[str]:
+    """The fuzz suite's global invariants, returned as violations instead
+    of asserted (shared by tests and scripts/chaos_sweep.py): no ACTIVE
+    pod bound to a missing node, no node over capacity."""
+    violations: list[str] = []
+    nodes = {n.metadata.name: n for n in store.scan(Node.KIND)}
+    usage: dict[str, dict[str, float]] = {}
+    for p in store.scan(Pod.KIND):
+        active = (
+            p.metadata.deletion_timestamp is None
+            and p.status.phase.value not in _TERMINAL
+        )
+        if not (p.node_name and active):
+            continue
+        if p.node_name not in nodes:
+            violations.append(
+                f"active pod {p.metadata.name} bound to lost node "
+                f"{p.node_name}"
+            )
+            continue
+        u = usage.setdefault(p.node_name, {})
+        for res, amt in p.spec.total_requests().items():
+            u[res] = u.get(res, 0.0) + amt
+    for name, node in nodes.items():
+        for res, used in usage.get(name, {}).items():
+            if used > node.allocatable.get(res, 0.0) + 1e-6:
+                violations.append(
+                    f"node {name} over-committed on {res}: {used}"
+                )
+    return violations
+
+
+class ChaosHarness:
+    """A `Harness` with a ChaosStore spliced between the cluster and
+    every controller, plus the driver loop that schedules manager/kubelet/
+    clock faults. The underlying harness is `self.harness`; `store` /
+    `clock` / `manager` / `apply` / `settle` / `advance` delegate so
+    existing workload builders work unchanged. The raw (fault-free) store
+    stays reachable as `self.raw_store` for assertions and fixtures."""
+
+    def __init__(self, plan: FaultPlan, nodes: list[Node] | None = None,
+                 config=None, engine_cls=None):
+        from ..api.config import load_operator_config
+
+        if isinstance(config, dict):
+            config = load_operator_config(config)
+        cluster = Cluster(nodes=nodes, config=config)
+        self.raw_store = cluster.store
+        self.chaos_store = ChaosStore(
+            cluster.store, plan, metrics=cluster.metrics
+        )
+        # every consumer wired AFTER this point (manager, reconcilers,
+        # scheduler, incremental usage accounting) reads through chaos;
+        # the kubelet was bound to the raw store in Cluster.__init__
+        cluster.store = self.chaos_store
+        self.harness = Harness(cluster=cluster, engine_cls=engine_cls)
+        self.plan = plan
+        self.manager_restarts = 0
+
+    # -- harness delegation ------------------------------------------------
+    @property
+    def store(self):
+        return self.harness.store
+
+    @property
+    def clock(self):
+        return self.harness.clock
+
+    @property
+    def manager(self):
+        return self.harness.manager
+
+    @property
+    def kubelet(self):
+        return self.harness.kubelet
+
+    @property
+    def config(self):
+        return self.harness.config
+
+    def apply(self, pcs):
+        return self.harness.apply(pcs)
+
+    def settle(self, max_rounds: int | None = None) -> None:
+        self.harness.settle(max_rounds)
+
+    def advance(self, seconds: float) -> None:
+        self.harness.advance(seconds)
+
+    # -- the chaotic loop --------------------------------------------------
+    def _record(self, fault_type: str) -> None:
+        """Driver-level fault bookkeeping: same plan count + metrics
+        counter the ChaosStore uses for store-level faults, so
+        grove_chaos_faults_injected_total totals the WHOLE fault log."""
+        self.plan.record(fault_type)
+        self.harness.cluster.metrics.counter(
+            "grove_chaos_faults_injected_total",
+            "chaos faults injected by type",
+        ).inc(type=fault_type)
+
+    def restart_manager(self) -> None:
+        """Operator process crash-restart: a brand-new manager (event
+        cursor 0 — it replays the log, or relists past a compaction
+        horizon) and brand-new reconcilers (every in-memory cache —
+        scheduler reservations, expectation marks — rebuilt from the
+        store), over the same chaos-wrapped store."""
+        self.manager_restarts += 1
+        if self.harness.cluster.metrics is not None:
+            self.harness.cluster.metrics.counter(
+                "grove_chaos_manager_restarts_total",
+                "chaos-injected manager crash-restarts",
+            ).inc()
+        self.harness._build_manager()
+
+    def run_chaos(self) -> None:
+        """The chaos phase: `plan.chaos_steps` driver steps of manager
+        rounds + kubelet ticks with faults arriving, then disarm and
+        settle to the recovered fixpoint (`settle_recovered`)."""
+        plan = self.plan
+        h = self.harness
+        self.chaos_store.armed = True
+        try:
+            for _ in range(plan.chaos_steps):
+                if plan.flip(plan.manager_crash_rate):
+                    self._record("manager_crash")
+                    self.restart_manager()
+                if plan.flip(plan.clock_jump_rate):
+                    self._record("clock_jump")
+                    h.clock.advance(
+                        plan.uniform(1.0, plan.clock_jump_max_seconds)
+                    )
+                if plan.flip(plan.compaction_rate):
+                    self.chaos_store.force_compaction()
+                stalled = plan.flip(plan.kubelet_stall_rate)
+                if stalled:
+                    self._record("kubelet_stall")
+                try:
+                    h.manager.run_once()
+                except ManagerCrash:
+                    self.restart_manager()
+                if not stalled:
+                    h.kubelet.tick()
+                # give backoff requeues a chance to fire mid-chaos
+                h.clock.advance(plan.step_seconds)
+        finally:
+            self.chaos_store.armed = False
+        self.settle_recovered()
+
+    def settle_recovered(self, max_iters: int = 64) -> None:
+        """Post-fault convergence: settle, then fire every near-term
+        requeue (error backoff chains, breaker cool-downs, scheduler
+        retries) by advancing the virtual clock requeue-by-requeue.
+        Long-range timers (gang termination hours out) are left pending —
+        a fault-free run leaves the identical timers."""
+        h = self.harness
+        horizon = h.config.controllers.error_backoff_max_seconds * 2 + 1
+        h.settle()
+        for _ in range(max_iters):
+            nxt = h.manager.next_requeue_at()
+            if nxt is None or nxt - h.clock.now() > horizon:
+                return
+            h.advance(nxt - h.clock.now() + 1e-3)
+        raise RuntimeError(
+            "chaos recovery did not drain its retry timers in "
+            f"{max_iters} hops (errors: {h.manager.errors[-3:]})"
+        )

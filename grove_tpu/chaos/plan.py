@@ -1,0 +1,105 @@
+"""Seeded, fully deterministic fault schedules.
+
+The reference delegates fault tolerance to Kubernetes machinery (workqueue
+rate limiters, `ERR_REQUEUE_AFTER` flow control) and its E2E suites fight
+eventual consistency with `Eventually()` polling. grove_tpu's control
+plane is deterministic, so infrastructure failure can be swept the same
+way workload interleavings are: a `FaultPlan` is one seeded RNG plus a set
+of per-fault rates, every fault decision is a draw from that RNG against
+the single-threaded op sequence, and the whole chaotic run — every
+transient write failure, conflict storm, stale read, delayed event batch,
+forced compaction, manager crash, kubelet stall and clock jump — replays
+bit-identically from the seed.
+
+`FaultPlan.from_seed(seed)` derives a per-seed MIX: each rate is scaled by
+an independent draw so different seeds emphasize different failure classes
+(one seed is a conflict storm, another is mostly crash-restarts), which is
+what makes a seed sweep a real search instead of the same storm repeated.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FaultPlan:
+    """One deterministic chaos schedule. Rates are probabilities per
+    intercepted store op (write/read/event faults) or per driver step
+    (manager crash, kubelet stall, clock jump, compaction). `counts`
+    records every injected fault by type — the run's reproducible fault
+    log, and the assertion hook for "chaos actually did something"."""
+
+    seed: int = 0
+    #: virtual seconds the driver advances per chaos step (lets backoff
+    #: requeues fire WHILE faults are still arriving)
+    step_seconds: float = 2.0
+    #: driver steps in the chaos phase
+    chaos_steps: int = 40
+
+    # store-level faults (per intercepted op, operator-identity writes)
+    write_fault_rate: float = 0.08
+    conflict_burst_rate: float = 0.01
+    conflict_burst_length: int = 4
+    stale_read_rate: float = 0.05
+    #: events newer than this many seqs behind the head may be hidden
+    #: from a stale read (how far an informer cache can lag)
+    stale_lag_events: int = 50
+    event_delay_rate: float = 0.05
+    #: how many events_since calls a delivery hold lasts
+    event_delay_reads: int = 3
+
+    # driver-level faults (per chaos step)
+    manager_crash_rate: float = 0.05
+    #: per-write probability that the manager dies right AFTER the write
+    #: commits (the classic crash-between-write-and-ack window)
+    midflight_crash_rate: float = 0.01
+    kubelet_stall_rate: float = 0.1
+    clock_jump_rate: float = 0.05
+    clock_jump_max_seconds: float = 120.0
+    compaction_rate: float = 0.05
+
+    counts: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.seed)
+
+    @classmethod
+    def from_seed(cls, seed: int, **overrides) -> "FaultPlan":
+        """Derive a per-seed fault mix: every rate scaled by an
+        independent draw in [0.25, 1.75] from a dedicated mix RNG (so the
+        runtime draw sequence stays aligned across plans regardless of the
+        mix). Explicit keyword overrides win."""
+        # a str seed hashes via sha512 (process-independent); a tuple
+        # would go through hash() and PYTHONHASHSEED-randomize
+        mix = random.Random(f"grove-chaos-mix-{seed}")
+        scaled = {
+            name: getattr(cls, "__dataclass_fields__")[name].default
+            * (0.25 + 1.5 * mix.random())
+            for name in (
+                "write_fault_rate", "conflict_burst_rate",
+                "stale_read_rate", "event_delay_rate",
+                "manager_crash_rate", "midflight_crash_rate",
+                "kubelet_stall_rate", "clock_jump_rate", "compaction_rate",
+            )
+        }
+        scaled.update(overrides)
+        return cls(seed=seed, **scaled)
+
+    # -- decision draws ----------------------------------------------------
+    def flip(self, rate: float) -> bool:
+        return self.rng.random() < rate
+
+    def uniform(self, lo: float, hi: float) -> float:
+        return lo + (hi - lo) * self.rng.random()
+
+    def record(self, fault_type: str) -> int:
+        """Count an injected fault; returns the new per-type count."""
+        n = self.counts.get(fault_type, 0) + 1
+        self.counts[fault_type] = n
+        return n
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.counts.values())

@@ -31,12 +31,19 @@ from .store import ObjectStore, StoreError
 def parse_wait_for(value: str) -> list[tuple[str, int]]:
     """'pclq-a:2,pclq-b:1' -> [(pclq-a, 2), (pclq-b, 1)] — the same
     dependency grammar the reference passes to grove-initc as
-    --podcliques=<fqn>:<minAvailable> (pod/initcontainer.go:155)."""
+    --podcliques=<fqn>:<minAvailable> (pod/initcontainer.go:155).
+    Raises ValueError on a malformed entry (non-integer minAvailable,
+    or no ':' separator at all); SimKubelet treats that barrier as
+    unsatisfiable rather than letting the tick die (see _barrier_open)."""
     out = []
     for part in value.split(","):
         if not part:
             continue
-        fqn, _, min_s = part.rpartition(":")
+        fqn, sep, min_s = part.rpartition(":")
+        if not sep or not fqn:
+            raise ValueError(
+                f"malformed wait-for entry {part!r}: want <fqn>:<minAvailable>"
+            )
         out.append((fqn, int(min_s)))
     return out
 
@@ -54,6 +61,9 @@ class SimKubelet:
         # keyed by pod UID: a replacement pod reusing a hole-filled NAME
         # must start clean, exactly like a fresh pod in a real cluster
         self._crashed: set[str] = set()
+        #: pod UIDs whose malformed wait-for annotation was already
+        #: surfaced as a Warning event (once per pod, not per tick)
+        self._warned_barriers: set[str] = set()
         #: namespace -> {sa: granted rules}, rebuilt lazily per tick
         self._authz_cache: dict[str, dict[str, set[str]]] = {}
         self._cursor = 0
@@ -278,6 +288,27 @@ class SimKubelet:
         spec = pod.metadata.annotations.get(constants.ANNOTATION_WAIT_FOR, "")
         if not spec:
             return True
+        try:
+            deps = parse_wait_for(spec)
+        except ValueError as exc:
+            # A malformed annotation (hand-edited pod, or a buggy writer)
+            # must not kill the kubelet tick for every OTHER pod on the
+            # node: the barrier is simply unsatisfiable — the pod stays
+            # Pending/NotReady, a Warning event says why (once), and a
+            # corrected annotation self-heals on a later tick.
+            if pod.metadata.uid not in self._warned_barriers:
+                self._warned_barriers.add(pod.metadata.uid)
+                from ..observability.events import (
+                    EventRecorder,
+                    REASON_INVALID_STARTUP_BARRIER,
+                )
+
+                EventRecorder(self.store, controller="kubelet").warning(
+                    pod,
+                    REASON_INVALID_STARTUP_BARRIER,
+                    f"unsatisfiable startup barrier {spec!r}: {exc}",
+                )
+            return False
         ns = pod.metadata.namespace
         sa = pod.spec.service_account_name
         if sa:
@@ -286,7 +317,7 @@ class SimKubelet:
                 grants = self._authz_cache[ns] = self.store.read_grants(ns)
             if "pods:watch" not in grants.get(sa, ()):
                 return False  # Forbidden: cannot observe parents
-        for pclq_fqn, min_available in parse_wait_for(spec):
+        for pclq_fqn, min_available in deps:
             ready = sum(
                 1
                 for p in self.store.scan(

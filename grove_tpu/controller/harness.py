@@ -56,22 +56,37 @@ class Harness:
                 lease_duration_seconds=le.lease_duration_seconds,
             )
         self.elector = elector
+        self._engine_cls = engine_cls
+        self._build_manager()
+
+    def _build_manager(self) -> None:
+        """(Re)build the manager + a fresh set of reconcilers over the
+        SAME store. Called once from __init__ — and again by the chaos
+        harness to model an operator process crash-restart: a new manager
+        starts with event cursor 0 (replaying, or relisting past a
+        compaction horizon) and reconcilers rebuild every in-memory cache
+        from the store, exactly like a restarted operator binary."""
+        cc = self.config.controllers
         self.manager = ControllerManager(
             self.store,
             identity=self.config.authorization.operator_identity,
-            error_retry_seconds=(
-                self.config.controllers.sync_retry_interval_seconds
-            ),
+            error_backoff_base_seconds=cc.error_backoff_base_seconds,
+            error_backoff_max_seconds=cc.error_backoff_max_seconds,
+            error_retry_budget=cc.error_retry_budget,
             logger=self.cluster.logger.with_name("manager"),
             metrics=self.cluster.metrics,
-            elector=elector,
+            elector=self.elector,
         )
         self.manager.register(
             PodCliqueSetReconciler(self.store, config=self.config)
         )
         self.manager.register(PCSGReconciler(self.store))
-        self.manager.register(PodCliqueReconciler(self.store))
-        kwargs = {"engine_cls": engine_cls} if engine_cls else {}
+        self.manager.register(
+            PodCliqueReconciler(
+                self.store, retry_seconds=cc.sync_retry_interval_seconds
+            )
+        )
+        kwargs = {"engine_cls": self._engine_cls} if self._engine_cls else {}
         self.scheduler = GangScheduler(self.cluster, **kwargs)
         self.manager.register(self.scheduler)
         from .autoscaler import Autoscaler

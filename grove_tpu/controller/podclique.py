@@ -33,7 +33,12 @@ from ..cluster.store import Event, ObjectStore, _shallow
 from .common import is_pod_active, is_pod_healthy, new_meta, stable_hash
 from .concurrency import run_with_slow_start
 from ..observability.events import EventRecorder, REASON_CREATE_SUCCESSFUL
-from .errors import GroveError, clear_status_errors, record_status_error
+from .errors import (
+    ERR_SYNC_FAILED,
+    GroveError,
+    clear_status_errors,
+    record_status_error,
+)
 from .runtime import Request, Result
 
 KIND = PodClique.KIND
@@ -43,8 +48,11 @@ class PodCliqueReconciler:
     name = "podclique"
     watch_kinds = frozenset((KIND, Pod.KIND, PodGang.KIND))
 
-    def __init__(self, store: ObjectStore):
+    def __init__(self, store: ObjectStore, retry_seconds: float = 5.0):
         self.store = store
+        #: pacing for the gated-pods self-requeue (see reconcile): the
+        #: sync_retry_interval_seconds the harness wires through
+        self.retry_seconds = retry_seconds
         self.recorder = EventRecorder(store, controller=self.name)
         #: clique keys whose next reconcile must run the pod component
         #: (_sync_pods: diff/replace/gates). The generation-change
@@ -189,22 +197,39 @@ class PodCliqueReconciler:
         key = (request.namespace, request.name)
         pods_dirty = key in self._pods_dirty
         self._pods_dirty.discard(key)
-        pclq = self.store.peek(KIND, request.namespace, request.name)
-        if pclq is None:
-            return Result()
-        if pclq.metadata.deletion_timestamp is not None:
-            return self._reconcile_delete(pclq)
-        self.store.add_finalizer(
-            KIND, request.namespace, request.name, constants.FINALIZER_PCLQ
-        )
-        if pods_dirty:
-            try:
+        try:
+            pclq = self.store.peek(KIND, request.namespace, request.name)
+            if pclq is None:
+                return Result()
+            if pclq.metadata.deletion_timestamp is not None:
+                return self._reconcile_delete(pclq)
+            self.store.add_finalizer(
+                KIND, request.namespace, request.name,
+                constants.FINALIZER_PCLQ
+            )
+            if pods_dirty:
                 self._sync_pods(pclq)
-            except Exception:
-                # error-interval retry must re-run the pod component
+            gated = self._reconcile_status(pclq)
+        except BaseException:
+            # The retry (backoff requeue, or a relist after a manager
+            # crash) must re-run the pod component. Guarding only
+            # _sync_pods lost the dirty bit when add_finalizer or the
+            # status flow raised — the retry then ran the cheap path,
+            # "succeeded", and the clique starved with zero pods.
+            if pods_dirty:
                 self._pods_dirty.add(key)
-                raise
-        self._reconcile_status(pclq)
+            raise
+        if gated:
+            # A pod still gated means _remove_gates deferred on state that
+            # may have been a stale read (gang not visible yet, base gang
+            # not Scheduled yet). Waiting ONLY for the next watch event
+            # starves when the state already changed before this reconcile
+            # consumed its event — so a gated pod always arms the retry
+            # timer, and the retry re-runs the pod component. (The count
+            # rides along from _reconcile_status's single pod pass — no
+            # second owned-pods scan on this per-pod-event hot path.)
+            self._pods_dirty.add(key)
+            return Result(requeue_after=self.retry_seconds)
         return Result()
 
     def _reconcile_delete(self, pclq: PodClique) -> Result:
@@ -293,6 +318,18 @@ class PodCliqueReconciler:
         free_indices = [i for i in range(pclq.spec.replicas + len(active) + count)
                         if i not in used][:count]
         pcs = self._owner_pcs(pclq)
+        if pcs is None and pclq.metadata.labels.get(constants.LABEL_PART_OF):
+            # The owning PCS not being visible is informer lag (or a
+            # racing cascade delete), never a license to build pods from
+            # a None template context — that would silently drop the
+            # startup-barrier annotation and identity env. Fail the
+            # reconcile; the backoff retry re-reads (or finds the clique
+            # itself gone).
+            raise GroveError(
+                ERR_SYNC_FAILED,
+                f"podclique:{pclq.metadata.namespace}/{pclq.metadata.name}",
+                "owning PodCliqueSet not visible; deferring pod builds",
+            )
         sg_num_pods = self._pcsg_template_num_pods(pclq, pcs)
         ctx = self._pod_template_ctx(pclq, pcs, sg_num_pods)
         # slow-start pacing (utils/concurrent.go:72-105): a failing
@@ -588,16 +625,18 @@ class PodCliqueReconciler:
                 self._mark_own()
 
     # -- status flow (reconcilestatus.go) ----------------------------------
-    def _reconcile_status(self, pclq: PodClique) -> None:
+    def _reconcile_status(self, pclq: PodClique) -> int:
         """Reads live state (peeks); the write goes through patch_status —
         the status flow runs on every reconcile for every clique, so the
         full-object get() clone here dominated settle at 10^3-clique
-        scale."""
+        scale. Returns the ACTIVE gated-pod count (computed in the same
+        single pod pass) so reconcile's gated-pod retry timer needs no
+        second owned-pods scan."""
         fresh = self.store.peek(
             KIND, pclq.metadata.namespace, pclq.metadata.name
         )
         if fresh is None:
-            return
+            return 0
         # single pass over the (small) pod list: this flow runs for every
         # clique on every enqueued round at 10^3-clique scale
         pods = []
@@ -665,7 +704,7 @@ class PodCliqueReconciler:
             and cur.selector
             == f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
         ):
-            return
+            return gated
 
         def mutate(status):
             status.replicas = len(pods)
@@ -705,6 +744,7 @@ class PodCliqueReconciler:
         self.store.patch_status(
             KIND, fresh.metadata.namespace, fresh.metadata.name, mutate
         )
+        return gated
 
     def _track_rollout(self, pclq: PodClique, status, pods: list[Pod]) -> None:
         """Per-clique rolling-update status parity (podclique.go:104-137):

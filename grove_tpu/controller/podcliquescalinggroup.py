@@ -24,7 +24,12 @@ from ..api.types import (
 from ..cluster.store import Event, ObjectStore, clone
 from .common import base_labels, new_meta
 from .podcliqueset import _shallow_spec
-from .errors import GroveError, clear_status_errors, record_status_error
+from .errors import (
+    ERR_SYNC_FAILED,
+    GroveError,
+    clear_status_errors,
+    record_status_error,
+)
 from .runtime import Request, Result
 
 KIND = PodCliqueScalingGroup.KIND
@@ -259,7 +264,16 @@ class PCSGReconciler:
     def _sync_podcliques(self, pcsg: PodCliqueScalingGroup) -> None:
         pcs = self._owner_pcs(pcsg)
         if pcs is None:
-            return
+            # A live PCSG always has an owning PCS; not seeing it is
+            # informer lag (or a racing cascade delete). Returning
+            # silently here starves the member cliques forever when no
+            # later event re-enqueues this PCSG — fail the reconcile and
+            # let the backoff retry re-read.
+            raise GroveError(
+                ERR_SYNC_FAILED,
+                f"pcsg:{pcsg.metadata.namespace}/{pcsg.metadata.name}",
+                "owning PodCliqueSet not visible; deferring clique sync",
+            )
         ns = pcsg.metadata.namespace
         fqn = pcsg.metadata.name
         pcs_name = pcs.metadata.name

@@ -62,6 +62,15 @@ from .runtime import Request, Result
 
 KIND = PodCliqueSet.KIND
 
+
+def _min_requeue(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    """Earliest of two optional requeue delays."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
 #: child kinds whose events map to the owning PCS via the part-of label
 #: (built from the classes' KIND attributes so a kind-string change can
 #: never desync this from watch_kinds)
@@ -220,15 +229,15 @@ class PodCliqueSetReconciler:
         key = (request.namespace, request.name)
         spec_dirty = key in self._spec_dirty
         self._spec_dirty.discard(key)
-        pcs = self.store.get(KIND, request.namespace, request.name)
-        if pcs is None:
-            return Result()
-        if pcs.metadata.deletion_timestamp is not None:
-            return self._reconcile_delete(pcs)
-        self.store.add_finalizer(
-            KIND, request.namespace, request.name, constants.FINALIZER_PCS
-        )
         try:
+            pcs = self.store.get(KIND, request.namespace, request.name)
+            if pcs is None:
+                return Result()
+            if pcs.metadata.deletion_timestamp is not None:
+                return self._reconcile_delete(pcs)
+            self.store.add_finalizer(
+                KIND, request.namespace, request.name, constants.FINALIZER_PCS
+            )
             if spec_dirty:
                 requeue = self._reconcile_spec(pcs)
             else:
@@ -240,13 +249,19 @@ class PodCliqueSetReconciler:
                 if self._sync_rolling_update(pcs):
                     self._sync_podcliques(pcs)
                     self._sync_pcsgs(pcs)
-                    self._sync_podgangs(pcs)
-        except Exception:
-            # the manager retries on the error interval; the spec flow
-            # must re-run then, not silently degrade to the status flow
-            self._spec_dirty.add(key)
+                    requeue = _min_requeue(
+                        requeue, self._sync_podgangs(pcs)
+                    )
+            self._reconcile_status(pcs)
+        except BaseException:
+            # the retry (backoff requeue, or relist after a manager
+            # crash) must re-run the spec flow, not silently degrade to
+            # the status flow — and the bit must survive failures OUTSIDE
+            # the spec flow too (add_finalizer, the status write), or one
+            # transient store fault swallows the pending spec work
+            if spec_dirty:
+                self._spec_dirty.add(key)
             raise
-        self._reconcile_status(pcs)
         return Result(requeue_after=requeue)
 
     # -- delete flow (reconciledelete.go) ----------------------------------
@@ -283,7 +298,7 @@ class PodCliqueSetReconciler:
         self._sync_rolling_update(pcs)
         self._sync_podcliques(pcs)
         self._sync_pcsgs(pcs)
-        self._sync_podgangs(pcs)
+        requeue = _min_requeue(requeue, self._sync_podgangs(pcs))
         return requeue
 
     def _process_generation_hash(self, pcs: PodCliqueSet) -> None:
@@ -675,7 +690,15 @@ class PodCliqueSetReconciler:
                 self.store.delete(PodCliqueScalingGroup.KIND, ns, pcsg.metadata.name)
 
     # -- podgang component (components/podgang/syncflow.go) ----------------
-    def _sync_podgangs(self, pcs: PodCliqueSet) -> None:
+    def _sync_podgangs(self, pcs: PodCliqueSet) -> Optional[float]:
+        """Returns a requeue delay when any gang's creation was DEFERRED
+        on an incomplete pod inventory. The deferral used to rely purely
+        on a future pod event to re-trigger the flow — which starves
+        forever when the inventory only LOOKED incomplete (a stale/lagging
+        cache read: the pods exist, their events are already consumed).
+        Deferring now always arms the retry timer, the same
+        self-requeue-on-expectation-miss contract the reference gets from
+        its expectations store + ERR_REQUEUE_AFTER."""
         ns, name = pcs.metadata.namespace, pcs.metadata.name
         levels = (
             self._topology_levels()
@@ -687,6 +710,7 @@ class PodCliqueSetReconciler:
             base_labels(name),
             **{constants.LABEL_COMPONENT: constants.COMPONENT_PODGANG},
         )
+        deferred = False
         for gang_name, (replica, spec, extra_labels) in expected.items():
             pods_by_group = {}
             complete = True
@@ -714,7 +738,9 @@ class PodCliqueSetReconciler:
                 ]
             existing = self.store.peek(PodGang.KIND, ns, gang_name)
             if not complete:
-                continue  # syncflow.go:443-447: creation deferred
+                if existing is None:
+                    deferred = True  # re-examine on the timer, not only
+                continue             # on events (syncflow.go:443-447)
             for group in spec.pod_groups:
                 group.pod_references = pods_by_group[group.name]
             if existing is None:
@@ -738,6 +764,13 @@ class PodCliqueSetReconciler:
         for gang in self.store.scan(PodGang.KIND, namespace=ns, labels=comp_labels):
             if gang.metadata.name not in expected:
                 self.store.delete(PodGang.KIND, ns, gang.metadata.name)
+        if not deferred:
+            return None
+        # the timer-fired retry must re-run the SPEC flow (the status-only
+        # flow never reaches this component), or the requeue re-examines
+        # nothing
+        self._spec_dirty.add((ns, name))
+        return self.config.controllers.sync_retry_interval_seconds
 
     def _compute_expected_podgangs(self, pcs: PodCliqueSet, levels: dict[str, str]):
         """name -> (pcs_replica, PodGangSpec, extra labels). Base gangs per

@@ -21,10 +21,19 @@ from __future__ import annotations
 import heapq
 import itertools
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from ..cluster.store import Event, ObjectStore, StoreError
+
+#: circuit-breaker states (exposed via breaker_state()/metrics: the gauge
+#: reads 0.0 closed, 0.5 half-open, 1.0 open)
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0.0, BREAKER_HALF_OPEN: 0.5,
+                  BREAKER_OPEN: 1.0}
 
 
 @dataclass(frozen=True, slots=True)
@@ -61,7 +70,9 @@ class Reconciler(Protocol):
 
 class ControllerManager:
     def __init__(self, store: ObjectStore, identity: str | None = None,
-                 error_retry_seconds: float = 5.0, logger=None,
+                 error_backoff_base_seconds: float = 1.0,
+                 error_backoff_max_seconds: float = 60.0,
+                 error_retry_budget: int = 8, logger=None,
                  metrics=None, elector=None):
         self.store = store
         #: optional LeaderElector (manager.go:98-104): a manager that does
@@ -77,8 +88,22 @@ class ControllerManager:
         #: impersonating it so the store's authorization hook can gate
         #: managed-resource mutation to the operator (+ exempt actors).
         self.identity = identity
-        #: requeue delay after a reconcile raises (ERR_REQUEUE_AFTER flow)
-        self.error_retry_seconds = error_retry_seconds
+        #: error-retry flow control (replaces the old fixed error interval,
+        #: the reference's default-rate-limiter exponential backoff): a
+        #: failing (controller, request) requeues at
+        #: min(max, base * 2^(attempt-1)) scaled by deterministic jitter,
+        #: and a request that exhausts the retry budget trips the
+        #: controller's circuit breaker (degraded state: work parks for a
+        #: cool-down of error_backoff_max_seconds, then one half-open
+        #: probe decides recovery vs re-open)
+        self.error_backoff_base_seconds = error_backoff_base_seconds
+        self.error_backoff_max_seconds = error_backoff_max_seconds
+        self.error_retry_budget = error_retry_budget
+        #: consecutive-failure count per (controller, request); success
+        #: resets its entry, so the dict stays bounded by live failures
+        self._attempts: dict[tuple[str, Request], int] = {}
+        #: controller name -> {"state", "opened_at"} (absent = closed)
+        self._breakers: dict[str, dict] = {}
         #: observability.Logger (config.log); None = silent
         self.logger = logger
         self.controllers: list[Reconciler] = []
@@ -179,6 +204,81 @@ class ControllerManager:
     def next_requeue_at(self) -> Optional[float]:
         return self._requeues[0][0] if self._requeues else None
 
+    def _push_requeue(self, at: float, cname: str, req: Request) -> None:
+        heapq.heappush(
+            self._requeues, (at, next(self._tiebreak), cname, req)
+        )
+
+    # -- error backoff + circuit breaker -----------------------------------
+    def _backoff_delay(self, cname: str, req: Request, attempts: int) -> float:
+        """min(cap, base * 2^(attempt-1)) scaled by DETERMINISTIC jitter in
+        [0.75, 1.0): a stable hash of (controller, request, attempt), so a
+        replayed simulation requeues at identical virtual times while
+        distinct requests still de-synchronize (no thundering herd on one
+        shared retry tick). Jitter >= 0.75 keeps the gap sequence strictly
+        growing (2 * 0.75 > 1) until it pins at the cap."""
+        # exponent clamped: attempts grows without bound on a permanent
+        # failure, and 2.0**~1075 overflows float — the min() with the cap
+        # makes anything past 2^63 indistinguishable anyway
+        nominal = self.error_backoff_base_seconds * (
+            2.0 ** min(attempts - 1, 63)
+        )
+        crc = zlib.crc32(
+            f"{cname}/{req.namespace}/{req.name}/{attempts}".encode()
+        )
+        return min(
+            self.error_backoff_max_seconds,
+            nominal * (0.75 + 0.25 * crc / 0xFFFFFFFF),
+        )
+
+    def breaker_state(self, cname: str) -> str:
+        """BREAKER_CLOSED / BREAKER_OPEN / BREAKER_HALF_OPEN for a
+        controller (public: debug dumps + tests read this, not the
+        internal dict)."""
+        br = self._breakers.get(cname)
+        return br["state"] if br is not None else BREAKER_CLOSED
+
+    def _controller_max_attempts(self, cname: str) -> float:
+        """Deepest live retry chain for a controller (the
+        grove_manager_backoff_depth gauge's documented meaning — one
+        request's success must not zero the gauge while another request's
+        chain is still deep)."""
+        return float(max(
+            (a for (c, _r), a in self._attempts.items() if c == cname),
+            default=0,
+        ))
+
+    def _set_breaker(self, cname: str, state: str, opened_at: float) -> None:
+        if state == BREAKER_CLOSED:
+            self._breakers.pop(cname, None)
+        else:
+            self._breakers[cname] = {"state": state, "opened_at": opened_at}
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "grove_manager_breaker_state",
+                "per-controller circuit breaker (0 closed, 0.5 half-open, "
+                "1 open)",
+            ).set(_BREAKER_GAUGE[state], controller=cname)
+
+    def resilience_snapshot(self) -> dict:
+        """Retry/breaker introspection for observability.debug: per
+        controller the breaker state plus how many requests are in a
+        retry chain and the deepest chain's attempt count."""
+        per: dict[str, dict] = {}
+        for (cname, _req), attempts in self._attempts.items():
+            entry = per.setdefault(
+                cname, {"retrying_requests": 0, "max_attempts": 0}
+            )
+            entry["retrying_requests"] += 1
+            entry["max_attempts"] = max(entry["max_attempts"], attempts)
+        for cname in self._breakers:
+            per.setdefault(
+                cname, {"retrying_requests": 0, "max_attempts": 0}
+            )
+        for cname, entry in per.items():
+            entry["breaker"] = self.breaker_state(cname)
+        return per
+
     # -- public introspection (consumed by observability.debug; the
     # controller-runtime workqueue-metrics analog). Keep debug surfaces on
     # these, not on _-prefixed internals, so a runtime refactor can't
@@ -277,6 +377,21 @@ class ControllerManager:
             ).set(float(len(batch)))
         for cname, req in batch:
             controller = by_name[cname]
+            # Circuit breaker: an OPEN controller runs nothing — its work
+            # parks on the requeue heap until the cool-down elapses, then
+            # the first request through is the half-open probe (success
+            # closes the breaker, failure re-opens it for another
+            # cool-down). Degraded state, not abandonment: parked requests
+            # always re-fire.
+            br = self._breakers.get(cname)
+            if br is not None and br["state"] == BREAKER_OPEN:
+                reopen = br["opened_at"] + self.error_backoff_max_seconds
+                if self.store.clock.now() >= reopen:
+                    self._set_breaker(cname, BREAKER_HALF_OPEN,
+                                      br["opened_at"])
+                else:
+                    self._push_requeue(reopen, cname, req)
+                    continue
             t0 = time.perf_counter() if m is not None else 0.0
             failed = False
             try:
@@ -302,13 +417,84 @@ class ControllerManager:
                     )
                 recorder = getattr(controller, "record_error", None)
                 if recorder is not None:
-                    if self.identity is not None:
-                        with self.store.impersonate(self.identity):
+                    # status recording is best-effort: a store that is
+                    # ALSO failing (transient apiserver fault) must not
+                    # escalate a retryable reconcile error into a manager
+                    # crash — the retry will re-record
+                    try:
+                        if self.identity is not None:
+                            with self.store.impersonate(self.identity):
+                                recorder(req, err)
+                        else:
                             recorder(req, err)
-                    else:
-                        recorder(req, err)
-                result = Result(requeue_after=self.error_retry_seconds)
+                    except Exception as rec_exc:
+                        if self.logger is not None:
+                            self.logger.error(
+                                "error recording failed", controller=cname,
+                                namespace=req.namespace, name=req.name,
+                                error=str(rec_exc),
+                            )
+                key = (cname, req)
+                attempts = self._attempts.get(key, 0) + 1
+                self._attempts[key] = attempts
+                if m is not None:
+                    m.counter(
+                        "grove_manager_reconcile_retries_total",
+                        "error-retry requeues per controller",
+                    ).inc(controller=cname)
+                    m.gauge(
+                        "grove_manager_backoff_depth",
+                        "consecutive-failure depth of the controller's "
+                        "deepest live retry chain",
+                    ).set(self._controller_max_attempts(cname),
+                          controller=cname)
+                state = self.breaker_state(cname)
+                if state == BREAKER_HALF_OPEN or (
+                    attempts >= self.error_retry_budget
+                    and state != BREAKER_OPEN
+                ):
+                    # budget exhausted — or ANY failure while half-open
+                    # (the probe request need not be the one that tripped
+                    # the breaker; a fresh request's first failure must
+                    # re-open it just the same): open the breaker — the
+                    # controller is degraded
+                    self._set_breaker(
+                        cname, BREAKER_OPEN, self.store.clock.now()
+                    )
+                    if m is not None:
+                        m.counter(
+                            "grove_manager_breaker_opens_total",
+                            "circuit-breaker opens per controller",
+                        ).inc(controller=cname)
+                    if self.logger is not None:
+                        self.logger.error(
+                            "circuit breaker opened", controller=cname,
+                            attempts=attempts,
+                            cooldown_seconds=self.error_backoff_max_seconds,
+                        )
+                result = Result(
+                    requeue_after=self._backoff_delay(cname, req, attempts)
+                )
                 failed = True
+            if not failed:
+                key = (cname, req)
+                if self._attempts.pop(key, None) is not None and m is not None:
+                    # re-derive, don't zero: another request's chain may
+                    # still be live and deeper
+                    m.gauge(
+                        "grove_manager_backoff_depth",
+                        "consecutive-failure depth of the controller's "
+                        "deepest live retry chain",
+                    ).set(self._controller_max_attempts(cname),
+                          controller=cname)
+                if self.breaker_state(cname) != BREAKER_CLOSED:
+                    # the half-open probe (or any reconcile racing it)
+                    # succeeded: the controller recovered
+                    self._set_breaker(cname, BREAKER_CLOSED, 0.0)
+                    if self.logger is not None:
+                        self.logger.info(
+                            "circuit breaker closed", controller=cname,
+                        )
             if m is not None:
                 m.counter(
                     "grove_manager_reconcile_total",

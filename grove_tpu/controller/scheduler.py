@@ -369,6 +369,7 @@ class GangScheduler:
         examine = dirty | self._starved
         backlog_keys: list[tuple[str, str]] = []
         dirty_scheduled: list[PodGang] = []
+        blocked_pending = False
         pod_bucket = self.store.kind_bucket(Pod.KIND)
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
@@ -379,6 +380,22 @@ class GangScheduler:
                     dirty_scheduled.append(gang)
             elif self._gang_ready_to_schedule(gang, pod_bucket=pod_bucket):
                 backlog_keys.append(key)
+            elif self._any_referenced_pod_bound(gang, pod_bucket):
+                # a PENDING gang with bound referenced pods is a committed
+                # bind whose Scheduled ack was lost (the manager died — or
+                # the status write failed — between bind_pod and
+                # patch_status): re-derive the condition from pod state,
+                # and let the best-effort rebind path fill any pods a
+                # partial bind left behind
+                self._repair_scheduled(gang)
+                dirty_scheduled.append(gang)
+            else:
+                # a pending gang blocked on pod/gate state: the event that
+                # unblocks it may already be BEHIND this reconcile (a
+                # stale/lagging read falsified readiness while the event
+                # was consumed) — waiting on events alone starves, so a
+                # blocked pending gang always arms the retry timer
+                blocked_pending = True
         # one preemption attempt per BACKLOG STAY: a gang that left the
         # backlog (deleted, or scheduled elsewhere, or pods gone) gets a
         # fresh attempt on return — and the set cannot leak across gang
@@ -399,7 +416,9 @@ class GangScheduler:
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
             self._update_phases(examine)
-            return Result()
+            return Result(
+                requeue_after=self.retry_seconds if blocked_pending else None
+            )
 
         snapshot = self.cluster.topology_snapshot()
         engine = self._engine_for(snapshot)
@@ -407,7 +426,9 @@ class GangScheduler:
         demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
         sched_fn = self.cluster.pod_scheduling_fn()
 
-        requeue: Optional[float] = None
+        requeue: Optional[float] = (
+            self.retry_seconds if blocked_pending else None
+        )
         if backlog_keys:
             pending, self._pending = self._pending, None
             dispatch = None
@@ -523,6 +544,47 @@ class GangScheduler:
             gang = gangs.get(key)
             if gang is not None:  # _update_phase writes via patch_status
                 self._update_phase(gang, pods)
+
+    def _any_referenced_pod_bound(self, gang: PodGang,
+                                  pod_bucket: dict) -> bool:
+        """True when at least one live referenced pod is bound — for a
+        PENDING gang, the signature of a bind that committed without its
+        Scheduled-condition write (see _repair_scheduled)."""
+        for group in gang.spec.pod_groups:
+            for ref in group.pod_references:
+                pod = pod_bucket.get((ref.namespace, ref.name))
+                if (
+                    pod is not None
+                    and pod.node_name
+                    and pod.metadata.deletion_timestamp is None
+                ):
+                    return True
+        return False
+
+    def _repair_scheduled(self, gang: PodGang) -> None:
+        """Crash-recovery replay of a lost bind ack: stamp Scheduled=True /
+        phase Starting from the observed pod state. Idempotent (condition
+        writes are change-detected); a failure here is a normal reconcile
+        error and retries on backoff."""
+        ns, name = gang.metadata.namespace, gang.metadata.name
+        now = self.store.clock.now()
+
+        def mutate(status):
+            status.phase = PodGangPhase.STARTING
+            set_condition(
+                status.conditions,
+                PodGangConditionType.SCHEDULED.value,
+                "True",
+                reason="Placed",
+                message="bind recovered from bound pod state",
+                now=now,
+            )
+
+        if self.store.patch_status(PodGang.KIND, ns, name, mutate):
+            self._mark_own()
+            self.log.info(
+                "recovered lost bind ack", namespace=ns, gang=name,
+            )
 
     def _has_unbound_referenced_pod(self, gang: PodGang) -> bool:
         for group in gang.spec.pod_groups:

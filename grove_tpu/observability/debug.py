@@ -53,6 +53,15 @@ def manager_dump(manager) -> dict[str, Any]:
         "next_requeue_at": manager.next_requeue_at(),
         "recorded_errors": len(manager.errors),
         "event_cursor": manager.event_cursor,
+        # per-controller error-retry flow control: breaker state + live
+        # retry-chain depth (runtime.resilience_snapshot; empty dict =
+        # nothing retrying, every breaker closed)
+        "resilience": manager.resilience_snapshot(),
+        "backoff": {
+            "base_seconds": manager.error_backoff_base_seconds,
+            "max_seconds": manager.error_backoff_max_seconds,
+            "retry_budget": manager.error_retry_budget,
+        },
         "is_leader": (
             manager.elector.is_leader() if manager.elector is not None
             else True

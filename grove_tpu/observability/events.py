@@ -23,6 +23,7 @@ REASON_PODGANG_SCHEDULED = "PodGangScheduled"
 REASON_PODGANG_UNSCHEDULABLE = "PodGangUnschedulable"
 REASON_GANG_TERMINATED = "PodGangTerminated"
 REASON_RECONCILE_ERROR = "ReconcileError"
+REASON_INVALID_STARTUP_BARRIER = "InvalidStartupBarrier"
 
 
 @dataclass

@@ -1,0 +1,466 @@
+"""Deterministic chaos suite: the convergence contract under
+infrastructure failure.
+
+The fuzz suite (test_fuzz_controlplane.py) sweeps WORKLOAD interleavings;
+this suite sweeps INFRASTRUCTURE failure — transient store faults,
+conflict storms, stale reads, delayed events, forced compaction, manager
+crash-restarts (between and mid-reconcile), kubelet stalls, clock jumps —
+through seeded, bit-reproducible FaultPlans. The contract asserted for
+every shipped seed: once faults stop, the post-fault settle reaches the
+SAME workload-level fixpoint a fault-free run reaches (and the fuzz
+invariants hold), retries observably back off exponentially until the
+configured cap, and a breaker-degraded controller recovers.
+
+A failing seed reproduces exactly:
+    python scripts/chaos_sweep.py --start <seed> --seeds 1
+"""
+
+import io
+
+import pytest
+
+from grove_tpu.api.types import PodCliqueScalingGroupConfig, PodCliqueSet
+from grove_tpu.chaos import (
+    ChaosHarness,
+    ChaosStore,
+    FaultPlan,
+    ManagerCrash,
+    TransientFault,
+    check_invariants,
+    settled_fingerprint,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.controller.runtime import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+)
+
+from test_e2e_basic import clique, simple_pcs
+
+#: the shipped fast seeds (CI-sized; scripts/chaos_sweep.py is the wide
+#: matrix). All verified convergent — a regression on any is a real
+#: robustness break, and the seed reproduces it standalone.
+CHAOS_SEEDS = (0, 3, 7, 9, 21)
+
+NODES = 24
+
+
+def chaos_workload():
+    """Startup ordering + a scaling group: gang create/defer, gates,
+    scaled gangs and RBAC are all on the fault path."""
+    return simple_pcs(
+        cliques=[
+            clique("fe", replicas=2),
+            clique("be", replicas=3, starts_after=["fe"]),
+        ],
+        replicas=2,
+        startup="CliqueStartupTypeExplicit",
+        sgs=[
+            PodCliqueScalingGroupConfig(
+                name="g", clique_names=["be"], replicas=2, min_available=1
+            )
+        ],
+    )
+
+
+def quiet(ch: ChaosHarness) -> ChaosHarness:
+    """Silence the expected fault-storm error logs."""
+    buf = io.StringIO()
+    ch.harness.cluster.logger.stream = buf
+    ch.harness.manager.logger.stream = buf
+    return ch
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The fault-free fixpoint every chaotic run must converge to."""
+    h = Harness(nodes=make_nodes(NODES))
+    h.apply(chaos_workload())
+    h.settle()
+    return settled_fingerprint(h.store)
+
+
+def run_seed(seed: int) -> ChaosHarness:
+    ch = quiet(ChaosHarness(FaultPlan.from_seed(seed),
+                            nodes=make_nodes(NODES)))
+    ch.apply(chaos_workload())
+    ch.run_chaos()
+    return ch
+
+
+@pytest.mark.chaos
+class TestConvergenceContract:
+    @pytest.mark.parametrize("seed", CHAOS_SEEDS)
+    def test_post_fault_settle_matches_fault_free_fixpoint(
+        self, seed, baseline
+    ):
+        ch = run_seed(seed)
+        assert ch.plan.total_injected > 0, (
+            "a chaos seed that injects nothing proves nothing"
+        )
+        assert check_invariants(ch.raw_store) == []
+        fp = settled_fingerprint(ch.raw_store)
+        assert fp == baseline, (
+            f"seed {seed} diverged after faults stopped "
+            f"(faults: {ch.plan.counts})"
+        )
+        # degraded states healed: every breaker closed, no retry chains
+        assert ch.manager.resilience_snapshot() == {}
+        # and the errors surfaced DURING the storm were cleared on
+        # recovery (also covered by the fingerprint's last_errors counts)
+        pcs = ch.raw_store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert pcs.status.last_errors == []
+
+    def test_same_seed_is_bit_reproducible(self):
+        a = run_seed(CHAOS_SEEDS[0])
+        b = run_seed(CHAOS_SEEDS[0])
+        assert a.plan.counts == b.plan.counts
+        assert a.manager_restarts == b.manager_restarts
+        assert settled_fingerprint(a.raw_store) == settled_fingerprint(
+            b.raw_store
+        )
+
+    def test_crash_only_plan_replays_to_identical_state(self, baseline):
+        """Isolates the crash-restart fault: a manager killed between and
+        mid-way through reconciles (every other fault off) must
+        replay/relist to the identical settled state."""
+        plan = FaultPlan.from_seed(
+            1234,
+            write_fault_rate=0.0, conflict_burst_rate=0.0,
+            stale_read_rate=0.0, event_delay_rate=0.0,
+            kubelet_stall_rate=0.0, clock_jump_rate=0.0,
+            manager_crash_rate=0.35, midflight_crash_rate=0.03,
+            compaction_rate=0.15,
+        )
+        ch = quiet(ChaosHarness(plan, nodes=make_nodes(NODES)))
+        ch.apply(chaos_workload())
+        ch.run_chaos()
+        assert ch.manager_restarts > 0, "the plan must actually crash it"
+        assert settled_fingerprint(ch.raw_store) == baseline
+        assert check_invariants(ch.raw_store) == []
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", range(40, 52))
+    def test_wide_seed_matrix(self, seed, baseline):
+        """The in-test slice of the wide sweep (scripts/chaos_sweep.py
+        covers more); excluded from the tier-1 gate by the slow marker."""
+        ch = run_seed(seed)
+        assert check_invariants(ch.raw_store) == []
+        assert settled_fingerprint(ch.raw_store) == baseline
+
+
+class TestBackoff:
+    def _failing_harness(self, **controller_cfg):
+        """A harness whose SCHEDULER permanently fails. The scheduler is
+        the clean probe for requeue timing: it has no record_error hook,
+        so a failure writes nothing back to the store and the retry chain
+        stays single (a status-writing reconciler's own error event
+        enqueues a second, interleaved chain)."""
+        h = Harness(
+            nodes=make_nodes(4),
+            config={"controllers": controller_cfg} if controller_cfg else None,
+        )
+        h.settle()
+        self._original = h.scheduler.reconcile
+        h.scheduler.reconcile = lambda req: (
+            (_ for _ in ()).throw(RuntimeError("permanently failing"))
+        )
+        # a node create is watched ONLY by the scheduler: one chain
+        h.store.create(make_nodes(1, name_prefix="poke")[0])
+        return h
+
+    def test_error_requeue_gaps_grow_exponentially_to_cap(self):
+        """The acceptance criterion: virtual-time gaps between error
+        requeues grow (strictly, jitter notwithstanding) until they pin
+        at error_backoff_max_seconds."""
+        h = self._failing_harness(
+            error_backoff_base_seconds=1.0,
+            error_backoff_max_seconds=60.0,
+            error_retry_budget=100,  # keep the breaker out of this test
+        )
+        gaps = []
+        for _ in range(10):
+            h.settle()
+            nxt = h.manager.next_requeue_at()
+            assert nxt is not None
+            gaps.append(nxt - h.clock.now())
+            h.advance(nxt - h.clock.now() + 1e-6)
+        for earlier, later in zip(gaps, gaps[1:]):
+            assert later >= earlier, gaps
+        # strict growth until the cap region...
+        below_cap = [g for g in gaps if g < 60.0]
+        for earlier, later in zip(below_cap, below_cap[1:]):
+            assert later > earlier, gaps
+        # ...then pinned exactly at the cap
+        assert gaps[0] < 1.01, gaps  # base-sized first retry
+        assert gaps[-1] == 60.0, gaps
+        assert gaps[-2] == 60.0, gaps
+
+    def test_jitter_is_deterministic_and_desynchronizing(self):
+        from grove_tpu.controller.runtime import ControllerManager, Request
+        from grove_tpu.cluster.store import ObjectStore
+
+        m = ControllerManager(ObjectStore())
+        r1 = Request("default", "a")
+        r2 = Request("default", "b")
+        # deterministic: same inputs, same delay
+        assert m._backoff_delay("c", r1, 3) == m._backoff_delay("c", r1, 3)
+        # desynchronizing: distinct requests get distinct delays
+        assert m._backoff_delay("c", r1, 3) != m._backoff_delay("c", r2, 3)
+        # bounded jitter: within [0.75, 1.0) of nominal
+        for attempt in range(1, 6):
+            nominal = 1.0 * 2 ** (attempt - 1)
+            d = m._backoff_delay("c", r1, attempt)
+            assert 0.75 * nominal <= d < nominal * 1.0 + 1e-9
+
+    def test_success_resets_the_retry_chain(self):
+        h = self._failing_harness(error_backoff_base_seconds=1.0,
+                                  error_backoff_max_seconds=60.0)
+        h.settle()
+        h.advance(2.0)  # second failure: chain depth 2+
+        snap = h.manager.resilience_snapshot()
+        assert snap["scheduler"]["max_attempts"] >= 2
+        # heal the reconciler: the next retry succeeds and resets
+        h.scheduler.reconcile = self._original
+        h.advance(10.0)
+        assert h.manager.resilience_snapshot() == {}
+        assert h.cluster.metrics.gauge("grove_manager_backoff_depth").value(
+            controller="scheduler"
+        ) == 0.0
+
+
+class TestCircuitBreaker:
+    def _broken_harness(self, budget=3):
+        h = Harness(
+            nodes=make_nodes(4),
+            config={"controllers": {
+                "error_backoff_base_seconds": 1.0,
+                "error_backoff_max_seconds": 30.0,
+                "error_retry_budget": budget,
+            }},
+        )
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        self._original = h.manager.controllers[0].reconcile
+        h.manager.controllers[0].reconcile = lambda req: (
+            (_ for _ in ()).throw(RuntimeError("down hard"))
+        )
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        pcs.spec.replicas = 2
+        h.store.update(pcs)
+        return h
+
+    def _fail_until_open(self, h, max_hops=10):
+        for _ in range(max_hops):
+            h.settle()
+            if h.manager.breaker_state("podcliqueset") == BREAKER_OPEN:
+                return
+            nxt = h.manager.next_requeue_at()
+            h.advance(nxt - h.clock.now() + 1e-6)
+        raise AssertionError("breaker never opened")
+
+    def test_budget_exhaustion_opens_breaker_and_degrades(self):
+        h = self._broken_harness(budget=3)
+        self._fail_until_open(h)
+        m = h.cluster.metrics
+        assert m.counter("grove_manager_breaker_opens_total").value(
+            controller="podcliqueset"
+        ) == 1
+        assert m.gauge("grove_manager_breaker_state").value(
+            controller="podcliqueset"
+        ) == 1.0
+        snap = h.manager.resilience_snapshot()
+        assert snap["podcliqueset"]["breaker"] == "open"
+        # degraded, not dead: work PARKS on the cool-down instead of
+        # running (other controllers unaffected)
+        reconciles_before = m.counter(
+            "grove_manager_reconcile_total"
+        ).value(controller="podcliqueset")
+        h.advance(5.0)  # within the cool-down
+        assert m.counter("grove_manager_reconcile_total").value(
+            controller="podcliqueset"
+        ) == reconciles_before
+        assert h.manager.pending_requeue_count > 0
+
+    def test_half_open_probe_recovers(self):
+        h = self._broken_harness(budget=3)
+        self._fail_until_open(h)
+        # heal the underlying failure while the breaker is open
+        h.manager.controllers[0].reconcile = self._original
+        cooldown = h.config.controllers.error_backoff_max_seconds
+        h.advance(cooldown + 1.0)  # probe fires, succeeds, breaker closes
+        assert h.manager.breaker_state("podcliqueset") == BREAKER_CLOSED
+        assert h.cluster.metrics.gauge("grove_manager_breaker_state").value(
+            controller="podcliqueset"
+        ) == 0.0
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.status.last_errors == []
+        assert live.status.last_operation.state == "Succeeded"
+
+    def test_fresh_request_failing_half_open_probe_reopens(self):
+        """The probe need not be the request that tripped the breaker: a
+        DIFFERENT request (attempt count 1, far below budget) failing
+        while half-open must re-open it, not leave it stuck half-open
+        with degraded-mode protection off."""
+        from grove_tpu.cluster.store import ObjectStore
+        from grove_tpu.controller.runtime import (
+            ControllerManager,
+            Request,
+            Result,
+        )
+
+        store = ObjectStore()
+        m = ControllerManager(store, error_backoff_base_seconds=1.0,
+                              error_backoff_max_seconds=10.0,
+                              error_retry_budget=2)
+
+        class Flaky:
+            name = "c"
+            watch_kinds = frozenset()
+            healthy = False
+
+            def map_event(self, event):
+                return []
+
+            def reconcile(self, req):
+                if not self.healthy:
+                    raise RuntimeError("down")
+                return Result()
+
+        c = Flaky()
+        m.register(c)
+        m._enqueue("c", Request("d", "a"))
+        m.run_once()  # attempt 1
+        store.clock.advance(2.0)
+        m.run_once()  # attempt 2 = budget: breaker opens
+        assert m.breaker_state("c") == BREAKER_OPEN
+        store.clock.advance(11.0)  # past the cool-down
+        m._enqueue("c", Request("d", "b"))  # FRESH request is the probe
+        m.run_once()
+        assert m.breaker_state("c") == BREAKER_OPEN, (
+            "a failing half-open probe must re-open regardless of the "
+            "probe request's own attempt count"
+        )
+        # and the re-opened breaker still recovers once healthy
+        c.healthy = True
+        store.clock.advance(11.0)
+        m.run_once()
+        assert m.breaker_state("c") == BREAKER_CLOSED
+
+    def test_failed_probe_reopens(self):
+        h = self._broken_harness(budget=3)
+        self._fail_until_open(h)
+        cooldown = h.config.controllers.error_backoff_max_seconds
+        h.advance(cooldown + 1.0)  # probe fires and fails: re-open
+        assert h.manager.breaker_state("podcliqueset") == BREAKER_OPEN
+        assert h.cluster.metrics.counter(
+            "grove_manager_breaker_opens_total"
+        ).value(controller="podcliqueset") == 2
+        # heal; the NEXT cool-down recovers
+        h.manager.controllers[0].reconcile = self._original
+        h.advance(cooldown + 1.0)
+        assert h.manager.breaker_state("podcliqueset") == BREAKER_CLOSED
+
+
+class TestManagerRestart:
+    def test_fresh_manager_replays_to_identical_state(self):
+        h = Harness(nodes=make_nodes(NODES))
+        h.apply(chaos_workload())
+        h.settle()
+        before = settled_fingerprint(h.store)
+        h._build_manager()  # fresh manager, cursor 0: full replay
+        h.settle()
+        assert settled_fingerprint(h.store) == before
+
+    def test_fresh_manager_relists_past_compaction(self):
+        h = Harness(nodes=make_nodes(NODES))
+        h.apply(chaos_workload())
+        h.settle()
+        before = settled_fingerprint(h.store)
+        h.store.compact_events(h.store.last_seq)  # horizon ahead of 0
+        h._build_manager()  # cursor 0 is now behind: 410-Gone relist
+        h.settle()
+        assert settled_fingerprint(h.store) == before
+        assert h.manager.event_cursor >= h.store.compaction_horizon
+
+
+class TestChaosStoreUnit:
+    def _armed(self, plan=None):
+        from grove_tpu.cluster.cluster import Cluster
+
+        c = Cluster(nodes=make_nodes(2))
+        cs = ChaosStore(c.store, plan or FaultPlan(seed=0,
+                                                  write_fault_rate=1.0))
+        cs.armed = True
+        return c, cs
+
+    def test_user_actor_and_lease_exempt(self):
+        from grove_tpu.controller.leaderelection import Lease
+        from grove_tpu.api.meta import ObjectMeta
+
+        c, cs = self._armed()
+        # user-actor writes never fault (fixture setup stays reliable)
+        cs.create(Lease(metadata=ObjectMeta(name="x", namespace="ns")))
+        # operator-identity writes to the Lease kind are also exempt
+        with cs.impersonate("system:serviceaccount:grove-system:op"):
+            lease = cs.get(Lease.KIND, "ns", "x")
+            lease.holder_identity = "op"
+            cs.update(lease)
+
+    def test_operator_writes_fault_and_map_to_conflict(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.controller.errors import to_grove_error
+
+        c, cs = self._armed()
+        with cs.impersonate("system:serviceaccount:grove-system:op"):
+            with pytest.raises(TransientFault) as exc:
+                cs.create(PriorityClass(
+                    metadata=ObjectMeta(name="p", namespace=""), value=1.0
+                ))
+        err = to_grove_error(exc.value, "op")
+        assert err.code == "ERR_STORE_CONFLICT"
+        assert cs.plan.counts["write_fault"] >= 1
+        # nothing committed: the fault fired before the write landed
+        assert cs.get(PriorityClass.KIND, "", "p") is None
+
+    def test_manager_crash_is_not_swallowed_by_recover_panic(self):
+        """ManagerCrash must escape the manager's except-Exception guard:
+        a dead process records nothing and requeues nothing."""
+        assert not issubclass(ManagerCrash, Exception)
+        plan = FaultPlan(seed=0, write_fault_rate=0.0,
+                         conflict_burst_rate=0.0,
+                         midflight_crash_rate=1.0)
+        c, cs = self._armed(plan)
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+
+        with cs.impersonate("system:serviceaccount:grove-system:op"):
+            with pytest.raises(ManagerCrash):
+                cs.create(PriorityClass(
+                    metadata=ObjectMeta(name="p", namespace=""), value=1.0
+                ))
+        # the mid-flight crash fires AFTER the commit: the write survives
+        assert cs.get(PriorityClass.KIND, "", "p") is not None
+
+    def test_delayed_events_truncate_without_gaps(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+
+        plan = FaultPlan(seed=0, event_delay_rate=1.0, event_delay_reads=1)
+        c, cs = self._armed(plan)
+        cursor = cs.last_seq
+        for i in range(4):
+            c.store.create(PriorityClass(
+                metadata=ObjectMeta(name=f"p{i}", namespace=""), value=1.0
+            ))
+        held = cs.events_since(cursor)
+        assert len(held) < 4, "delivery hold must truncate"
+        if held:
+            cursor = held[-1].seq
+        cs.armed = False  # faults stop: delivery resumes with no gap
+        rest = cs.events_since(cursor)
+        assert [e.name for e in held] + [e.name for e in rest] == [
+            f"p{i}" for i in range(4)
+        ], "delayed delivery must never skip an event"

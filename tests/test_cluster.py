@@ -167,6 +167,47 @@ class TestKubelet:
         worker = c.store.get(Pod.KIND, "default", "worker-0")
         assert leader.status.ready and worker.status.ready
 
+    def test_malformed_wait_for_is_unsatisfiable_not_fatal(self):
+        # a malformed minAvailable used to raise out of parse_wait_for and
+        # kill the whole kubelet tick; it must instead hold ONLY that
+        # pod's barrier, warn once, and self-heal on correction
+        from grove_tpu.cluster.kubelet import parse_wait_for
+        from grove_tpu.observability.events import (
+            REASON_INVALID_STARTUP_BARRIER,
+        )
+
+        with pytest.raises(ValueError):
+            parse_wait_for("leader:not-a-number")
+        with pytest.raises(ValueError):
+            parse_wait_for("no-colon-at-all")
+
+        c = Cluster(nodes=make_nodes(2))
+        c.store.create(make_pod("ok", node="node-0", pclq="leader"))
+        c.store.create(make_pod("bad", node="node-1", pclq="worker",
+                                wait_for="leader:not-a-number"))
+        c.kubelet.run_to_quiesce()  # must not raise
+        assert c.store.get(Pod.KIND, "default", "ok").status.ready
+        # the pod starts (containers run) but its barrier never opens
+        bad = c.store.get(Pod.KIND, "default", "bad")
+        assert bad.status.phase == PodPhase.RUNNING
+        assert not bad.status.ready
+        events = [e for e in c.store.list("Event")
+                  if e.reason == REASON_INVALID_STARTUP_BARRIER]
+        assert len(events) == 1 and events[0].type == "Warning"
+        assert "leader:not-a-number" in events[0].message
+        count0 = events[0].count
+        c.kubelet.tick()
+        c.kubelet.tick()
+        events = [e for e in c.store.list("Event")
+                  if e.reason == REASON_INVALID_STARTUP_BARRIER]
+        assert events[0].count == count0, "warned once, not per tick"
+        # corrected annotation self-heals without kubelet intervention
+        pod = c.store.get(Pod.KIND, "default", "bad")
+        pod.metadata.annotations[constants.ANNOTATION_WAIT_FOR] = "leader:1"
+        c.store.update(pod)
+        c.kubelet.run_to_quiesce()
+        assert c.store.get(Pod.KIND, "default", "bad").status.ready
+
     def test_crash_recover_and_evict(self):
         c = Cluster(nodes=make_nodes(1))
         c.store.create(make_pod("p", node="node-0"))

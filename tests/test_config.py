@@ -73,6 +73,60 @@ class TestConfigDecode:
                 {"authorization": {"enabled": True, "operator_identity": ""}}
             )
 
+    def test_backoff_fields_decode_and_defaults(self):
+        cfg = load_operator_config({})
+        assert cfg.controllers.error_backoff_base_seconds == 1.0
+        assert cfg.controllers.error_backoff_max_seconds == 60.0
+        assert cfg.controllers.error_retry_budget == 8
+        cfg = load_operator_config(
+            {"controllers": {"error_backoff_base_seconds": 0.5,
+                             "error_backoff_max_seconds": 30.0,
+                             "error_retry_budget": 3}}
+        )
+        assert cfg.controllers.error_backoff_base_seconds == 0.5
+        assert cfg.controllers.error_backoff_max_seconds == 30.0
+        assert cfg.controllers.error_retry_budget == 3
+
+    def test_backoff_validation(self):
+        with pytest.raises(ValidationError, match="error_backoff_base_seconds"):
+            load_operator_config(
+                {"controllers": {"error_backoff_base_seconds": 0}}
+            )
+        with pytest.raises(ValidationError, match="error_backoff_max_seconds"):
+            load_operator_config(
+                {"controllers": {"error_backoff_base_seconds": 10.0,
+                                 "error_backoff_max_seconds": 5.0}}
+            )
+        with pytest.raises(ValidationError, match="error_retry_budget"):
+            load_operator_config(
+                {"controllers": {"error_retry_budget": 0}}
+            )
+        with pytest.raises(ValidationError, match="error_retry_budget"):
+            load_operator_config(
+                {"controllers": {"error_retry_budget": 2.5}}
+            )
+        # aggregated, decode-style: all three problems in one raise
+        with pytest.raises(ValidationError) as e:
+            load_operator_config(
+                {"controllers": {"error_backoff_base_seconds": -1,
+                                 "error_backoff_max_seconds": "x",
+                                 "error_retry_budget": True}}
+            )
+        assert sum(
+            "error_" in m for m in e.value.errors
+        ) == 3, e.value.errors
+
+    def test_backoff_knobs_reach_manager(self):
+        h = Harness(
+            nodes=make_nodes(2),
+            config={"controllers": {"error_backoff_base_seconds": 2.0,
+                                    "error_backoff_max_seconds": 40.0,
+                                    "error_retry_budget": 4}},
+        )
+        assert h.manager.error_backoff_base_seconds == 2.0
+        assert h.manager.error_backoff_max_seconds == 40.0
+        assert h.manager.error_retry_budget == 4
+
     def test_topology_levels_validation(self):
         with pytest.raises(ValidationError, match="duplicate domain"):
             load_operator_config(

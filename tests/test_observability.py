@@ -104,6 +104,126 @@ class TestErrorSurfacing:
         assert live.status.last_operation.state == "Succeeded"
 
 
+class TestRecordStatusErrorIdempotency:
+    """The anti-livelock guarantee record_status_error's docstring claims:
+    a REPEATING identical error must not re-stamp timestamps (its own
+    status write would otherwise re-trigger the manager forever), while a
+    CHANGED error must."""
+
+    def _failing_harness(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        return h
+
+    def test_repeating_error_does_not_restamp(self):
+        from grove_tpu.controller.errors import (
+            GroveError,
+            record_status_error,
+        )
+
+        h = self._failing_harness()
+        err = GroveError("ERR_SYNC_FAILED", "op", "same failure")
+        record_status_error(h.store, PodCliqueSet.KIND, "default",
+                            "simple1", err)
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        stamped = live.status.last_errors[0].observed_at
+        op_stamped = live.status.last_operation.last_update_time
+        rv = live.metadata.resource_version
+        h.clock.advance(10.0)
+        # identical error later: no timestamp movement, NO status write
+        record_status_error(h.store, PodCliqueSet.KIND, "default",
+                            "simple1", err)
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.status.last_errors[0].observed_at == stamped
+        assert live.status.last_operation.last_update_time == op_stamped
+        assert live.metadata.resource_version == rv, (
+            "identical error must not produce a store write"
+        )
+
+    def test_changed_error_restamps(self):
+        from grove_tpu.controller.errors import (
+            GroveError,
+            record_status_error,
+        )
+
+        h = self._failing_harness()
+        record_status_error(
+            h.store, PodCliqueSet.KIND, "default", "simple1",
+            GroveError("ERR_SYNC_FAILED", "op", "first failure"),
+        )
+        h.clock.advance(10.0)
+        record_status_error(
+            h.store, PodCliqueSet.KIND, "default", "simple1",
+            GroveError("ERR_STORE_CONFLICT", "op", "different failure"),
+        )
+        live = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        assert live.status.last_errors[0].observed_at == h.clock.now()
+        assert live.status.last_errors[0].code == "ERR_STORE_CONFLICT"
+        assert (
+            live.status.last_operation.last_update_time == h.clock.now()
+        )
+
+
+class TestResilienceMetrics:
+    """Backoff/breaker observability: the retry flow feeds the registry
+    and the debug dump (the new resilience families in the text
+    exposition are what an operator alerts on)."""
+
+    def test_retry_metrics_and_exposition(self):
+        h = Harness(nodes=make_nodes(4))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        original = h.manager.controllers[0].reconcile
+        h.manager.controllers[0].reconcile = lambda req: (
+            (_ for _ in ()).throw(RuntimeError("flaky"))
+        )
+        pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
+        pcs.spec.replicas = 2
+        h.store.update(pcs)
+        h.settle()
+        h.advance(2.0)  # one backoff retry fires and fails again
+        m = h.cluster.metrics
+        retries = m.counter("grove_manager_reconcile_retries_total")
+        assert retries.value(controller="podcliqueset") >= 2
+        depth = m.gauge("grove_manager_backoff_depth")
+        assert depth.value(controller="podcliqueset") >= 2
+        dump = h.debug_dump()
+        res = dump["manager"]["resilience"]["podcliqueset"]
+        assert res["breaker"] == "closed"
+        assert res["retrying_requests"] == 1
+        assert res["max_attempts"] >= 2
+        assert dump["manager"]["backoff"]["retry_budget"] == (
+            h.config.controllers.error_retry_budget
+        )
+        text = m.render()
+        assert 'grove_manager_reconcile_retries_total{controller="podcliqueset"}' in text
+        assert "grove_manager_backoff_depth" in text
+        # recovery zeroes the depth gauge and clears the retry chain
+        h.manager.controllers[0].reconcile = original
+        h.advance(h.config.controllers.error_backoff_max_seconds + 1)
+        assert depth.value(controller="podcliqueset") == 0.0
+        assert h.debug_dump()["manager"]["resilience"] == {}
+
+    def test_chaos_fault_metrics_exported(self):
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        ch = ChaosHarness(FaultPlan.from_seed(3), nodes=make_nodes(8))
+        import io
+
+        quiet = io.StringIO()
+        ch.harness.cluster.logger.stream = quiet
+        ch.harness.manager.logger.stream = quiet
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.run_chaos()
+        m = ch.harness.cluster.metrics
+        faults = m.counter("grove_chaos_faults_injected_total")
+        assert faults.total() > 0
+        assert faults.total() == ch.plan.total_injected
+        text = m.render()
+        assert "grove_chaos_faults_injected_total" in text
+
+
 class TestMetrics:
     def test_registry_primitives(self):
         r = MetricsRegistry()
