@@ -53,6 +53,33 @@ class ControllerConfig:
     error_backoff_base_seconds: float = 1.0
     error_backoff_max_seconds: float = 60.0
     error_retry_budget: int = 8
+    # Node lifecycle controller (heartbeat-driven NotReady, eviction
+    # sweeps, gang-aware drain). Disable for pure placement benchmarks
+    # that want zero per-node control-plane overhead.
+    node_monitor_enabled: bool = True
+
+
+@dataclass
+class ClusterConfig:
+    """Node-lifecycle tuning — the kube-controller-manager node-lifecycle
+    flag set (--node-monitor-grace-period / --pod-eviction-timeout) plus
+    the kubelet's nodeLeaseDurationSeconds, re-homed onto the simulated
+    cluster. Consumed by SimKubelet (lease renewal) and the NodeMonitor
+    (NotReady detection, eviction grace, flap damping)."""
+
+    # Heartbeat lease lifetime: a node whose lease lags the freshest
+    # cluster heartbeat by more than this goes NotReady.
+    node_lease_duration_seconds: float = 40.0
+    # NotReady -> pod sweep grace: pods on a NotReady node are only marked
+    # Failed (and replaced elsewhere) after this long, so a flapping node
+    # never causes evictions.
+    pod_eviction_grace_seconds: float = 300.0
+    # A recovered node must renew continuously for this long before it
+    # re-enters the scheduler's candidate set (flap damping). Keep it
+    # above node_lease_duration_seconds: the Ready flip requires a lease
+    # renewed within the lease duration of *now*, so a dead node can
+    # never ride a stale-but-recent lease back to Ready.
+    node_stable_ready_seconds: float = 60.0
 
 
 @dataclass
@@ -124,6 +151,7 @@ class OperatorConfig:
         default_factory=WorkloadDefaultsConfig
     )
     controllers: ControllerConfig = field(default_factory=ControllerConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
@@ -164,6 +192,7 @@ _TYPES = {
     "WorkloadDefaultsConfig": WorkloadDefaultsConfig,
     "LeaderElectionConfig": LeaderElectionConfig,
     "ControllerConfig": ControllerConfig,
+    "ClusterConfig": ClusterConfig,
     "SolverConfig": SolverConfig,
     "AutoscalerConfig": AutoscalerConfig,
     "AuthorizationConfig": AuthorizationConfig,
@@ -235,6 +264,33 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     if not _int(cc.error_retry_budget) or cc.error_retry_budget < 1:
         errs.append(
             "config.controllers.error_retry_budget: must be an int >= 1"
+        )
+    if not isinstance(cc.node_monitor_enabled, bool):
+        errs.append("config.controllers.node_monitor_enabled: must be a bool")
+
+    cl = cfg.cluster
+    if not _num(cl.node_lease_duration_seconds) or cl.node_lease_duration_seconds <= 0:
+        errs.append(
+            "config.cluster.node_lease_duration_seconds: must be > 0"
+        )
+    if not _num(cl.pod_eviction_grace_seconds) or cl.pod_eviction_grace_seconds < 0:
+        errs.append(
+            "config.cluster.pod_eviction_grace_seconds: must be >= 0"
+        )
+    if not _num(cl.node_stable_ready_seconds) or cl.node_stable_ready_seconds <= 0:
+        errs.append(
+            "config.cluster.node_stable_ready_seconds: must be > 0"
+        )
+    elif (
+        _num(cl.node_lease_duration_seconds)
+        and 0 < cl.node_lease_duration_seconds
+        and cl.node_stable_ready_seconds < cl.node_lease_duration_seconds
+    ):
+        errs.append(
+            "config.cluster.node_stable_ready_seconds: must be >= "
+            "node_lease_duration_seconds (the Ready flip requires a lease "
+            "renewed within the lease duration of now; a shorter stable "
+            "window would let a dead node ride a stale lease back to Ready)"
         )
 
     sv = cfg.solver

@@ -43,6 +43,10 @@ ANNOTATION_TOPOLOGY_NAME = "grove.io/topology-name"
 # container as --podcliques args (pod/initcontainer.go:155); consumed by the
 # simulated kubelet instead of an in-pod binary.
 ANNOTATION_WAIT_FOR = "grove.io/wait-for"
+# Stamped by Cluster.drain (alongside the cordon) to mark a node under
+# gang-aware graceful drain; the NodeMonitor paces the evictions and
+# Cluster.uncordon clears it (the kubectl-drain / maintenance analog).
+ANNOTATION_DRAIN = "grove.io/drain"
 
 # --- Scheduling gate (components/pod/pod.go:68) ---
 PODGANG_PENDING_CREATION_GATE = "grove.io/podgang-pending-creation"

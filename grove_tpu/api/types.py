@@ -428,6 +428,34 @@ def sort_topology_levels(levels: list[TopologyLevel]) -> list[TopologyLevel]:
 # Node (simulated kwok-style inventory; stands in for corev1.Node)
 # --------------------------------------------------------------------------
 
+#: corev1.NodeConditionType Ready. status "True" = healthy; "False" =
+#: NotReady (heartbeat lost / infrastructure failure). An ABSENT condition
+#: counts as ready — fresh inventory is schedulable before the first
+#: node-monitor pass, like a node that has not been adopted by the
+#: lifecycle controller yet.
+NODE_CONDITION_READY = "Ready"
+
+
+@dataclass(slots=True)
+class NodeStatus:
+    """Node status subresource: the lifecycle conditions the NodeMonitor
+    maintains (corev1.NodeStatus.conditions analog). Written only through
+    the status path, so condition flips never bump the node generation."""
+
+    conditions: list[Condition] = field(default_factory=list)
+
+
+def node_ready(node: "Node") -> bool:
+    """True unless the Ready condition is explicitly non-True (see
+    NODE_CONDITION_READY). The ONE readiness predicate — the topology
+    encoding (solver candidate set) and the node monitor both use it, so
+    schedulability and lifecycle can never disagree on what NotReady
+    means."""
+    for c in node.status.conditions:
+        if c.type == NODE_CONDITION_READY:
+            return c.status == "True"
+    return True
+
 
 @dataclass(slots=True)
 class Node:
@@ -441,5 +469,6 @@ class Node:
     # honors (operator/api/core/v1alpha1/podclique.go:60-63); grove_tpu owns
     # the scheduler, so the solve paths enforce them directly.
     taints: list[str] = field(default_factory=list)
+    status: NodeStatus = field(default_factory=NodeStatus)
 
     KIND = "Node"

@@ -131,6 +131,17 @@ class ChaosHarness:
         self.harness = Harness(cluster=cluster, engine_cls=engine_cls)
         self.plan = plan
         self.manager_restarts = 0
+        #: node-fault bookkeeping (all repaired at disarm so the
+        #: recovered fixpoint is measured against restored infrastructure)
+        self._flapping: dict[str, int] = {}  # node -> steps until recovery
+        self._hb_lost: set[str] = set()
+        self._outage_domains: list[str] = []
+        self._drained_nodes: list[str] = []
+
+    #: drain storms are capped per run: an unbounded storm could cordon
+    #: the whole inventory out from under the workload, and a drained
+    #: node stays cordoned until disarm
+    DRAIN_STORM_MAX = 2
 
     # -- harness delegation ------------------------------------------------
     @property
@@ -187,10 +198,116 @@ class ChaosHarness:
             ).inc()
         self.harness._build_manager()
 
+    # -- node-lifecycle faults ---------------------------------------------
+    def _live_node_names(self) -> list[str]:
+        return sorted(
+            n.metadata.name
+            for n in self.raw_store.scan(Node.KIND)
+            if n.metadata.deletion_timestamp is None
+        )
+
+    def _inject_node_faults(self) -> None:
+        """Per-step node-lifecycle fault draws (see FaultPlan): flap,
+        silent heartbeat loss, whole-domain outage, drain storm. Targets
+        are drawn from the plan RNG over the sorted live inventory, so a
+        seed replays the same nodes failing in the same order."""
+        from ..cluster.inventory import RACK_KEY
+
+        plan = self.plan
+        cluster = self.harness.cluster
+        names = self._live_node_names()
+        # nodes already under a standing heartbeat-level fault: a flap
+        # expiring on one would restore its heartbeat (recover_node) and
+        # silently heal the heartbeat-loss/outage mid-chaos, breaking
+        # their until-disarm semantics — so neither draw may target them.
+        # The flip+pick RNG draws still run unconditionally: only the
+        # injection is skipped, keeping every seed's draw sequence intact.
+        standing = set(self._flapping) | self._hb_lost
+        if self._outage_domains:
+            outage = set(self._outage_domains)
+            standing |= {
+                n.metadata.name
+                for n in self.raw_store.scan(Node.KIND)
+                if n.metadata.labels.get(RACK_KEY) in outage
+            }
+        if names and plan.flip(plan.node_flap_rate):
+            name = names[plan.pick(len(names))]
+            if name not in standing:
+                self._record("node_flap")
+                cluster.fail_node(name)
+                self._flapping[name] = 1 + plan.pick(3)
+        if names and plan.flip(plan.heartbeat_loss_rate):
+            name = names[plan.pick(len(names))]
+            if name not in standing:
+                self._record("heartbeat_loss")
+                self.kubelet.fail_heartbeat(name)
+                self._hb_lost.add(name)
+        if plan.flip(plan.domain_outage_rate):
+            racks = sorted(
+                {
+                    n.metadata.labels.get(RACK_KEY)
+                    for n in self.raw_store.scan(Node.KIND)
+                    if n.metadata.labels.get(RACK_KEY)
+                }
+                - set(self._outage_domains)
+            )
+            if racks:
+                dom = racks[plan.pick(len(racks))]
+                self._record("domain_outage")
+                cluster.fail_domain(RACK_KEY, dom)
+                self._outage_domains.append(dom)
+        if (
+            plan.flip(plan.drain_storm_rate)
+            and len(self._drained_nodes) < self.DRAIN_STORM_MAX
+        ):
+            candidates = [
+                n for n in names
+                if n not in self._drained_nodes
+                and n not in self._flapping
+                and n not in self._hb_lost
+            ]
+            if candidates:
+                name = candidates[plan.pick(len(candidates))]
+                self._record("drain_storm")
+                cluster.drain(name)
+                self._drained_nodes.append(name)
+
+    def _tick_node_faults(self) -> None:
+        """End-of-step flap timers: expired flaps resume heartbeating
+        (the node then rides the monitor's stable-ready window back in)."""
+        for name in sorted(self._flapping):
+            self._flapping[name] -= 1
+            if self._flapping[name] <= 0:
+                del self._flapping[name]
+                self.harness.cluster.recover_node(name)
+
+    def _repair_infrastructure(self) -> None:
+        """Disarm-time repair: every injected node fault heals (flaps
+        recover, heartbeats resume, outage domains return, drained nodes
+        uncordon) — the convergence contract measures the recovered
+        fixpoint against restored infrastructure, exactly like the store
+        faults stopping."""
+        from ..cluster.inventory import RACK_KEY
+
+        cluster = self.harness.cluster
+        for name in sorted(self._flapping):
+            cluster.recover_node(name)
+        self._flapping.clear()
+        for name in sorted(self._hb_lost):
+            self.kubelet.restore_heartbeat(name)
+        self._hb_lost.clear()
+        for dom in self._outage_domains:
+            cluster.recover_domain(RACK_KEY, dom)
+        self._outage_domains = []
+        for name in self._drained_nodes:
+            cluster.uncordon(name)
+        self._drained_nodes = []
+
     def run_chaos(self) -> None:
         """The chaos phase: `plan.chaos_steps` driver steps of manager
-        rounds + kubelet ticks with faults arriving, then disarm and
-        settle to the recovered fixpoint (`settle_recovered`)."""
+        rounds + kubelet ticks with faults arriving, then disarm, repair
+        the infrastructure, and settle to the recovered fixpoint
+        (`settle_recovered`)."""
         plan = self.plan
         h = self.harness
         self.chaos_store.armed = True
@@ -206,6 +323,7 @@ class ChaosHarness:
                     )
                 if plan.flip(plan.compaction_rate):
                     self.chaos_store.force_compaction()
+                self._inject_node_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")
@@ -215,10 +333,12 @@ class ChaosHarness:
                     self.restart_manager()
                 if not stalled:
                     h.kubelet.tick()
+                self._tick_node_faults()
                 # give backoff requeues a chance to fire mid-chaos
                 h.clock.advance(plan.step_seconds)
         finally:
             self.chaos_store.armed = False
+            self._repair_infrastructure()
         self.settle_recovered()
 
     def settle_recovered(self, max_iters: int = 64) -> None:

@@ -60,6 +60,21 @@ class FaultPlan:
     clock_jump_max_seconds: float = 120.0
     compaction_rate: float = 0.05
 
+    # node-lifecycle faults (per chaos step; the infrastructure axis the
+    # store faults cannot model — see cluster/nodehealth.py):
+    #   node_flap      — a node fails (NotReady + heartbeats stop) and
+    #                    recovers within a few steps
+    #   heartbeat_loss — a node's lease silently stops renewing until the
+    #                    chaos phase disarms (partition/kubelet death)
+    #   domain_outage  — a whole rack goes NotReady in one tick
+    #   drain_storm    — a maintenance drain starts mid-churn (capped at
+    #                    DRAIN_STORM_MAX nodes per run so the workload
+    #                    always keeps enough capacity to converge)
+    node_flap_rate: float = 0.04
+    heartbeat_loss_rate: float = 0.03
+    domain_outage_rate: float = 0.015
+    drain_storm_rate: float = 0.015
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
@@ -77,11 +92,16 @@ class FaultPlan:
         scaled = {
             name: getattr(cls, "__dataclass_fields__")[name].default
             * (0.25 + 1.5 * mix.random())
+            # NOTE: names appended at the END only — the mix draws run in
+            # tuple order, so appending keeps every pre-existing seed's
+            # rates (and therefore its verified convergence) unchanged
             for name in (
                 "write_fault_rate", "conflict_burst_rate",
                 "stale_read_rate", "event_delay_rate",
                 "manager_crash_rate", "midflight_crash_rate",
                 "kubelet_stall_rate", "clock_jump_rate", "compaction_rate",
+                "node_flap_rate", "heartbeat_loss_rate",
+                "domain_outage_rate", "drain_storm_rate",
             )
         }
         scaled.update(overrides)
@@ -93,6 +113,10 @@ class FaultPlan:
 
     def uniform(self, lo: float, hi: float) -> float:
         return lo + (hi - lo) * self.rng.random()
+
+    def pick(self, n: int) -> int:
+        """Deterministic index draw in [0, n) (fault-target selection)."""
+        return self.rng.randrange(n)
 
     def record(self, fault_type: str) -> int:
         """Count an injected fault; returns the new per-type count."""

@@ -1,14 +1,18 @@
-"""Simulated cluster: object store (apiserver), clock, nodes, kubelet."""
+"""Simulated cluster: object store (apiserver), clock, nodes, kubelet,
+node-lifecycle heartbeats."""
 
 from .clock import SimClock
 from .store import Event, ObjectStore, StoreError
 from .inventory import make_nodes
 from .kubelet import SimKubelet
 from .cluster import Cluster
+from .nodehealth import NODE_LEASE_NAMESPACE, NodeLease
 
 __all__ = [
     "Cluster",
     "Event",
+    "NODE_LEASE_NAMESPACE",
+    "NodeLease",
     "ObjectStore",
     "SimClock",
     "SimKubelet",

@@ -96,15 +96,132 @@ class Cluster:
         self._req_cache: dict[int, tuple] = {}
 
     # -- node ops ----------------------------------------------------------
+    #: read-modify-write attempts for node mutations before giving up (a
+    #: conflict storm at the store boundary must not silently lose a
+    #: cordon — each retry re-reads, so the loop is idempotent)
+    NODE_UPDATE_RETRIES = 8
+
+    def _update_node(self, name: str, mutate) -> Node:
+        """Conflict-retrying node update (the same retry discipline the
+        controllers get from the manager's backoff): re-read + mutate +
+        write, retrying transient store failures. Unknown nodes raise
+        NotFound with a clear message instead of an AttributeError deep in
+        the mutator."""
+        from .store import AlreadyExists, Forbidden, NotFound, StoreError
+
+        last: StoreError | None = None
+        for _ in range(self.NODE_UPDATE_RETRIES):
+            node = self.store.get(Node.KIND, "default", name)
+            if node is None:
+                raise NotFound(f"node {name!r} not found")
+            mutate(node)
+            try:
+                return self.store.update(node)
+            except (NotFound, AlreadyExists, Forbidden):
+                raise  # terminal: retrying cannot help
+            except StoreError as exc:  # transient conflict/write fault
+                last = exc
+        raise last  # type: ignore[misc]  # loop ran: last is set
+
     def cordon(self, name: str) -> None:
-        node = self.store.get(Node.KIND, "default", name)
-        node.unschedulable = True
-        self.store.update(node)
+        def mutate(node):
+            node.unschedulable = True
+
+        self._update_node(name, mutate)
 
     def uncordon(self, name: str) -> None:
-        node = self.store.get(Node.KIND, "default", name)
-        node.unschedulable = False
-        self.store.update(node)
+        """Clears the cordon AND any drain mark — returning a node to
+        service is the inverse of both."""
+        from ..api.constants import ANNOTATION_DRAIN
+
+        def mutate(node):
+            node.unschedulable = False
+            node.metadata.annotations.pop(ANNOTATION_DRAIN, None)
+
+        self._update_node(name, mutate)
+
+    def drain(self, name: str) -> None:
+        """Begin a gang-aware graceful drain (the kubectl-drain analog):
+        cordon the node and stamp the drain annotation; the NodeMonitor
+        then evicts its pods no faster than replacements become Ready
+        elsewhere, honoring each clique's MinAvailable, and falls back to
+        whole-gang termination only when a gang cannot be rebuilt on the
+        remaining capacity. Drive the control plane (settle/advance) until
+        node_drained(name) reports True."""
+        from ..api.constants import ANNOTATION_DRAIN
+
+        def mutate(node):
+            node.unschedulable = True
+            node.metadata.annotations[ANNOTATION_DRAIN] = "true"
+
+        self._update_node(name, mutate)
+
+    def node_drained(self, name: str) -> bool:
+        """True when no active pod remains bound to the node."""
+        for pod in self.store.scan(Pod.KIND):
+            if (
+                pod.node_name == name
+                and pod.metadata.deletion_timestamp is None
+                and pod.status.phase
+                not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+            ):
+                return False
+        return True
+
+    def fail_node(self, name: str) -> None:
+        """Infrastructure node failure: heartbeats stop AND the Ready
+        condition flips immediately (the monitor would reach the same
+        state one lease-lag later; stamping it directly gives outage
+        injection its one-tick semantics). Recovery goes through
+        recover_node — the node re-enters the candidate set only after
+        the monitor's stable-ready window."""
+        from .nodehealth import set_node_ready
+        from .store import NotFound
+
+        if self.store.peek(Node.KIND, "default", name) is None:
+            raise NotFound(f"node {name!r} not found")
+        self.kubelet.fail_heartbeat(name)
+        set_node_ready(
+            self.store, name, False, reason="NodeFailed",
+            message="injected node failure", now=self.clock.now(),
+        )
+
+    def recover_node(self, name: str) -> None:
+        """Heartbeats resume; the NodeMonitor flips Ready back after
+        node_stable_ready_seconds of continuous renewal."""
+        self.kubelet.restore_heartbeat(name)
+
+    def fail_domain(self, label_key: str, value: str) -> list[str]:
+        """Failure-domain outage (rack/slice/ICI-domain loss): every node
+        labelled `label_key=value` goes NotReady in one tick and stops
+        heartbeating. Returns the failed node names. The scheduler's
+        candidate set drops the whole domain at the next snapshot, so
+        displaced gangs repair onto healthy domains after the eviction
+        grace."""
+        from .store import NotFound
+
+        names = [
+            n.metadata.name
+            for n in self.store.scan(Node.KIND)
+            if n.metadata.labels.get(label_key) == value
+        ]
+        if not names:
+            raise NotFound(f"no node carries {label_key}={value!r}")
+        for name in names:
+            self.fail_node(name)
+        return names
+
+    def recover_domain(self, label_key: str, value: str) -> list[str]:
+        """Heartbeats resume for every member node (each still waits out
+        the stable-ready window before rejoining the candidate set)."""
+        names = [
+            n.metadata.name
+            for n in self.store.scan(Node.KIND)
+            if n.metadata.labels.get(label_key) == value
+        ]
+        for name in names:
+            self.recover_node(name)
+        return names
 
     # -- solver input ------------------------------------------------------
     @staticmethod

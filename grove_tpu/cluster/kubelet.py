@@ -19,12 +19,22 @@ pod kills as its fault model):
   evict_pod  — pod-level failure (node eviction/OOM): phase Failed, capacity
                released; the pod component replaces the pod.
   recover_pod— crashed containers come back; pod turns Ready again.
+  fail_heartbeat / restore_heartbeat — node-level failure: the node's
+               heartbeat lease stops renewing (partition / kubelet death);
+               the NodeMonitor marks it NotReady once the lease lags and
+               sweeps its pods after the eviction grace. Pods on the node
+               keep their last reported state, like a real partition.
+
+Beyond pod lifecycle, every tick renews one heartbeat Lease per live node
+(cluster/nodehealth.py) — the node-lease controller the k8s node
+lifecycle machinery keys on.
 """
 
 from __future__ import annotations
 
 from ..api import constants
 from ..api.types import Node, Pod, PodPhase
+from .nodehealth import renew_node_lease
 from .store import ObjectStore, StoreError
 
 
@@ -76,6 +86,9 @@ class SimKubelet:
         #: a node that comes back before the tick is spared, preserving
         #: the scan-at-tick-start semantics
         self._nodes_lost: set[str] = set()
+        #: nodes whose heartbeat lease renewal is suppressed (injected
+        #: node failure — partition, kubelet death, domain outage)
+        self._hb_failed: set[str] = set()
 
     @property
     def event_cursor(self) -> int:
@@ -164,6 +177,22 @@ class SimKubelet:
         if pod is not None:
             self._crashed.discard(pod.metadata.uid)
 
+    def fail_heartbeat(self, node_name: str) -> None:
+        """Node-level failure: stop renewing this node's heartbeat lease.
+        The NodeMonitor marks it NotReady once the lease lags the freshest
+        cluster heartbeat by the configured lease duration."""
+        self._hb_failed.add(node_name)
+
+    def restore_heartbeat(self, node_name: str) -> None:
+        """Heartbeats resume next tick; the NodeMonitor readmits the node
+        only after its stable-ready window (flap damping)."""
+        self._hb_failed.discard(node_name)
+
+    @property
+    def heartbeat_failed(self) -> frozenset[str]:
+        """Nodes with suppressed heartbeats (introspection/chaos driver)."""
+        return frozenset(self._hb_failed)
+
     def evict_pod(self, namespace: str, name: str) -> None:
         """Pod-level failure: Failed phase, capacity released; the pod
         component replaces it."""
@@ -185,6 +214,16 @@ class SimKubelet:
         changes = 0
         self._authz_cache.clear()
         self._drain()
+        # heartbeats first: one Lease renewal per live node per clock
+        # instant (renew_node_lease skips nodes already renewed at this
+        # instant, so the many settle rounds per instant write once).
+        # Renewals are deliberately NOT counted in `changes` — a tick that
+        # only heartbeats is quiescent for the settle loop; the manager's
+        # follow-up settle drains the Lease events into the NodeMonitor.
+        now_hb = self.store.clock.now()
+        for node_name in sorted(self._nodes):  # deterministic event order
+            if node_name not in self._hb_failed:
+                renew_node_lease(self.store, node_name, now_hb)
         # the readiness snapshot is the drained state: writes made DURING
         # this tick emit events that only land at the next drain, so
         # membership is exactly "ready as of tick start"

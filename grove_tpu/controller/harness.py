@@ -93,6 +93,16 @@ class Harness:
 
         self.autoscaler = Autoscaler(self.cluster)
         self.manager.register(self.autoscaler)
+        # node lifecycle last: its writes (Ready flips, eviction sweeps,
+        # drain evictions) land as events for the next round's workload
+        # controllers, and a crash-restart rebuilds its stabilization
+        # state conservatively like every other in-memory cache
+        self.node_monitor = None
+        if self.config.controllers.node_monitor_enabled:
+            from .nodemonitor import NodeMonitor
+
+            self.node_monitor = NodeMonitor(self.cluster)
+            self.manager.register(self.node_monitor)
 
     def autoscale(self) -> None:
         """One periodic HPA sweep + settle (the HPA sync interval). The

@@ -76,6 +76,20 @@ class PodCliqueReconciler:
         #: single-threaded store, so store.last_seq right after a write IS
         #: that write's event.
         self._own_events: set[int] = set()
+        #: per-key count of reconciles that found the clique NOT VISIBLE
+        #: while pod work was pending. A just-recreated clique (gang
+        #: restart) can be hidden from peek by informer lag — returning
+        #: success there would eat the dirty bit and starve the clique
+        #: with zero pods (no pod ever exists to emit a wakeup event).
+        #: Bounded: a genuinely deleted clique stops retrying when its
+        #: Deleted event clears the key (map_events), or after
+        #: NOT_VISIBLE_RETRIES at the latest.
+        self._not_visible: dict[tuple[str, str], int] = {}
+
+    #: retries for a dirty-but-not-visible clique before concluding it is
+    #: genuinely gone (each retry is retry_seconds — and many store
+    #: events — later, so a lagging read has long since caught up)
+    NOT_VISIBLE_RETRIES = 3
 
     def record_error(self, request: Request, err: GroveError) -> None:
         """Every kind surfaces its own controller errors
@@ -116,7 +130,16 @@ class PodCliqueReconciler:
                     == event.old.metadata.deletion_timestamp
                 ):
                     continue
-                pods_dirty.add((event.namespace, event.name))
+                key = (event.namespace, event.name)
+                if event.type == "Deleted":
+                    # final store deletion: cleanup already ran in
+                    # _reconcile_delete, so there is nothing to reconcile
+                    # — and the not-visible retry loop (see reconcile)
+                    # must stop now, not at its bound
+                    pods_dirty.discard(key)
+                    self._not_visible.pop(key, None)
+                    continue
+                pods_dirty.add(key)
                 enqueue(name_, Request(event.namespace, event.name))
             elif kind == Pod.KIND:
                 if event.seq in own:
@@ -200,7 +223,21 @@ class PodCliqueReconciler:
         try:
             pclq = self.store.peek(KIND, request.namespace, request.name)
             if pclq is None:
+                # Not visible ≠ deleted: a just-recreated clique (gang
+                # restart) can be hidden by informer lag, and dropping the
+                # dirty bit here starves it at zero pods forever — no pod
+                # exists to ever wake this reconciler again. Restore the
+                # bit and retry on the timer; a genuine deletion ends the
+                # loop via its Deleted event (map_events) or the bound.
+                if pods_dirty:
+                    seen = self._not_visible.get(key, 0)
+                    if seen < self.NOT_VISIBLE_RETRIES:
+                        self._not_visible[key] = seen + 1
+                        self._pods_dirty.add(key)
+                        return Result(requeue_after=self.retry_seconds)
+                self._not_visible.pop(key, None)
                 return Result()
+            self._not_visible.pop(key, None)
             if pclq.metadata.deletion_timestamp is not None:
                 return self._reconcile_delete(pclq)
             self.store.add_finalizer(

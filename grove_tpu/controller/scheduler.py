@@ -173,11 +173,18 @@ class GangScheduler:
                 if gang:
                     dirty.add((event.namespace, gang))
                 if event.type == "Deleted" and event.obj.node_name:
-                    key = (event.namespace, event.name)
-                    vacated.pop(key, None)
-                    if len(vacated) >= self.VACATED_LRU_MAX:
-                        vacated.pop(next(iter(vacated)))
-                    vacated[key] = event.obj.node_name
+                    # only live nodes make useful hints: the node-loss
+                    # sweep deletes pods still "bound" to a vanished
+                    # node, and recording those would re-point the hint
+                    # map at dead capacity right after the purge below
+                    if self.store.peek(
+                        Node.KIND, "default", event.obj.node_name
+                    ) is not None:
+                        key = (event.namespace, event.name)
+                        vacated.pop(key, None)
+                        if len(vacated) >= self.VACATED_LRU_MAX:
+                            vacated.pop(next(iter(vacated)))
+                        vacated[key] = event.obj.node_name
                 queued = True
             elif kind == PodGang.KIND:
                 if event.seq in own:
@@ -185,7 +192,31 @@ class GangScheduler:
                 else:
                     dirty.add((event.namespace, event.name))
                     queued = True
-            elif kind == Node.KIND or kind == ClusterTopology.KIND:
+            elif kind == Node.KIND:
+                if event.type == "Deleted":
+                    # a vanished node must not linger in reservation
+                    # memory: a pod-level vacated hint pointing at it can
+                    # never bind (the node left node_index) but would
+                    # shadow the real prior-node fast path, and a gang
+                    # reservation naming it would trial dead capacity
+                    # every backlog round. Purged IN PLACE: `vacated` is
+                    # an alias bound for this batch, and rebinding the
+                    # attribute would strand later same-batch inserts in
+                    # the discarded dict. Rare event: one O(entries)
+                    # purge, not per-tick cost.
+                    gone = event.name
+                    for k in [
+                        k for k, v in vacated.items() if v == gone
+                    ]:
+                        del vacated[k]
+                    for k in [
+                        k
+                        for k, nodes in self._reservations.items()
+                        if gone in nodes
+                    ]:
+                        del self._reservations[k]
+                queued = True
+            elif kind == ClusterTopology.KIND:
                 queued = True
         if queued:
             enqueue(self.name, _SINGLETON_REQ)
@@ -855,13 +886,16 @@ class GangScheduler:
                 continue
             prio = self._priority_of(pg)
             need = sg.total_demand()
-            # nodes the preemptor could run on at all
+            # nodes the preemptor could run on at all (victims bound to
+            # cordoned/NotReady nodes free capacity the preemptor can
+            # never use — they must not be counted, let alone disturbed)
             if sg.pod_elig is None or any(m is None for m in sg.pod_elig):
-                usable = np.ones(snapshot.num_nodes, dtype=bool)
+                usable = snapshot.schedulable.copy()
             else:
                 usable = np.zeros(snapshot.num_nodes, dtype=bool)
                 for m in sg.pod_elig:
                     usable |= m
+                usable &= snapshot.schedulable
             # capacity buckets: one per domain at the preemptor's required
             # level (freed capacity in the wrong rack cannot satisfy a
             # rack-packed gang); level -1 = one global bucket

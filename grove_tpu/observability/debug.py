@@ -85,12 +85,16 @@ def scheduler_dump(scheduler) -> dict[str, Any]:
 
 def harness_dump(harness) -> dict[str, Any]:
     """The full in-process debug surface (see module docstring)."""
-    return {
+    out = {
         "manager": manager_dump(harness.manager),
         "store": store_dump(harness.store),
         "scheduler": scheduler_dump(harness.scheduler),
         "virtual_clock": harness.clock.now(),
     }
+    monitor = getattr(harness, "node_monitor", None)
+    if monitor is not None:
+        out["node_lifecycle"] = monitor.debug_state()
+    return out
 
 
 def main() -> int:  # pragma: no cover - thin CLI

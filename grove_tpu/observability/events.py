@@ -24,6 +24,12 @@ REASON_PODGANG_UNSCHEDULABLE = "PodGangUnschedulable"
 REASON_GANG_TERMINATED = "PodGangTerminated"
 REASON_RECONCILE_ERROR = "ReconcileError"
 REASON_INVALID_STARTUP_BARRIER = "InvalidStartupBarrier"
+# Node lifecycle (the node-lifecycle controller's event vocabulary).
+REASON_NODE_NOT_READY = "NodeNotReady"
+REASON_NODE_READY = "NodeReady"
+REASON_NODE_PODS_EVICTED = "NodePodsEvicted"
+REASON_NODE_DRAINED = "NodeDrained"
+REASON_DRAIN_GANG_TERMINATED = "DrainGangTerminated"
 
 
 @dataclass

@@ -114,7 +114,17 @@ def pod_eligibility_mask(
 
     The single mask-derivation point for both the backlog encode and the
     scheduler's best-effort singles — eligibility semantics must not
-    diverge between them."""
+    diverge between them.
+
+    Node LIFECYCLE exclusion (cordoned / deleting / NotReady nodes) is NOT
+    folded into these masks: it lives in `snapshot.schedulable`, which
+    encode_topology derives from the same Node objects (including the
+    Ready condition the NodeMonitor maintains) and which every solve path
+    — serial candidates, device free-matrix zeroing, reservation reuse,
+    best-effort singles, preemption trials — applies unconditionally.
+    Keeping the two orthogonal means a single NotReady node can never
+    force per-pod masks onto an otherwise unconstrained backlog (which
+    would knock it off the fast paths cluster-wide)."""
     if scheduling is None:
         return None
     selector, tolerations = scheduling

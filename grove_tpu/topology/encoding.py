@@ -27,6 +27,7 @@ from ..api.types import (
     ClusterTopologySpec,
     Node,
     TopologyLevel,
+    node_ready,
     sort_topology_levels,
 )
 from ..api.meta import ObjectMeta
@@ -220,7 +221,16 @@ def encode_topology(
     for ni, node in enumerate(nodes):
         for ri, r in enumerate(resource_names):
             capacity[ni, ri] = float(node.allocatable.get(r, 0.0))
-        schedulable[ni] = not node.unschedulable and node.metadata.deletion_timestamp is None
+        # Candidate-set membership: cordons, deletion marks AND the
+        # lifecycle Ready condition. NotReady nodes (heartbeat lost,
+        # domain outage, stabilizing after a flap) are excluded here, and
+        # `schedulable` is what every solve path keys its node candidates
+        # on — so displaced gangs can only repair onto healthy domains.
+        schedulable[ni] = (
+            not node.unschedulable
+            and node.metadata.deletion_timestamp is None
+            and node_ready(node)
+        )
 
     snapshot = TopologySnapshot(
         level_keys=level_keys,

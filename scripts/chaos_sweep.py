@@ -129,6 +129,11 @@ def main(argv=None) -> int:
                     help="first seed (default 0)")
     ap.add_argument("--nodes", type=int, default=24,
                     help="cluster size (default 24)")
+    ap.add_argument("--json", dest="json_path", default=None,
+                    metavar="PATH",
+                    help="also write the full sweep matrix (per-seed "
+                         "results + summary) as one JSON document — the "
+                         "CI artifact format")
     args = ap.parse_args(argv)
 
     baseline_h = Harness(nodes=make_nodes(args.nodes))
@@ -136,18 +141,28 @@ def main(argv=None) -> int:
     baseline_h.settle()
     baseline = settled_fingerprint(baseline_h.store)
 
+    results = []
     failed = []
     for seed in range(args.start, args.start + args.seeds):
         result = run_seed(seed, args.nodes, baseline)
         print(json.dumps(result), flush=True)
+        results.append(result)
         if not result["ok"]:
             failed.append(seed)
-    print(json.dumps({
+    summary = {
         "swept": args.seeds,
         "start": args.start,
+        "nodes": args.nodes,
         "failed_seeds": failed,
         "ok": not failed,
-    }), flush=True)
+    }
+    print(json.dumps(summary), flush=True)
+    if args.json_path:
+        with open(args.json_path, "w") as fh:
+            json.dump(
+                {"summary": summary, "results": results}, fh, indent=2
+            )
+            fh.write("\n")
     return 1 if failed else 0
 
 

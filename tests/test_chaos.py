@@ -464,3 +464,72 @@ class TestChaosStoreUnit:
         assert [e.name for e in held] + [e.name for e in rest] == [
             f"p{i}" for i in range(4)
         ], "delayed delivery must never skip an event"
+
+
+class TestStaleCliqueReadStarvation:
+    """Chaos-found (node-fault sweep, seed 6): a clique recreated by the
+    gang-restart flow can be hidden from peek by informer lag exactly
+    when its pod work is pending. Returning success there ate the dirty
+    bit and starved the clique at zero pods — with no pod in existence,
+    no event ever wakes the reconciler again. Not-visible + dirty now
+    retries on the timer with the bit restored; genuine deletions stop
+    the loop via their Deleted event (or the retry bound)."""
+
+    def _reconciler(self):
+        h = Harness(nodes=make_nodes(4))
+        rec = next(
+            c for c in h.manager.controllers if c.name == "podclique"
+        )
+        return h, rec
+
+    def test_not_visible_with_pending_work_restores_dirty_and_retries(self):
+        from grove_tpu.controller.runtime import Request
+
+        h, rec = self._reconciler()
+        key = ("default", "ghost")
+        rec._pods_dirty.add(key)
+        res = rec.reconcile(Request("default", "ghost"))
+        assert res.requeue_after is not None
+        assert key in rec._pods_dirty, "pending pod work must survive"
+
+    def test_retry_is_bounded_for_a_genuinely_gone_clique(self):
+        from grove_tpu.controller.runtime import Request
+
+        h, rec = self._reconciler()
+        key = ("default", "ghost")
+        req = Request("default", "ghost")
+        for _ in range(rec.NOT_VISIBLE_RETRIES):
+            rec._pods_dirty.add(key)
+            assert rec.reconcile(req).requeue_after is not None
+        rec._pods_dirty.add(key)
+        res = rec.reconcile(req)
+        assert res.requeue_after is None, "the loop must terminate"
+        assert key not in rec._not_visible
+
+    def test_deleted_event_stops_the_retry_loop(self):
+        from grove_tpu.api.types import PodClique
+        from grove_tpu.cluster.store import Event
+        from grove_tpu.controller.runtime import Request
+
+        h, rec = self._reconciler()
+        key = ("default", "ghost")
+        rec._pods_dirty.add(key)
+        assert rec.reconcile(Request("default", "ghost")).requeue_after
+        rec.map_event(Event(
+            seq=1, type="Deleted", kind=PodClique.KIND,
+            namespace="default", name="ghost", obj=None,
+        ))
+        assert key not in rec._pods_dirty
+        assert key not in rec._not_visible
+        res = rec.reconcile(Request("default", "ghost"))
+        assert res.requeue_after is None
+
+    def test_visible_again_clears_the_counter_and_syncs(self):
+        """After a lagging read catches up, the retried reconcile runs
+        the pod component and rebuilds the clique's pods."""
+        h, rec = self._reconciler()
+        h.apply(chaos_workload())
+        h.settle()
+        pods = h.store.list("Pod")
+        assert pods and all(p.node_name for p in pods)
+        assert rec._not_visible == {}

@@ -637,7 +637,9 @@ class TestSchedulerLRU:
     OLDEST entry, not the whole map."""
 
     def test_vacated_lru_keeps_hot_entries(self):
-        h = Harness(nodes=make_nodes(4))
+        # 8 real nodes: hints are only recorded for nodes that still
+        # exist (a vanished node makes a useless — and purged — hint)
+        h = Harness(nodes=make_nodes(8))
         sched = h.scheduler
         sched.VACATED_LRU_MAX = 4
         from grove_tpu.cluster.store import Event as Ev
@@ -659,11 +661,14 @@ class TestSchedulerLRU:
                       namespace="default", name=name, obj=pod)
 
         for i in range(4):
-            sched.map_event(deleted(f"p{i}", f"n{i}"))
+            sched.map_event(deleted(f"p{i}", f"node-{i}"))
         # refresh p0 (re-delete): now p1 is the oldest
-        sched.map_event(deleted("p0", "n0-new"))
-        sched.map_event(deleted("p4", "n4"))  # crosses the bound
+        sched.map_event(deleted("p0", "node-5"))
+        sched.map_event(deleted("p4", "node-4"))  # crosses the bound
         keys = {k[1] for k in sched._vacated}
         assert "p1" not in keys, "oldest entry evicted"
         assert keys == {"p0", "p2", "p3", "p4"}
-        assert sched._vacated[("default", "p0")] == "n0-new"
+        assert sched._vacated[("default", "p0")] == "node-5"
+        # a vanished node never enters the hint map
+        sched.map_event(deleted("p9", "gone-node"))
+        assert ("default", "p9") not in sched._vacated

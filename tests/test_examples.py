@@ -36,12 +36,22 @@ def test_example_converges(module, pods, gangs):
 
 
 def test_operations_tour_runs(capsys):
-    """The ops example end to end: service boundary, TLS rotation,
-    introspection surfaces."""
+    """The ops example end to end: node lifecycle walkthrough always;
+    service boundary, TLS rotation and introspection when the optional
+    service dependencies are installed."""
     import operations_tour
 
     operations_tour.main()
     out = capsys.readouterr().out
+    assert "node lifecycle: draining" in out
+    assert "repaired onto healthy racks" in out
+    assert "rack recovered" in out
+    try:
+        import grpc  # noqa: F401
+        from cryptography import x509  # noqa: F401
+    except ImportError:
+        assert "service tour skipped" in out
+        return
     assert "service Debug probe" in out
     assert "ROTATED listener (rotations=1)" in out
 

@@ -8,6 +8,9 @@ import contextlib
 import numpy as np
 import pytest
 
+pytest.importorskip("grpc", reason="service extra not installed")
+pytest.importorskip("cryptography", reason="service extra not installed")
+
 from grove_tpu.service import (
     PlacementService,
     RemotePlacementEngine,
