@@ -136,6 +136,13 @@ def main() -> int:
                     "the FULL path (apply -> pods -> gangs -> scheduler -> "
                     "bound/ready) at the same scale as the solver stress "
                     "config; 0 disables")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="profile this run: write a Chrome trace-event "
+                    "JSON (Perfetto / chrome://tracing loadable) with the "
+                    "engine's encode/device/repair spans and the "
+                    "control-plane benches' reconcile/solve spans. "
+                    "Tracing adds a little overhead — leave unset for "
+                    "record runs (see docs/observability.md)")
     ap.add_argument("--service", action="store_true",
                     help="benchmark the solve THROUGH the placement-service "
                     "gRPC boundary (server spawned as a subprocess on this "
@@ -150,6 +157,11 @@ def main() -> int:
 
     enable_compilation_cache()
     if args.service:
+        if args.trace:
+            ap.error("--trace is not supported with --service: the span "
+                     "tracer is in-process and the service bench drives "
+                     "the solver behind gRPC (trace the in-process paths "
+                     "without --service)")
         return bench_service(args)
     if args.small:
         args.nodes, args.gangs, args.iters = 512, 64, 3
@@ -181,8 +193,22 @@ def main() -> int:
     warm = mk_engine()
     warm.solve(gangs)  # warm-up: compile + caches (not recorded)
 
+    #: --trace: {group label -> Tracer} for the offline Chrome trace;
+    #: each bench section lands as its own Perfetto process, and passing
+    #: the Tracer (not its span list) lets chrome_trace align the
+    #: sections' private perf_counter epochs onto one real time axis
+    trace_groups: dict = {}
+    tracer = None
+    if args.trace:
+        from grove_tpu.observability.tracing import Tracer
+
+        tracer = Tracer()
+        trace_groups["solver"] = tracer
+
     registry = MetricsRegistry()
-    engine = mk_engine(metrics=registry)
+    engine = mk_engine(
+        metrics=registry, **({"tracer": tracer} if tracer else {})
+    )
     # Each iteration is one "bind the whole backlog" event.
     placed = 0
     phase_stats: dict[str, list[float]] = {}
@@ -327,7 +353,10 @@ def main() -> int:
     # reported as cold); see bench_controlplane.
     cp = {}
     if args.cp_replicas > 0:
-        cp = bench_controlplane(args.nodes, args.cp_replicas)
+        cp = bench_controlplane(
+            args.nodes, args.cp_replicas,
+            trace_groups=trace_groups if args.trace else None,
+        )
         # Sustained-churn regime (VERDICT r4 #2): the reference's actual
         # operating claim is a long-lived operator under a continuous
         # event stream, not a one-shot backlog settle — measure steady
@@ -337,6 +366,7 @@ def main() -> int:
                 args.nodes,
                 rate=args.churn_rate,
                 duration=args.churn_duration,
+                trace_groups=trace_groups if args.trace else None,
             )
         )
 
@@ -378,6 +408,14 @@ def main() -> int:
         **({"mesh": dict(mesh.shape)} if args.sharded else {}),
         **cp,
     }
+    if args.trace:
+        from grove_tpu.observability.tracing import chrome_trace
+
+        with open(args.trace, "w") as fh:
+            json.dump(chrome_trace(trace_groups), fh)
+            fh.write("\n")
+        n_spans = sum(len(v.finished) for v in trace_groups.values())
+        print(f"wrote {n_spans} spans to {args.trace}", file=sys.stderr)
     print(json.dumps(out))
     return 0
 
@@ -467,7 +505,9 @@ def bench_service(args) -> int:
             proc.wait(timeout=10)
 
 
-def bench_controlplane(num_nodes: int, replicas: int) -> dict:
+def bench_controlplane(
+    num_nodes: int, replicas: int, trace_groups: dict | None = None
+) -> dict:
     from grove_tpu.api.meta import ObjectMeta as Meta
     from grove_tpu.api.types import (
         Container,
@@ -511,7 +551,11 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
         nodes=make_nodes(
             num_nodes,
             allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
-        )
+        ),
+        config=(
+            {"tracing": {"enabled": True}} if trace_groups is not None
+            else None
+        ),
     )
     t0 = time.perf_counter()
     h.apply(pcs("cpwarm"))
@@ -548,6 +592,8 @@ def bench_controlplane(num_nodes: int, replicas: int) -> dict:
         h.settle()
     runs.sort()
     warm, solve_wall = runs[1]
+    if trace_groups is not None:
+        trace_groups["controlplane"] = h.cluster.tracer
     return {
         "controlplane_replicas": replicas,
         "controlplane_settle_seconds": round(warm, 2),
@@ -801,7 +847,10 @@ def _churn_pcs(name: str, replicas: int = 1):
     )
 
 
-def bench_churn(num_nodes: int, rate: float, duration: float) -> dict:
+def bench_churn(
+    num_nodes: int, rate: float, duration: float,
+    trace_groups: dict | None = None,
+) -> dict:
     """Steady-arrival churn against a warm plane (churn_workload); returns
     churn_*-prefixed fields for the bench JSON line."""
     if duration <= 0:
@@ -814,12 +863,18 @@ def bench_churn(num_nodes: int, rate: float, duration: float) -> dict:
         nodes=make_nodes(
             num_nodes,
             allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
-        )
+        ),
+        config=(
+            {"tracing": {"enabled": True}} if trace_groups is not None
+            else None
+        ),
     )
     h.apply(_churn_pcs("standing", 200 if num_nodes >= 2000 else 10))
     h.settle()
     tune_gc()
     stats = churn_workload(h, rate=rate, duration=duration)
+    if trace_groups is not None:
+        trace_groups["churn"] = h.cluster.tracer
     return {f"churn_{k}": v for k, v in stats.items()}
 
 

@@ -144,6 +144,21 @@ class LogConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Span tracing + flight recorder (observability/tracing.py,
+    docs/observability.md). Off by default: the disabled path is a no-op
+    tracer singleton that allocates nothing, so production/bench hot
+    paths pay ~nothing. When enabled, finished spans land in a bounded
+    ring (max_spans) and a copy of every span + reconcile error + event
+    feeds the flight recorder's postmortem ring
+    (flight_recorder_capacity) — both fixed-memory at any run length."""
+
+    enabled: bool = False
+    max_spans: int = 65536
+    flight_recorder_capacity: int = 4096
+
+
+@dataclass
 class OperatorConfig:
     api_version: str = API_VERSION
     kind: str = KIND
@@ -162,6 +177,7 @@ class OperatorConfig:
         default_factory=LeaderElectionConfig
     )
     log: LogConfig = field(default_factory=LogConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
 
 def _build(cls, data: Any, path: str, errs: list[str]):
@@ -198,6 +214,7 @@ _TYPES = {
     "AuthorizationConfig": AuthorizationConfig,
     "TopologyAwareSchedulingConfig": TopologyAwareSchedulingConfig,
     "LogConfig": LogConfig,
+    "TracingConfig": TracingConfig,
     "OperatorConfig": OperatorConfig,
 }
 
@@ -357,6 +374,16 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append(f"config.log.level: must be one of {_LOG_LEVELS}")
     if cfg.log.format not in _LOG_FORMATS:
         errs.append(f"config.log.format: must be one of {_LOG_FORMATS}")
+
+    tr = cfg.tracing
+    if not isinstance(tr.enabled, bool):
+        errs.append("config.tracing.enabled: must be a bool")
+    if not _int(tr.max_spans) or tr.max_spans < 1:
+        errs.append("config.tracing.max_spans: must be an int >= 1")
+    if not _int(tr.flight_recorder_capacity) or tr.flight_recorder_capacity < 1:
+        errs.append(
+            "config.tracing.flight_recorder_capacity: must be an int >= 1"
+        )
     return errs
 
 

@@ -114,7 +114,8 @@ class ChaosHarness:
     stays reachable as `self.raw_store` for assertions and fixtures."""
 
     def __init__(self, plan: FaultPlan, nodes: list[Node] | None = None,
-                 config=None, engine_cls=None):
+                 config=None, engine_cls=None,
+                 trace_path: str | None = None):
         from ..api.config import load_operator_config
 
         if isinstance(config, dict):
@@ -128,6 +129,17 @@ class ChaosHarness:
         # scheduler, incremental usage accounting) reads through chaos;
         # the kubelet was bound to the raw store in Cluster.__init__
         cluster.store = self.chaos_store
+        # chaos ALWAYS records spans + errors + events into the bounded
+        # flight-recorder ring (observability/tracing.py): a seed that
+        # wedges or diverges leaves a postmortem (dump_flight) instead of
+        # demanding a re-run under print statements. enable_tracing runs
+        # BEFORE Harness so the manager/reconcilers capture the recording
+        # tracer at construction.
+        cluster.enable_tracing()
+        self.flight = cluster.flight
+        #: when set, a failed post-chaos settle auto-dumps the flight
+        #: recorder here (scripts/chaos_sweep.py --trace-dir wires it)
+        self.trace_path = trace_path
         self.harness = Harness(cluster=cluster, engine_cls=engine_cls)
         self.plan = plan
         self.manager_restarts = 0
@@ -346,7 +358,19 @@ class ChaosHarness:
         requeue (error backoff chains, breaker cool-downs, scheduler
         retries) by advancing the virtual clock requeue-by-requeue.
         Long-range timers (gang termination hours out) are left pending —
-        a fault-free run leaves the identical timers."""
+        a fault-free run leaves the identical timers.
+
+        A failed settle (wedged seed) auto-dumps the flight recorder to
+        `trace_path` when one was configured, then re-raises — the
+        postmortem artifact survives the crash."""
+        try:
+            self._settle_recovered(max_iters)
+        except Exception:
+            if self.trace_path:
+                self.dump_flight(self.trace_path)
+            raise
+
+    def _settle_recovered(self, max_iters: int) -> None:
         h = self.harness
         horizon = h.config.controllers.error_backoff_max_seconds * 2 + 1
         h.settle()
@@ -359,3 +383,83 @@ class ChaosHarness:
             "chaos recovery did not drain its retry timers in "
             f"{max_iters} hops (errors: {h.manager.errors[-3:]})"
         )
+
+    # -- postmortem artifact -------------------------------------------------
+    def wedged_summary(self) -> dict[str, Any]:
+        """Name what is stuck RIGHT NOW, from the raw (fault-free) store:
+        gangs that never reached Scheduled, pods that never bound or never
+        went ready, cliques below their replica count — plus the manager's
+        recorded errors, pending work, and the seed's fault log. This is
+        the `wedged` section of the flight-recorder dump: a postmortem
+        opens with the stuck object's name, not a span soup."""
+        from ..api.meta import get_condition
+        from ..api.podgang import PodGang, PodGangConditionType
+
+        unscheduled = []
+        for g in self.raw_store.scan(PodGang.KIND):
+            cond = get_condition(
+                g.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            if cond is None or cond.status != "True":
+                unscheduled.append({
+                    "kind": g.KIND,
+                    "name": f"{g.metadata.namespace}/{g.metadata.name}",
+                    "phase": g.status.phase.value,
+                    "reason": cond.reason if cond is not None else None,
+                    "message": cond.message if cond is not None else None,
+                })
+        stuck_pods = []
+        for p in self.raw_store.scan(Pod.KIND):
+            if p.metadata.deletion_timestamp is not None:
+                continue
+            if p.status.phase.value in _TERMINAL:
+                continue
+            if not p.node_name or not p.status.ready:
+                stuck_pods.append({
+                    "kind": p.KIND,
+                    "name": f"{p.metadata.namespace}/{p.metadata.name}",
+                    "bound": bool(p.node_name),
+                    "phase": p.status.phase.value,
+                    "gates": list(p.spec.scheduling_gates),
+                })
+        lagging_cliques = []
+        for c in self.raw_store.scan(PodClique.KIND):
+            if c.status.ready_replicas < c.spec.replicas:
+                lagging_cliques.append({
+                    "kind": c.KIND,
+                    "name": f"{c.metadata.namespace}/{c.metadata.name}",
+                    "replicas": c.spec.replicas,
+                    "ready": c.status.ready_replicas,
+                    "errors": list(c.status.last_errors),
+                })
+        manager = self.harness.manager
+        return {
+            "seed": self.plan.seed,
+            "virtual_clock": self.clock.now(),
+            "unscheduled_gangs": unscheduled,
+            "stuck_pods": stuck_pods,
+            "lagging_cliques": lagging_cliques,
+            "workqueue": manager.workqueue_snapshot(),
+            "manager_errors": [
+                {"controller": c, "namespace": r.namespace, "name": r.name,
+                 "error": msg}
+                for c, r, msg in manager.errors[-32:]
+            ],
+            "manager_restarts": self.manager_restarts,
+            "faults_injected": dict(sorted(self.plan.counts.items())),
+        }
+
+    def dump_flight(self, path: str | None = None) -> dict[str, Any]:
+        """The chaos postmortem: flight-recorder ring (recent spans +
+        reconcile errors + events) with the wedged-object summary on top.
+        Writes JSON to `path` when given; always returns the dict. Convert
+        to a Perfetto-loadable Chrome trace with
+        `python -m grove_tpu.observability.trace <path>`."""
+        import json
+
+        dump = self.flight.dump(wedged=self.wedged_summary())
+        if path:
+            with open(path, "w") as fh:
+                json.dump(dump, fh)
+                fh.write("\n")
+        return dump

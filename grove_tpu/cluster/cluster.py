@@ -21,6 +21,7 @@ from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
 from ..api.types import ClusterTopology, Node, Pod, PodPhase, TopologyLevel
 from ..observability import Logger, MetricsRegistry
+from ..observability.tracing import NOOP_TRACER
 from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
 from .clock import SimClock
 from .kubelet import SimKubelet
@@ -42,6 +43,14 @@ class Cluster:
         self.logger = Logger(
             level=self.config.log.level, format=self.config.log.format
         )
+        # Span tracer + chaos flight recorder (observability/tracing.py):
+        # off by default — the no-op singleton keeps every instrumented
+        # hot path at ~zero cost until config.tracing.enabled (or
+        # enable_tracing()) turns it on.
+        self.tracer = NOOP_TRACER
+        self.flight = None
+        if self.config.tracing.enabled:
+            self.enable_tracing()
         defaults = self.config.workload_defaults
         self.store.register_admission(
             "PodCliqueSet",
@@ -94,6 +103,35 @@ class Cluster:
         self._usage: dict[str, dict[str, float]] | None = None
         self._usage_cursor = 0
         self._req_cache: dict[int, tuple] = {}
+
+    # -- tracing ------------------------------------------------------------
+    def enable_tracing(self, max_spans: int | None = None,
+                       flight_capacity: int | None = None):
+        """Build and wire the span tracer + flight recorder (idempotent).
+        Called from __init__ when config.tracing.enabled, and by harnesses
+        that upgrade after construction (ChaosHarness always records a
+        flight so a wedged seed leaves a postmortem). Must run BEFORE the
+        controllers are built — they capture cluster.tracer at
+        construction (Harness._build_manager re-reads it on restart)."""
+        if self.tracer.enabled:
+            return self.tracer
+        from ..observability.tracing import FlightRecorder, Tracer
+
+        tcfg = self.config.tracing
+        self.flight = FlightRecorder(
+            capacity=flight_capacity or tcfg.flight_recorder_capacity
+        )
+        self.tracer = Tracer(
+            clock=self.clock,
+            max_spans=max_spans or tcfg.max_spans,
+            flight=self.flight,
+        )
+        self.kubelet.tracer = self.tracer
+        # EventRecorder hook: recorders hold the store (possibly via the
+        # chaos proxy, whose __getattr__ delegates), so the flight ring
+        # rides as a store attribute rather than N constructor params
+        self.store.flight_recorder = self.flight
+        return self.tracer
 
     # -- node ops ----------------------------------------------------------
     #: read-modify-write attempts for node mutations before giving up (a

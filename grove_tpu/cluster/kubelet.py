@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from ..api import constants
 from ..api.types import Node, Pod, PodPhase
+from ..observability.tracing import NOOP_TRACER
 from .nodehealth import renew_node_lease
 from .store import ObjectStore, StoreError
 
@@ -68,6 +69,10 @@ class SimKubelet:
 
     def __init__(self, store: ObjectStore):
         self.store = store
+        #: span tracer (observability/tracing.py); Cluster.enable_tracing
+        #: swaps in the recording one. Per-pod lifecycle points are gated
+        #: on tracer.enabled so the disabled path allocates nothing.
+        self.tracer = NOOP_TRACER
         # keyed by pod UID: a replacement pod reusing a hole-filled NAME
         # must start clean, exactly like a fresh pod in a real cluster
         self._crashed: set[str] = set()
@@ -252,6 +257,10 @@ class SimKubelet:
                         (pod.metadata.namespace, pod.metadata.name)
                     )
         pod_bucket = self.store.kind_bucket(Pod.KIND)  # read-only
+        trace = self.tracer.enabled
+        #: key -> (gang label, node, has startup barrier) for the pod
+        #: lifecycle trace points; only populated when tracing is on
+        pod_meta: dict[tuple[str, str], tuple[str, str, bool]] = {}
         for key in sorted(self._candidates):
             pod = pod_bucket.get(key)
             if (
@@ -266,6 +275,14 @@ class SimKubelet:
                 continue  # stays NotReady until recover_pod
             if pod.spec.scheduling_gates:
                 continue
+            if trace:
+                pod_meta[key] = (
+                    pod.metadata.labels.get(constants.LABEL_PODGANG, ""),
+                    pod.node_name,
+                    bool(pod.metadata.annotations.get(
+                        constants.ANNOTATION_WAIT_FOR
+                    )),
+                )
             if pod.status.phase == PodPhase.PENDING:
                 # container start and readiness land in ONE tick when the
                 # startup barrier is already open as of tick start (the
@@ -303,14 +320,35 @@ class SimKubelet:
             status.ever_started = True
 
         for ns, name in to_run:
-            changes += self.store.patch_status(Pod.KIND, ns, name, start)
+            if self.store.patch_status(Pod.KIND, ns, name, start):
+                changes += 1
+                if trace:
+                    self._trace_pod("kubelet.pod_start", ns, name, pod_meta)
         for ns, name in to_start_ready:
-            changes += self.store.patch_status(
-                Pod.KIND, ns, name, start_ready
-            )
+            if self.store.patch_status(Pod.KIND, ns, name, start_ready):
+                changes += 1
+                if trace:
+                    # start + barrier release land in one tick: both
+                    # lifecycle points, in order
+                    self._trace_pod("kubelet.pod_start", ns, name, pod_meta)
+                    self._trace_pod("kubelet.pod_ready", ns, name, pod_meta)
         for ns, name in to_ready:
-            changes += self.store.patch_status(Pod.KIND, ns, name, ready)
+            if self.store.patch_status(Pod.KIND, ns, name, ready):
+                changes += 1
+                if trace:
+                    self._trace_pod("kubelet.pod_ready", ns, name, pod_meta)
         return changes
+
+    def _trace_pod(self, span_name: str, ns: str, pod_name: str,
+                   meta: dict) -> None:
+        """Pod lifecycle trace point (pod_start / pod_ready — the latter
+        IS the startup-barrier release when `barrier` is set). Gang-tagged
+        so GangTimeline can stitch per-gang startup phases."""
+        gang, node, barrier = meta.get((ns, pod_name), ("", "", False))
+        self.tracer.point(
+            span_name, pod=f"{ns}/{pod_name}", namespace=ns, gang=gang,
+            node=node, barrier=barrier,
+        )
 
     def run_to_quiesce(self, max_ticks: int = 64) -> None:
         for _ in range(max_ticks):

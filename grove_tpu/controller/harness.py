@@ -76,6 +76,10 @@ class Harness:
             logger=self.cluster.logger.with_name("manager"),
             metrics=self.cluster.metrics,
             elector=self.elector,
+            # re-read on every (re)build: the chaos harness enables
+            # tracing after Cluster construction, and a crash-restarted
+            # manager must keep feeding the same flight recorder
+            tracer=self.cluster.tracer,
         )
         self.manager.register(
             PodCliqueSetReconciler(self.store, config=self.config)

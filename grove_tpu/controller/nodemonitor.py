@@ -91,6 +91,11 @@ class NodeMonitor:
         self.metrics = cluster.metrics
         self.recorder = EventRecorder(cluster.store, controller=self.name)
         self.log = cluster.logger.with_name(self.name)
+        #: span tracer (observability/tracing.py): eviction sweeps and
+        #: drain passes are traced — they are the node-lifecycle events a
+        #: chaos postmortem needs causality for. No-op unless cluster
+        #: tracing is enabled.
+        self.tracer = cluster.tracer
         #: node -> virtual time its post-recovery stabilization began.
         #: In-memory on purpose: a restarted manager conservatively
         #: restarts the window (same shape as the reference's expectation
@@ -296,12 +301,23 @@ class NodeMonitor:
             status.phase = PodPhase.FAILED
             status.ready = False
 
-        for node_name in node_names:
-            swept = 0
-            for ns, name in victims.get(node_name, ()):
-                swept += self.store.patch_status(Pod.KIND, ns, name, fail)
-            if not swept:
-                continue
+        sweep_sp = self.tracer.span(
+            "nodemonitor.evict_sweep", nodes=len(node_names)
+        )
+        total_swept = 0
+        with sweep_sp:
+            for node_name in node_names:
+                total_swept += self._sweep_node(
+                    node_name, victims.get(node_name, ()), fail
+                )
+        sweep_sp.set(swept=total_swept)
+
+    def _sweep_node(self, node_name: str, node_victims, fail) -> int:
+        """Fail every active pod of one expired node; returns the count."""
+        swept = 0
+        for ns, name in node_victims:
+            swept += self.store.patch_status(Pod.KIND, ns, name, fail)
+        if swept:
             self.metrics.counter(
                 "grove_node_pod_evictions_total",
                 "pods swept to Failed off NotReady nodes after the "
@@ -317,6 +333,7 @@ class NodeMonitor:
             self.log.info(
                 "swept NotReady node", node=node_name, pods=swept,
             )
+        return swept
 
     # -- gang-aware drain ----------------------------------------------------
     def _reconcile_drains(
@@ -336,9 +353,13 @@ class NodeMonitor:
             # nodes would spend its PDB budget once per node and dip
             # below MinAvailable
             evicted: set[tuple[str, str]] = set()
-            for node in draining:
-                if self._drain_one(node, pods, evicted):
-                    pending = True
+            with self.tracer.span(
+                "nodemonitor.drain_pass", nodes=len(draining)
+            ) as dsp:
+                for node in draining:
+                    if self._drain_one(node, pods, evicted):
+                        pending = True
+                dsp.set(evicted=len(evicted), pending=pending)
         self._drain_in_flight = pending
         return pending
 

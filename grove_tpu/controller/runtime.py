@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Optional, Protocol
 
 from ..cluster.store import Event, ObjectStore, StoreError
+from ..observability.tracing import NOOP_TRACER
 
 #: circuit-breaker states (exposed via breaker_state()/metrics: the gauge
 #: reads 0.0 closed, 0.5 half-open, 1.0 open)
@@ -73,8 +74,13 @@ class ControllerManager:
                  error_backoff_base_seconds: float = 1.0,
                  error_backoff_max_seconds: float = 60.0,
                  error_retry_budget: int = 8, logger=None,
-                 metrics=None, elector=None):
+                 metrics=None, elector=None, tracer=None):
         self.store = store
+        #: observability.tracing span tracer; the no-op singleton unless
+        #: tracing is enabled (one span per reconcile, tagged
+        #: controller/request/outcome/attempt; reconcile errors feed the
+        #: flight recorder)
+        self.tracer = tracer or NOOP_TRACER
         #: optional LeaderElector (manager.go:98-104): a manager that does
         #: not hold the lease runs NOTHING — it neither drains events nor
         #: reconciles, so its cursor stays put and takeover replays (or
@@ -293,6 +299,21 @@ class ControllerManager:
         """Timer-held requests waiting on the requeue heap."""
         return len(self._requeues)
 
+    def workqueue_snapshot(self) -> list[dict]:
+        """Queued + timer-parked requests, as JSON-able dicts (the chaos
+        flight recorder's wedged section names stuck work with this)."""
+        out = [
+            {"controller": cname, "namespace": req.namespace,
+             "name": req.name, "state": "queued"}
+            for cname, req in self._queue
+        ]
+        out.extend(
+            {"controller": cname, "namespace": req.namespace,
+             "name": req.name, "state": "requeue", "at": at}
+            for at, _tb, cname, req in sorted(self._requeues)
+        )
+        return out
+
     @property
     def event_cursor(self) -> int:
         """Last store event seq this manager has drained."""
@@ -394,12 +415,19 @@ class ControllerManager:
                     continue
             t0 = time.perf_counter() if m is not None else 0.0
             failed = False
+            # one span per reconcile; a finished span's attrs stay
+            # mutable, so the outcome/attempt tags land after the fact
+            span = self.tracer.span(
+                "manager.reconcile", controller=cname,
+                namespace=req.namespace, name=req.name,
+            )
             try:
-                if self.identity is not None:
-                    with self.store.impersonate(self.identity):
+                with span:
+                    if self.identity is not None:
+                        with self.store.impersonate(self.identity):
+                            result = controller.reconcile(req)
+                    else:
                         result = controller.reconcile(req)
-                else:
-                    result = controller.reconcile(req)
             except Exception as exc:
                 # A reconcile panic never kills the manager (the reference
                 # sets RecoverPanic, manager.go:105-107): record it, let the
@@ -437,6 +465,11 @@ class ControllerManager:
                 key = (cname, req)
                 attempts = self._attempts.get(key, 0) + 1
                 self._attempts[key] = attempts
+                span.set(outcome="error", attempt=attempts)
+                self.tracer.record_error(
+                    cname, req.namespace, req.name, str(err),
+                    self.store.clock.now(),
+                )
                 if m is not None:
                     m.counter(
                         "grove_manager_reconcile_retries_total",
@@ -477,6 +510,11 @@ class ControllerManager:
                 )
                 failed = True
             if not failed:
+                span.set(
+                    outcome="soft-error" if result.error
+                    else ("requeue" if result.requeue_after is not None
+                          else "ok")
+                )
                 key = (cname, req)
                 if self._attempts.pop(key, None) is not None and m is not None:
                     # re-derive, don't zero: another request's chain may

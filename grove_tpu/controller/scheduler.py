@@ -37,6 +37,7 @@ from ..observability.events import (
     REASON_PODGANG_SCHEDULED,
     REASON_PODGANG_UNSCHEDULABLE,
 )
+from ..observability.tracing import accepts_tracer_kwarg
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import (
     UNRESOLVED_LEVEL,
@@ -72,6 +73,9 @@ class GangScheduler:
         self.metrics = cluster.metrics
         self.recorder = EventRecorder(cluster.store, controller=self.name)
         self.log = cluster.logger.with_name("scheduler")
+        #: span tracer (observability/tracing.py); the no-op singleton
+        #: unless cluster tracing is enabled
+        self.tracer = cluster.tracer
         self._engine_kwargs = dict(
             top_k=cfg.solver.top_k,
             native_repair=cfg.solver.native_repair,
@@ -79,6 +83,12 @@ class GangScheduler:
             bucket_min=cfg.solver.gang_bucket_minimum,
             metrics=cluster.metrics,
         )
+        if cluster.tracer.enabled and accepts_tracer_kwarg(engine_cls):
+            # only injected when tracing is on AND the engine can take
+            # it: a custom engine class with a strict signature keeps
+            # working untraced even under ChaosHarness, which always
+            # enables tracing for the flight recorder
+            self._engine_kwargs["tracer"] = cluster.tracer
         #: (namespace, gang name) pairs whose pods/status changed since the
         #: last reconcile — the incremental alternative to the r1 design of
         #: re-checking every pod reference of every scheduled gang on every
@@ -283,17 +293,18 @@ class GangScheduler:
         encoding. ONE code path shared by pre_round and the reconcile
         fallback: the adoption guards trust that pre_round's encode equals
         what the reconcile would compute, so the two must never diverge."""
-        backlog = [
-            self.store.get(PodGang.KIND, ns, name)
-            for ns, name in backlog_keys
-        ]
-        encoded = encode_podgangs(
-            backlog, snapshot,
-            self.cluster.pod_demand_fn(snapshot.resource_names),
-            priority_of=self._priority_of,
-            pod_scheduling=self.cluster.pod_scheduling_fn(),
-        )
-        return backlog, encoded
+        with self.tracer.span("scheduler.encode", gangs=len(backlog_keys)):
+            backlog = [
+                self.store.get(PodGang.KIND, ns, name)
+                for ns, name in backlog_keys
+            ]
+            encoded = encode_podgangs(
+                backlog, snapshot,
+                self.cluster.pod_demand_fn(snapshot.resource_names),
+                priority_of=self._priority_of,
+                pod_scheduling=self.cluster.pod_scheduling_fn(),
+            )
+            return backlog, encoded
 
     def pre_round(self) -> None:
         """Manager pre_round hook (runtime.run_once): when a backlog is
@@ -313,32 +324,35 @@ class GangScheduler:
         provably irrelevant to solve inputs (_dispatch_unaffected), and
         engine.solve re-verifies gang identity + free-matrix content.
         Any staleness falls back to a fresh synchronous solve."""
-        self._pending = None
-        seq0 = self.store.last_seq
-        backlog_keys: list[tuple[str, str]] = []
-        pod_bucket = self.store.kind_bucket(Pod.KIND)
-        for gang in self.store.scan(PodGang.KIND):
-            if gang.metadata.deletion_timestamp is not None:
-                continue
-            if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
-                continue
-            if self._gang_ready_to_schedule(
-                gang, speculate_gates=True, pod_bucket=pod_bucket
-            ):
-                backlog_keys.append(
-                    (gang.metadata.namespace, gang.metadata.name)
-                )
-        if not backlog_keys:
-            return
-        snapshot = self.cluster.topology_snapshot()
-        engine = self._engine_for(snapshot)
-        if getattr(engine, "dispatch", None) is None:
-            return  # custom engine without async support (tests)
-        backlog, encoded = self._fetch_and_encode(backlog_keys, snapshot)
-        dispatch = engine.dispatch(encoded, free=snapshot.free.copy())
-        if dispatch is not None:
-            self._pending = (seq0, backlog_keys, backlog, encoded,
-                             dispatch)
+        with self.tracer.span("scheduler.pre_round") as sp:
+            self._pending = None
+            seq0 = self.store.last_seq
+            backlog_keys: list[tuple[str, str]] = []
+            pod_bucket = self.store.kind_bucket(Pod.KIND)
+            for gang in self.store.scan(PodGang.KIND):
+                if gang.metadata.deletion_timestamp is not None:
+                    continue
+                if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+                    continue
+                if self._gang_ready_to_schedule(
+                    gang, speculate_gates=True, pod_bucket=pod_bucket
+                ):
+                    backlog_keys.append(
+                        (gang.metadata.namespace, gang.metadata.name)
+                    )
+            sp.set(backlog=len(backlog_keys), dispatched=False)
+            if not backlog_keys:
+                return
+            snapshot = self.cluster.topology_snapshot()
+            engine = self._engine_for(snapshot)
+            if getattr(engine, "dispatch", None) is None:
+                return  # custom engine without async support (tests)
+            backlog, encoded = self._fetch_and_encode(backlog_keys, snapshot)
+            dispatch = engine.dispatch(encoded, free=snapshot.free.copy())
+            if dispatch is not None:
+                self._pending = (seq0, backlog_keys, backlog, encoded,
+                                 dispatch)
+                sp.set(dispatched=True)
 
     def reconcile(self, request: Request) -> Result:
         dirty, self._dirty = self._dirty, set()
@@ -461,85 +475,14 @@ class GangScheduler:
             self.retry_seconds if blocked_pending else None
         )
         if backlog_keys:
-            pending, self._pending = self._pending, None
-            dispatch = None
-            if (
-                pending is not None
-                and pending[1] == backlog_keys
-                and self._dispatch_unaffected(pending[0])
-            ):
-                # nothing the dispatched scores depend on was written since
-                # pre_round: adopt its fetches + encode + in-flight device
-                # phase (engine.solve still verifies gang identity + free)
-                _, _, backlog, encoded, dispatch = pending
-            else:
-                if pending is not None:
-                    pending[4].cancel()  # stale: stop in-flight RPC work
-                backlog, encoded = self._fetch_and_encode(
-                    backlog_keys, snapshot
-                )
-            solver_by_name = {g.name: g for g in encoded}
-            by_name = {g.metadata.name: g for g in backlog}
-            solver_gangs = self._try_reserved(
-                encoded, by_name, snapshot, free
-            )
-            result = (
-                engine.solve(solver_gangs, free=free, dispatch=dispatch)
-                if dispatch is not None
-                else engine.solve(solver_gangs, free=free)
-            )
-            # counted AFTER the solve (engine.solve may still reject the
-            # dispatch — e.g. _try_reserved bound a reservation, mutating
-            # free and shrinking the gang list — so only its own stats say
-            # whether the in-flight result was adopted), and only when a
-            # dispatch EXISTED: solves with no pre_round dispatch at all
-            # (custom engine, empty speculative backlog) must not inflate
-            # the hit-rate denominator
-            if pending is not None:
-                self._count_dispatch(
-                    "overlapped"
-                    if result.stats.get("dispatch_overlap")
-                    else "fresh"
-                )
-            self.log.debug(
-                "backlog solved", gangs=len(backlog),
-                placed=result.num_placed, unplaced=len(result.unplaced),
-                wall_seconds=round(result.wall_seconds, 4),
-            )
-            for name, placement in result.placed.items():
-                self._bind(by_name[name], placement)
-            for name, reason in result.unplaced.items():
-                gang = by_name[name]
-                before = clone(gang.status)
-                prev = get_condition(
-                    gang.status.conditions, PodGangConditionType.SCHEDULED.value
-                )
-                entered = prev is None or prev.status != "False"
-                set_condition(
-                    gang.status.conditions,
-                    PodGangConditionType.SCHEDULED.value,
-                    "False",
-                    reason="Unschedulable",
-                    message=reason,
-                    now=self.store.clock.now(),
-                )
-                if gang.status != before:
-                    self.store.update_status(gang)
-                    self._mark_own()
-                if entered:  # count state TRANSITIONS, not message churn
-                    self.metrics.counter(
-                        "grove_scheduler_gangs_unschedulable_total",
-                        "gangs that entered the Unschedulable state",
-                    ).inc()
-                    self.recorder.warning(
-                        gang, REASON_PODGANG_UNSCHEDULABLE, reason
-                    )
-                requeue = self.retry_seconds
-            if self.preemption_enabled and result.unplaced:
-                self._preempt(
-                    result, by_name, solver_by_name, snapshot, free,
-                    demand_fn,
-                )
+            with self.tracer.span(
+                "scheduler.solve", gangs=len(backlog_keys)
+            ) as solve_sp:
+                if self._solve_backlog(
+                    backlog_keys, snapshot, engine, free, demand_fn,
+                    solve_sp,
+                ):
+                    requeue = self.retry_seconds
 
         self._bind_best_effort(
             dirty_scheduled, snapshot, free, demand_fn, sched_fn, engine
@@ -564,6 +507,103 @@ class GangScheduler:
         )
         self._just_bound = set()
         return Result(requeue_after=requeue)
+
+    def _solve_backlog(
+        self, backlog_keys, snapshot, engine, free, demand_fn, solve_sp
+    ) -> bool:
+        """One full-backlog solve round: adopt (or replace) the pre_round
+        dispatch, run reservation reuse + the engine solve, bind the
+        placements, stamp Unschedulable on the rest, and run preemption.
+        Returns True when any gang was left unplaced (the caller arms the
+        retry timer). Runs inside the scheduler.solve span; `solve_sp`
+        receives the outcome tags."""
+        pending, self._pending = self._pending, None
+        dispatch = None
+        if (
+            pending is not None
+            and pending[1] == backlog_keys
+            and self._dispatch_unaffected(pending[0])
+        ):
+            # nothing the dispatched scores depend on was written since
+            # pre_round: adopt its fetches + encode + in-flight device
+            # phase (engine.solve still verifies gang identity + free)
+            _, _, backlog, encoded, dispatch = pending
+        else:
+            if pending is not None:
+                pending[4].cancel()  # stale: stop in-flight RPC work
+            backlog, encoded = self._fetch_and_encode(
+                backlog_keys, snapshot
+            )
+        solver_by_name = {g.name: g for g in encoded}
+        by_name = {g.metadata.name: g for g in backlog}
+        solver_gangs = self._try_reserved(
+            encoded, by_name, snapshot, free
+        )
+        result = (
+            engine.solve(solver_gangs, free=free, dispatch=dispatch)
+            if dispatch is not None
+            else engine.solve(solver_gangs, free=free)
+        )
+        # counted AFTER the solve (engine.solve may still reject the
+        # dispatch — e.g. _try_reserved bound a reservation, mutating
+        # free and shrinking the gang list — so only its own stats say
+        # whether the in-flight result was adopted), and only when a
+        # dispatch EXISTED: solves with no pre_round dispatch at all
+        # (custom engine, empty speculative backlog) must not inflate
+        # the hit-rate denominator
+        if pending is not None:
+            self._count_dispatch(
+                "overlapped"
+                if result.stats.get("dispatch_overlap")
+                else "fresh"
+            )
+        solve_sp.set(
+            placed=result.num_placed, unplaced=len(result.unplaced),
+            overlapped=bool(result.stats.get("dispatch_overlap")),
+            wall_seconds=round(result.wall_seconds, 6),
+        )
+        self.log.debug(
+            "backlog solved", gangs=len(backlog),
+            placed=result.num_placed, unplaced=len(result.unplaced),
+            wall_seconds=round(result.wall_seconds, 4),
+        )
+        for name, placement in result.placed.items():
+            self._bind(by_name[name], placement)
+        for name, reason in result.unplaced.items():
+            gang = by_name[name]
+            before = clone(gang.status)
+            prev = get_condition(
+                gang.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            entered = prev is None or prev.status != "False"
+            set_condition(
+                gang.status.conditions,
+                PodGangConditionType.SCHEDULED.value,
+                "False",
+                reason="Unschedulable",
+                message=reason,
+                now=self.store.clock.now(),
+            )
+            if gang.status != before:
+                self.store.update_status(gang)
+                self._mark_own()
+            if entered:  # count state TRANSITIONS, not message churn
+                self.metrics.counter(
+                    "grove_scheduler_gangs_unschedulable_total",
+                    "gangs that entered the Unschedulable state",
+                ).inc()
+                self.recorder.warning(
+                    gang, REASON_PODGANG_UNSCHEDULABLE, reason
+                )
+        if self.preemption_enabled and result.unplaced:
+            with self.tracer.span(
+                "scheduler.preempt", starved=len(result.unplaced)
+            ) as psp:
+                psp.set(evicted=self._preempt(
+                    result, by_name, solver_by_name, snapshot, free,
+                    demand_fn,
+                ))
+        return bool(result.unplaced)
 
     def _update_phases(self, keys: set[tuple[str, str]]) -> None:
         # live kind buckets (read-only): the sweep peeks 8 pods per gang
@@ -822,7 +862,7 @@ class GangScheduler:
     # owns reclaim) ----------------------------------------------------------
     def _preempt(
         self, result, by_name, solver_by_name, snapshot, free, demand_fn
-    ) -> bool:
+    ) -> int:
         """Evict lower-priority SCALED gangs to make room for
         capacity-starved higher-priority gangs. BASE gangs are never
         victims: evicting one would collapse a workload below its gang
@@ -864,11 +904,11 @@ class GangScheduler:
                 (self._priority_of(gang), gang.metadata.name, gang)
             )
         if not evictable:
-            return False
+            return 0
         evictable.sort(key=lambda t: (t[0], t[1]))  # cheapest victims first
         node_index = snapshot.node_index
         sched_free = np.where(snapshot.schedulable[:, None], free, 0.0)
-        evicted_any = False
+        evicted_gangs = 0
         starved = [
             (name, reason)
             for name, reason in result.unplaced.items()
@@ -963,8 +1003,8 @@ class GangScheduler:
             ]
             for victim in chosen:
                 self._evict(victim, preemptor=name)
-            evicted_any = True
-        return evicted_any
+            evicted_gangs += len(chosen)
+        return evicted_gangs
 
     def _trial_place(
         self, sg, snapshot, free, victims, demand_fn, node_index
@@ -1096,6 +1136,18 @@ class GangScheduler:
             "grove_scheduler_gang_bind_latency_seconds",
             "virtual seconds from PodGang creation to bind",
         ).observe(self.store.clock.now() - gang.metadata.creation_timestamp)
+        if self.tracer.enabled:
+            # the GangTimeline anchor: created_at + pod count let the
+            # reconstructor decompose this gang's bind latency into
+            # queued/solving/binding and stitch the kubelet's startup
+            # points onto it (observability/tracing.py)
+            self.tracer.point(
+                "scheduler.bind",
+                gang=f"{ns}/{gang.metadata.name}",
+                created_at=gang.metadata.creation_timestamp,
+                pods=len(placement.pod_to_node),
+                score=round(placement.placement_score, 4),
+            )
         self.recorder.normal(
             gang,
             REASON_PODGANG_SCHEDULED,

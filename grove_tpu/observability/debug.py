@@ -94,6 +94,20 @@ def harness_dump(harness) -> dict[str, Any]:
     monitor = getattr(harness, "node_monitor", None)
     if monitor is not None:
         out["node_lifecycle"] = monitor.debug_state()
+    out["tracing"] = tracing_dump(harness.cluster)
+    return out
+
+
+def tracing_dump(cluster) -> dict[str, Any]:
+    """The tracing section of debug dumps: bounded span/flight counts
+    ({"enabled": False} when tracing is off) plus, when enabled, the
+    GangTimeline latency decomposition — flushing every complete gang's
+    phase durations into grove_trace_gang_phase_seconds as a side effect
+    (idempotent per bind, so repeated dumps never double-count)."""
+    tracer = cluster.tracer
+    out = tracer.summary()
+    if tracer.enabled:
+        out["gang_timeline"] = tracer.flush_gang_phases(cluster.metrics)
     return out
 
 

@@ -9,6 +9,7 @@ events compaction."""
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 
 from ..api.meta import ObjectMeta
@@ -58,10 +59,33 @@ class EventRecorder:
         self.store = store
         self.controller = controller
 
+    @staticmethod
+    def dedup_name(kind: str, name: str, reason: str) -> str:
+        """Collision-free event object name for one (kind, involved name,
+        reason) triple. The readable prefix joins the fields with "-",
+        which is ambiguous on its own (name "pod-a-b" + reason "c" and
+        name "pod-a" + reason "b-c" both read "pod-a-b-c"); the appended
+        digest hashes the fields with a separator that cannot appear in
+        them, so overlapping prefixes can never share a dedup key."""
+        digest = hashlib.sha1(
+            "\x00".join((kind, name, reason)).encode()
+        ).hexdigest()[:8]
+        return f"{kind.lower()}-{name}-{reason.lower()}-{digest}"
+
     def event(self, involved, type_: str, reason: str, message: str) -> None:
         ns = involved.metadata.namespace or "default"
-        name = f"{involved.KIND.lower()}-{involved.metadata.name}-{reason.lower()}"
+        name = self.dedup_name(
+            involved.KIND, involved.metadata.name, reason
+        )
         now = self.store.clock.now()
+        flight = getattr(self.store, "flight_recorder", None)
+        if flight is not None:
+            # chaos flight recorder (observability/tracing.py): events
+            # ride in the postmortem ring alongside spans + errors
+            flight.add_event(
+                type_, reason, involved.KIND, involved.metadata.name,
+                ns, message, virtual_time=now,
+            )
         existing = self.store.get(ClusterEvent.KIND, ns, name)
         if existing is not None:
             existing.count += 1

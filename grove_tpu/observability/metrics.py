@@ -10,6 +10,7 @@ by bench.py (the driver metric) and tests.
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass, field
 
 
@@ -49,44 +50,89 @@ class Gauge:
 
 @dataclass
 class Histogram:
-    """Exact-percentile histogram with label support. observe() is O(1)
+    """Bounded-memory histogram with label support. observe() is O(1)
     append; the sort is deferred to the first percentile read after new
     observations, so per-gang latency observation stays cheap at
     10^5-gang scale (reads are rare — bench/render time — writes are the
-    hot path). Label-less usage reads/writes the () series."""
+    hot path). Label-less usage reads/writes the () series.
+
+    Memory bound: each label series retains at most `max_observations`
+    raw samples. Below the cap percentiles are EXACT; at the cap the
+    series switches to deterministic reservoir downsampling (Algorithm R
+    driven by a per-series LCG seeded from the label key — replayable,
+    no `random` module), so percentiles become a uniform-sample estimate
+    while `count`/`sum`/`mean` stay exact via separate accumulators.
+    `reset()` drops all series for long-lived harnesses."""
 
     name: str
     help: str = ""
+    #: per-series raw-sample cap; at 10^5-gang scale the bind-latency
+    #: series would otherwise grow one float per gang forever
+    max_observations: int = 65536
     _series: dict[tuple, list[float]] = field(default_factory=dict)
     _dirty: set = field(default_factory=set)
+    #: exact per-series totals (survive downsampling)
+    _counts: dict[tuple, int] = field(default_factory=dict)
+    _sums: dict[tuple, float] = field(default_factory=dict)
+    #: per-series LCG state for the deterministic reservoir
+    _rng: dict[tuple, int] = field(default_factory=dict)
 
     def observe(self, value: float, **labels: str) -> None:
         key = _label_key(labels)
-        self._series.setdefault(key, []).append(value)
-        self._dirty.add(key)
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        self._sums[key] = self._sums.get(key, 0.0) + value
+        obs = self._series.get(key)
+        if obs is None:
+            obs = self._series[key] = []
+        if len(obs) < self.max_observations:
+            obs.append(value)
+            self._dirty.add(key)
+            return
+        # reservoir: keep each of the n+1 samples with equal probability,
+        # driven by a deterministic per-series LCG (MMIX constants)
+        state = self._rng.get(key)
+        if state is None:
+            state = zlib.crc32(repr(key).encode()) or 1
+        state = (state * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+        self._rng[key] = state
+        j = state % (n + 1)
+        if j < self.max_observations:
+            obs[j] = value
+            self._dirty.add(key)
+
+    def reset(self) -> None:
+        """Drop every series (long-lived harness hygiene)."""
+        self._series.clear()
+        self._dirty.clear()
+        self._counts.clear()
+        self._sums.clear()
+        self._rng.clear()
 
     def _obs_for(self, labels: dict[str, str] | None) -> list[float]:
         return self._series.get(_label_key(labels), [])
 
     @property
     def count(self) -> int:
-        return sum(len(o) for o in self._series.values())
+        return sum(self._counts.values())
 
     def series_count(self, **labels: str) -> int:
         """Observation count of ONE label series (the () series when
-        unlabeled) — the public read debug dumps use."""
-        return len(self._obs_for(labels))
+        unlabeled) — the public read debug dumps use. Exact even past
+        the retention cap."""
+        return self._counts.get(_label_key(labels), 0)
 
     @property
     def sum(self) -> float:
-        return float(sum(sum(o) for o in self._series.values()))
+        return float(sum(self._sums.values()))
 
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
     def percentile(self, q: float, **labels: str) -> float:
-        """q in [0, 100]; nearest-rank on the sorted observations of one
-        label series (the () series when unlabeled)."""
+        """q in [0, 100]; nearest-rank on the sorted retained
+        observations of one label series (the () series when unlabeled).
+        Exact below max_observations, reservoir estimate past it."""
         key = _label_key(labels)
         obs = self._series.get(key)
         if not obs:
@@ -124,12 +170,15 @@ class MetricsRegistry:
         return self._metrics.get(name)
 
     def render(self) -> str:
-        """Prometheus text exposition (the /metrics endpoint analog)."""
+        """Prometheus text exposition (the /metrics endpoint analog).
+        Label values (quantile labels included — they flow through the
+        same _fmt_labels path) and HELP text are escaped per the
+        Prometheus text-format spec."""
         lines: list[str] = []
         for name in sorted(self._metrics):
             m = self._metrics[name]
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {_escape_help(m.help)}")
             if isinstance(m, Counter):
                 lines.append(f"# TYPE {name} counter")
                 for key, v in sorted(m._values.items()):
@@ -148,16 +197,33 @@ class MetricsRegistry:
                                           "quantile": f"0.{q}"}.items()))
                         )
                         lines.append(f"{name}{qk} {m.percentile(q, **labels)}")
-                    obs = m._series[key]
                     lines.append(
-                        f"{name}_sum{_fmt_labels(key)} {float(sum(obs))}"
+                        f"{name}_sum{_fmt_labels(key)} {m._sums[key]}"
                     )
-                    lines.append(f"{name}_count{_fmt_labels(key)} {len(obs)}")
+                    lines.append(
+                        f"{name}_count{_fmt_labels(key)} {m._counts[key]}"
+                    )
         return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and line feed are the three characters the spec requires."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping per the spec: backslash and line feed."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"

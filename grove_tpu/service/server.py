@@ -46,10 +46,22 @@ class PlacementService:
     """Holds one engine per registered topology epoch (bounded)."""
 
     def __init__(self, engine_cls=PlacementEngine, max_epochs: int = 4,
-                 **engine_kwargs):
+                 tracer=None, **engine_kwargs):
         self.engine_cls = engine_cls
         self.engine_kwargs = engine_kwargs
         self.max_epochs = max_epochs
+        #: observability.tracing span tracer, shared with every engine
+        #: this service builds (engine.encode/device/repair spans land in
+        #: it; the Debug RPC reports its summary). Default disabled —
+        #: and the recording Tracer is single-threaded, so enable it only
+        #: with max_workers=1 or for in-process/debug use.
+        from ..observability.tracing import NOOP_TRACER, accepts_tracer_kwarg
+
+        if tracer is None:
+            tracer = NOOP_TRACER
+        self.tracer = tracer
+        if tracer.enabled and accepts_tracer_kwarg(engine_cls):
+            self.engine_kwargs.setdefault("tracer", tracer)
         self._engines: dict[str, PlacementEngine] = {}
         import time as _time
 
@@ -145,6 +157,9 @@ class PlacementService:
             "solves_total": self._solves,
             "syncs_total": self._syncs,
             "uptime_seconds": round(_time.time() - self._started_at, 3),
+            # same bounded shape as harness.debug_dump()["tracing"]:
+            # {"enabled": False} unless a tracer was injected
+            "tracing": self.tracer.summary(),
         }).encode()
 
 

@@ -333,6 +333,7 @@ class PlacementEngine:
         commit_chunk: int = 32,
         bucket_min: int = 8,
         metrics=None,
+        tracer=None,
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
@@ -343,6 +344,14 @@ class PlacementEngine:
         #: observability.MetricsRegistry; solve() feeds the north-star
         #: numbers (backlog bind latency, placements, score distribution)
         self.metrics = metrics
+        #: observability.tracing span tracer: solve() decomposes into
+        #: engine.encode / engine.device / engine.repair child spans so a
+        #: slow backlog says WHERE it was slow (no-op unless injected)
+        if tracer is None:
+            from ..observability.tracing import NOOP_TRACER
+
+            tracer = NOOP_TRACER
+        self.tracer = tracer
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
         self._cap_scale = np.maximum(
             snapshot.capacity.max(axis=0), 1e-9
@@ -396,8 +405,14 @@ class PlacementEngine:
         if not solvable:
             return None
         order = sorted(solvable, key=gang_sort_key)
-        args = self._encode_arrays(order, free)
-        token = self._device_begin(*args, self._cap_scale)
+        # the encode of an overlapped solve happens HERE (under the
+        # scheduler.pre_round span when the scheduler drives it); the
+        # consuming solve only emits engine.device/engine.repair
+        with self.tracer.span(
+            "engine.encode", gangs=len(order), dispatch=True
+        ):
+            args = self._encode_arrays(order, free)
+            token = self._device_begin(*args, self._cap_scale)
         return SolveDispatch(
             engine=self,
             order=order,
@@ -446,17 +461,26 @@ class PlacementEngine:
             result.stats["encode_seconds"] = dispatch.encode_seconds
             result.stats["dispatch_overlap"] = 1.0
             t_dev = time.perf_counter()
-            top_val, top_dom = self._device_end(dispatch.token)
+            with self.tracer.span(
+                "engine.device", gangs=len(order), overlapped=True
+            ):
+                top_val, top_dom = self._device_end(dispatch.token)
             result.stats["device_seconds"] = time.perf_counter() - t_dev
         else:
-            args = self._encode_arrays(order, free)
+            with self.tracer.span("engine.encode", gangs=len(order)):
+                args = self._encode_arrays(order, free)
             result.stats["encode_seconds"] = time.perf_counter() - t0
             t_dev = time.perf_counter()
-            top_val, top_dom = self._device_phase(*args, self._cap_scale)
+            with self.tracer.span(
+                "engine.device", gangs=len(order), overlapped=False
+            ):
+                top_val, top_dom = self._device_phase(*args, self._cap_scale)
             result.stats["device_seconds"] = time.perf_counter() - t_dev
 
         t_rep = time.perf_counter()
-        placed_map, fallbacks = self._repair(order, top_val, top_dom, free)
+        with self.tracer.span("engine.repair", gangs=len(order)) as rsp:
+            placed_map, fallbacks = self._repair(order, top_val, top_dom, free)
+            rsp.set(fallbacks=fallbacks)
         result.stats["repair_seconds"] = time.perf_counter() - t_rep
         for gang in order:
             if gang.name in placed_map:

@@ -90,9 +90,14 @@ def sweep_workload():
     )
 
 
-def run_seed(seed: int, nodes: int, baseline: dict) -> dict:
+def run_seed(seed: int, nodes: int, baseline: dict,
+             trace_dir: Path | None = None) -> dict:
     plan = FaultPlan.from_seed(seed)
-    ch = ChaosHarness(plan, nodes=make_nodes(nodes))
+    trace_path = (
+        str(trace_dir / f"seed-{seed}-flight.json")
+        if trace_dir is not None else None
+    )
+    ch = ChaosHarness(plan, nodes=make_nodes(nodes), trace_path=trace_path)
     # silence the expected fault-storm error logs (with_name children
     # copy the stream at creation, so the manager's logger needs its own
     # reassignment; restarted managers inherit the cluster logger's)
@@ -109,9 +114,10 @@ def run_seed(seed: int, nodes: int, baseline: dict) -> dict:
     except Exception as exc:  # a non-converging seed must not stop the sweep
         fingerprint_ok, violations = False, []
         error = f"{type(exc).__name__}: {exc}"
-    return {
+    ok = fingerprint_ok and not violations and error is None
+    result = {
         "seed": seed,
-        "ok": fingerprint_ok and not violations and error is None,
+        "ok": ok,
         "fingerprint_match": fingerprint_ok,
         "invariant_violations": violations,
         "error": error,
@@ -119,6 +125,13 @@ def run_seed(seed: int, nodes: int, baseline: dict) -> dict:
         "manager_restarts": ch.manager_restarts,
         "wall_seconds": round(time.perf_counter() - t0, 3),
     }
+    if not ok and trace_path is not None:
+        # every failure class leaves the postmortem, not just the wedged
+        # settle that settle_recovered auto-dumps (a diverged fingerprint
+        # settles fine — the flight ring is how you see WHY it diverged)
+        ch.dump_flight(trace_path)
+        result["flight_dump"] = trace_path
+    return result
 
 
 def main(argv=None) -> int:
@@ -134,7 +147,18 @@ def main(argv=None) -> int:
                     help="also write the full sweep matrix (per-seed "
                          "results + summary) as one JSON document — the "
                          "CI artifact format")
+    ap.add_argument("--trace-dir", dest="trace_dir", default=None,
+                    metavar="DIR",
+                    help="write a flight-recorder postmortem "
+                         "(seed-N-flight.json: recent spans + errors + "
+                         "events + the wedged-object summary) for every "
+                         "FAILING seed; open with python -m "
+                         "grove_tpu.observability.trace")
     args = ap.parse_args(argv)
+    trace_dir = None
+    if args.trace_dir:
+        trace_dir = Path(args.trace_dir)
+        trace_dir.mkdir(parents=True, exist_ok=True)
 
     baseline_h = Harness(nodes=make_nodes(args.nodes))
     baseline_h.apply(sweep_workload())
@@ -144,7 +168,7 @@ def main(argv=None) -> int:
     results = []
     failed = []
     for seed in range(args.start, args.start + args.seeds):
-        result = run_seed(seed, args.nodes, baseline)
+        result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:

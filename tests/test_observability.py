@@ -416,3 +416,135 @@ class TestManagerMetrics:
         h.settle()
         errs = h.cluster.metrics.counter("grove_manager_reconcile_errors_total")
         assert errs.value(controller="podclique") > 0
+
+
+class TestExpositionEscaping:
+    """Satellite (PR 3): Prometheus text-format escaping — label values
+    containing backslash, double-quote, or newline previously rendered
+    invalid/ambiguous exposition text."""
+
+    def test_label_values_escaped_per_spec(self):
+        r = MetricsRegistry()
+        r.counter("c", "help").inc(kind='a"b\\c\nd')
+        text = r.render()
+        assert 'c{kind="a\\"b\\\\c\\nd"} 1.0' in text
+        assert "\nd" not in text.replace("\\nd", ""), "raw newline leaked"
+
+    def test_help_text_escaped(self):
+        r = MetricsRegistry()
+        r.counter("c", "line1\nline2\\tail").inc()
+        text = r.render()
+        assert "# HELP c line1\\nline2\\\\tail" in text
+
+    def test_quantile_labels_flow_through_escaping_path(self):
+        r = MetricsRegistry()
+        h = r.histogram("h", "help")
+        h.observe(1.0, tier='we"ird')
+        text = r.render()
+        # the quantile label and the user label render through ONE
+        # formatting path, escaped together
+        assert 'h{quantile="0.50",tier="we\\"ird"} 1.0' in text
+        assert 'h_count{tier="we\\"ird"} 1' in text
+
+
+class TestHistogramBounds:
+    """Satellite (PR 3): bounded histogram memory at 10^5-gang scale —
+    exact percentiles below the cap, deterministic reservoir past it,
+    exact count/sum throughout, and reset() for long-lived harnesses."""
+
+    def test_exact_below_cap(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        h = Histogram("h", max_observations=100)
+        for v in range(50):
+            h.observe(float(v))
+        assert h.count == 50
+        assert h.percentile(100) == 49.0
+        assert h.percentile(0) == 0.0
+
+    def test_reservoir_caps_memory_keeps_exact_totals(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        h = Histogram("h", max_observations=128)
+        n = 5000
+        for v in range(n):
+            h.observe(float(v))
+        assert len(h._series[()]) == 128, "raw samples capped"
+        assert h.count == n, "count stays exact past the cap"
+        assert h.series_count() == n
+        assert h.sum == pytest.approx(n * (n - 1) / 2)
+        assert h.mean() == pytest.approx((n - 1) / 2)
+        # a uniform reservoir's median estimates the true median
+        assert h.percentile(50) == pytest.approx(n / 2, rel=0.35)
+
+    def test_reservoir_deterministic(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        def fill():
+            h = Histogram("h", max_observations=32)
+            for v in range(1000):
+                h.observe(float(v), shard="s1")
+            return list(h._series[(("shard", "s1"),)])
+
+        assert fill() == fill(), "replayable: no global RNG involved"
+
+    def test_reset_drops_all_series(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        h = Histogram("h", max_observations=16)
+        for v in range(40):
+            h.observe(float(v), k="a")
+        h.reset()
+        assert h.count == 0
+        assert h.sum == 0.0
+        assert h.percentile(50, k="a") == 0.0
+        h.observe(3.0, k="a")
+        assert h.count == 1 and h.percentile(50, k="a") == 3.0
+
+
+class TestEventDedupCollision:
+    """Satellite (PR 3): the dedup key must not collide for
+    prefix-overlapping (name, reason) pairs."""
+
+    def test_prefix_overlap_yields_distinct_events(self):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import Pod, PodSpec
+        from grove_tpu.observability.events import EventRecorder
+
+        h = Harness(nodes=make_nodes(2))
+        rec = EventRecorder(h.store, controller="test")
+        p1 = Pod(metadata=ObjectMeta(name="pod-a-b"), spec=PodSpec())
+        p2 = Pod(metadata=ObjectMeta(name="pod-a"), spec=PodSpec())
+        h.store.create(p1)
+        h.store.create(p2)
+        rec.warning(p1, "c", "first")
+        rec.warning(p2, "b-c", "second")
+        evts = [e for e in h.store.list(ClusterEvent.KIND)
+                if e.reporting_controller == "test"]
+        assert len(evts) == 2, "prefix-overlapping pairs must not merge"
+        assert {e.count for e in evts} == {1}
+
+    def test_same_triple_still_dedups(self):
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import Pod, PodSpec
+        from grove_tpu.observability.events import EventRecorder
+
+        h = Harness(nodes=make_nodes(2))
+        rec = EventRecorder(h.store, controller="test")
+        p = Pod(metadata=ObjectMeta(name="pod-a"), spec=PodSpec())
+        h.store.create(p)
+        rec.warning(p, "r", "m1")
+        rec.warning(p, "r", "m2")
+        evts = [e for e in h.store.list(ClusterEvent.KIND)
+                if e.reporting_controller == "test"]
+        assert len(evts) == 1
+        assert evts[0].count == 2
+
+    def test_dedup_name_collision_free(self):
+        from grove_tpu.observability.events import EventRecorder
+
+        a = EventRecorder.dedup_name("Pod", "pod-a-b", "c")
+        b = EventRecorder.dedup_name("Pod", "pod-a", "b-c")
+        assert a != b
+        # stable across calls (it IS the store key)
+        assert a == EventRecorder.dedup_name("Pod", "pod-a-b", "c")

@@ -1,0 +1,471 @@
+"""Tests for the span-tracing layer (observability/tracing.py): span
+causality, the GangTimeline sum contract against the north-star bind
+latency, flight-recorder bounds, Chrome-trace export, the chaos
+postmortem dump, and the disabled-path zero-cost guarantee."""
+
+import json
+
+import pytest
+
+from grove_tpu.chaos import ChaosHarness, FaultPlan
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.solver import PlacementEngine
+from grove_tpu.observability import tracing
+from grove_tpu.observability.tracing import (
+    GANG_PHASES,
+    NOOP_TRACER,
+    FlightRecorder,
+    GangTimeline,
+    Span,
+    Tracer,
+    chrome_trace,
+)
+
+from test_e2e_basic import clique, simple_pcs
+
+_TICK = 1e-9  # "within one virtual-clock tick" (acceptance criterion)
+
+
+def traced_harness(nodes=8, **node_kw):
+    return Harness(
+        nodes=make_nodes(nodes, **node_kw),
+        config={"tracing": {"enabled": True}},
+    )
+
+
+def run_spread(h, rounds=12, dt=0.5):
+    """Drive the control plane with the virtual clock advancing BETWEEN
+    rounds, so gang lifecycle phases land at distinct virtual times
+    (one settle() call runs at a single virtual instant)."""
+    for _ in range(rounds):
+        h.clock.advance(dt)
+        h.manager.run_once()
+        h.clock.advance(dt)
+        h.kubelet.tick()
+    h.settle()
+
+
+class TestSpanCausality:
+    def test_parent_child_nesting(self):
+        tr = Tracer()
+        with tr.span("outer") as outer:
+            with tr.span("mid") as mid:
+                with tr.span("inner") as inner:
+                    assert tr.open_depth == 3
+        assert outer.parent_id is None
+        assert mid.parent_id == outer.span_id
+        assert inner.parent_id == mid.span_id
+        assert len(tr.finished) == 3
+
+    def test_reentrant_same_name_nesting(self):
+        # a reconcile driving a nested manager round re-enters the same
+        # instrumentation site: the stack must nest, not confuse spans
+        tr = Tracer()
+        with tr.span("manager.reconcile", controller="a") as a:
+            with tr.span("manager.reconcile", controller="b") as b:
+                pass
+        assert b.parent_id == a.span_id
+
+    def test_exception_unwind_records_error_and_pops(self):
+        tr = Tracer()
+        with pytest.raises(ValueError):
+            with tr.span("outer"):
+                with tr.span("inner"):
+                    raise ValueError("boom")
+        assert tr.open_depth == 0
+        by_name = {sp.name: sp for sp in tr.finished}
+        assert "ValueError: boom" in by_name["inner"].attrs["error"]
+
+    def test_skipped_exit_tolerated(self):
+        # a crash raised through a crash-restart can skip __exit__ calls;
+        # finishing an outer span must clear the abandoned inner frames
+        tr = Tracer()
+        outer = tr.span("outer")
+        tr._enter(outer)
+        inner = tr.span("inner")
+        tr._enter(inner)  # never finished
+        tr._finish(outer)
+        assert tr.open_depth == 0
+
+    def test_point_parents_to_open_span(self):
+        tr = Tracer()
+        with tr.span("solve") as solve:
+            pt = tr.point("bind", gang="ns/g")
+        assert pt.parent_id == solve.span_id
+        assert pt.v0 == pt.v1
+
+    def test_e2e_bind_ancestry_reaches_solve(self):
+        h = traced_harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        spans = list(h.cluster.tracer.finished)
+        by_id = {sp.span_id: sp for sp in spans}
+        binds = [sp for sp in spans if sp.name == "scheduler.bind"]
+        assert binds, "the bound gang must emit a scheduler.bind point"
+        for bind in binds:
+            chain = []
+            cur = bind
+            while cur.parent_id is not None:
+                cur = by_id[cur.parent_id]
+                chain.append(cur.name)
+            assert "scheduler.solve" in chain
+            assert "manager.reconcile" in chain
+        # the reconcile span wrapping the solve is the scheduler's
+        solve = next(sp for sp in spans if sp.name == "scheduler.solve")
+        rec = by_id[solve.parent_id]
+        assert rec.name == "manager.reconcile"
+        assert rec.attrs["controller"] == "scheduler"
+        assert rec.attrs["outcome"] in ("ok", "requeue", "soft-error")
+
+
+class TestGangTimeline:
+    def test_phases_sum_to_bind_latency_plus_startup(self):
+        h = traced_harness()
+        h.apply(simple_pcs(
+            replicas=2,
+            cliques=[clique("fe", 2), clique("be", 2, starts_after=["fe"])],
+            startup="CliqueStartupTypeExplicit",
+        ))
+        run_spread(h)
+        tr = h.cluster.tracer
+        tls = GangTimeline(tr.finished).timelines()
+        assert len(tls) == 2, "both gangs reconstructed"
+        bind_hist = h.cluster.metrics.histogram(
+            "grove_scheduler_gang_bind_latency_seconds"
+        )
+        assert bind_hist.count == 2
+        for key, tl in tls.items():
+            assert tl["complete"], f"{key} incomplete: {tl}"
+            assert tl["pods_expected"] == 4
+            # telescoping: phases sum EXACTLY to (running - created)
+            assert sum(tl["phases"].values()) == pytest.approx(
+                tl["total"], abs=_TICK
+            )
+            assert tl["bind_latency"] + tl["startup"] == pytest.approx(
+                tl["total"], abs=_TICK
+            )
+            assert all(v >= 0.0 for v in tl["phases"].values())
+            assert set(tl["phases"]) == set(GANG_PHASES)
+        # the decomposition's bind latency IS the recorded north-star
+        # metric: per-gang values sum to the histogram's exact sum
+        assert sum(tl["bind_latency"] for tl in tls.values()) == (
+            pytest.approx(bind_hist.sum, abs=2 * _TICK)
+        )
+        # the spread run must actually exercise nonzero phases, or this
+        # test proves nothing
+        assert sum(tl["total"] for tl in tls.values()) > 0.0
+
+    def test_debug_dump_flushes_phase_histogram_idempotently(self):
+        h = traced_harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        run_spread(h, rounds=4)
+        d1 = h.debug_dump()["tracing"]
+        assert d1["enabled"] is True
+        assert d1["gang_timeline"]["complete"] == 1
+        ph = h.cluster.metrics.histogram("grove_trace_gang_phase_seconds")
+        count1 = ph.count
+        assert count1 == len(GANG_PHASES)  # one observation per phase
+        d2 = h.debug_dump()["tracing"]
+        assert ph.count == count1, "repeated dumps must not double-count"
+        assert d2["gang_timeline"]["complete"] == 1
+
+    def test_rebound_gang_keeps_last_bind(self):
+        # two binds for one gang (preempt + rebind): the timeline keys on
+        # the LAST bind and ignores pod points that precede it
+        tr = Tracer()
+        with tr.span("scheduler.solve"):
+            tr.point("scheduler.bind", gang="ns/g", created_at=0.0, pods=1)
+        tr.point("kubelet.pod_start", namespace="ns", gang="g", pod="ns/p0")
+        tr.point("kubelet.pod_ready", namespace="ns", gang="g", pod="ns/p0")
+        tr.clock = type("C", (), {"now": staticmethod(lambda: 5.0)})()
+        with tr.span("scheduler.solve"):
+            tr.point("scheduler.bind", gang="ns/g", created_at=0.0, pods=1)
+        tr.point("kubelet.pod_start", namespace="ns", gang="g", pod="ns/p0")
+        tr.point("kubelet.pod_ready", namespace="ns", gang="g", pod="ns/p0")
+        tls = GangTimeline(tr.finished).timelines()
+        tl = tls["ns/g"]
+        assert tl["complete"]
+        assert tl["checkpoints"]["bound"] == 5.0
+        assert tl["bind_latency"] == pytest.approx(5.0)
+
+
+class TestFlightRecorder:
+    def test_ring_wraparound_fixed_memory(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.add_error("c", "ns", f"obj-{i}", "err", virtual_time=float(i))
+        s = fr.summary()
+        assert s["retained"] == 8
+        assert s["appended"] == 20
+        assert s["dropped"] == 12
+        names = [e["name"] for e in fr.entries()]
+        assert names == [f"obj-{i}" for i in range(12, 20)]
+
+    def test_dump_mixes_spans_errors_events(self):
+        fr = FlightRecorder(capacity=16)
+        tr = Tracer(flight=fr)
+        with tr.span("s"):
+            pass
+        tr.record_error("scheduler", "ns", "g", "boom", 1.0)
+        fr.add_event("Warning", "R", "Pod", "p", "ns", "m", 2.0)
+        dump = fr.dump(wedged={"x": 1})
+        assert dump["format"] == "grove-flight/v1"
+        assert dump["wedged"] == {"x": 1}
+        assert {e["type"] for e in dump["entries"]} == {
+            "span", "error", "event",
+        }
+        json.dumps(dump)  # JSON-able end to end
+
+    def test_late_span_attrs_reach_flight_ring(self):
+        # the runtime stamps outcome/attempt AFTER the reconcile span
+        # closes (runtime.py "tags land after the fact"); the flight
+        # entry aliases the span's live attrs dict, so postmortem dumps
+        # must still carry them — a deep copy in add_span would silently
+        # erase failed-vs-ok from every chaos artifact
+        fr = FlightRecorder(capacity=8)
+        tr = Tracer(flight=fr)
+        with tr.span("manager.reconcile") as sp:
+            pass
+        sp.set(outcome="error", attempt=3)
+        entry = json.loads(json.dumps(fr.dump()))["entries"][0]
+        assert entry["attrs"] == {"outcome": "error", "attempt": 3}
+
+    def test_events_feed_flight_via_store_hook(self):
+        h = traced_harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        types = {e["type"] for e in h.cluster.flight.entries()}
+        assert "event" in types and "span" in types
+
+
+class TestChaosFlightDump:
+    def test_wedged_dump_names_stuck_gang(self):
+        # a gang that can never place: the postmortem must NAME it
+        ch = ChaosHarness(
+            FaultPlan.from_seed(1, chaos_steps=0),
+            nodes=make_nodes(2, allocatable={"cpu": 1.0, "memory": 1.0,
+                                             "tpu": 0.0}),
+        )
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=5.0)]))
+        ch.settle()
+        dump = ch.dump_flight()
+        assert dump["summary"]["retained"] > 0
+        stuck = dump["wedged"]["unscheduled_gangs"]
+        assert [g["name"] for g in stuck] == ["default/simple1-0"]
+        assert dump["wedged"]["stuck_pods"], "unbound pods named too"
+
+    def test_failed_settle_autodumps_to_trace_path(self, tmp_path):
+        path = tmp_path / "flight.json"
+        ch = ChaosHarness(
+            FaultPlan.from_seed(2, chaos_steps=0),
+            nodes=make_nodes(4),
+            trace_path=str(path),
+        )
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.settle()  # populate the ring before the wedge
+
+        def boom(max_iters):
+            raise RuntimeError("wedged")
+
+        ch._settle_recovered = boom
+        with pytest.raises(RuntimeError):
+            ch.settle_recovered()
+        data = json.loads(path.read_text())
+        assert data["format"] == "grove-flight/v1"
+        assert data["entries"]
+
+    def test_chaos_run_converges_with_flight_recorder_on(self):
+        # the always-on flight recorder must not perturb convergence
+        plan = FaultPlan.from_seed(5)
+        ch = ChaosHarness(plan, nodes=make_nodes(8))
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.run_chaos()
+        assert ch.flight.appended > 0
+
+
+class TestChromeTrace:
+    def _spans(self):
+        h = traced_harness()
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        run_spread(h, rounds=4)
+        return list(h.cluster.tracer.finished)
+
+    def test_schema(self):
+        spans = self._spans()
+        doc = chrome_trace({"grove": spans})
+        events = doc["traceEvents"]
+        # metadata + one event per span
+        assert len(events) == len(spans) + 1
+        for ev in events:
+            assert set(ev) >= {"name", "ph", "pid", "tid"}
+            assert ev["ph"] in ("X", "i", "M")
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0.0
+                assert ev["ts"] >= 0.0
+            if ev["ph"] == "i":
+                assert ev["s"] == "t"
+            if ev["ph"] != "M":
+                assert isinstance(ev["args"]["span_id"], int)
+                for v in ev["args"].values():
+                    assert isinstance(v, (str, int, float, bool, type(None)))
+        json.loads(json.dumps(doc))  # loadable round trip
+
+    def test_cli_converts_trace_and_flight_dumps(self, tmp_path, capsys):
+        from grove_tpu.observability.trace import main as trace_main
+
+        spans = self._spans()
+        tr_dump = tmp_path / "dump.json"
+        tr_dump.write_text(json.dumps(
+            {"format": "grove-trace/v1",
+             "spans": [sp.to_dict() for sp in spans]}
+        ))
+        out = tmp_path / "chrome.json"
+        assert trace_main([str(tr_dump), "-o", str(out), "--summary"]) == 0
+        doc = json.loads(out.read_text())
+        assert len(doc["traceEvents"]) == len(spans) + 1
+
+        fr = FlightRecorder(capacity=64)
+        for sp in spans:
+            fr.add_span(sp)
+        fl_dump = tmp_path / "flight.json"
+        fl_dump.write_text(json.dumps(fr.dump()))
+        out2 = tmp_path / "chrome2.json"
+        assert trace_main([str(fl_dump), "-o", str(out2)]) == 0
+        assert json.loads(out2.read_text())["traceEvents"]
+
+    def test_span_roundtrip(self):
+        sp = Span(None, "n", 3, 1, 1.0, 2.0, {"k": "v"})
+        sp.v1, sp.t1 = 4.0, 2.5
+        back = Span.from_dict(json.loads(json.dumps(sp.to_dict())))
+        assert back.to_dict() == sp.to_dict()
+
+    def test_tracer_groups_share_one_time_axis(self):
+        # regression: span t0/t1 are relative to the PRIVATE epoch of
+        # the recording tracer, so merging raw span lists from tracers
+        # created at different times stacked every group at ts~0 and
+        # sequential bench stages rendered as concurrent. Passing the
+        # Tracer objects shifts each group by its epoch delta from the
+        # earliest one.
+        a, b = Tracer(), Tracer()
+        a._t_base, b._t_base = 100.0, 103.0  # b's epoch: 3 s after a's
+        for tr in (a, b):
+            sp = Span(None, "work", 1, None, 0.0, 0.25, {})
+            sp.t1 = 0.5
+            tr.finished.append(sp)
+        xs = {
+            ev["pid"]: ev
+            for ev in chrome_trace({"a": a, "b": b})["traceEvents"]
+            if ev["ph"] == "X"
+        }
+        assert xs[1]["ts"] == pytest.approx(0.25e6)  # earliest: no shift
+        assert xs[2]["ts"] == pytest.approx(3.25e6)  # shifted by +3 s
+        assert xs[1]["dur"] == xs[2]["dur"] == pytest.approx(0.25e6)
+        # raw span lists keep the un-shifted single-tracer behavior
+        raw = {
+            ev["pid"]: ev
+            for ev in chrome_trace(
+                {"a": list(a.finished), "b": list(b.finished)}
+            )["traceEvents"]
+            if ev["ph"] == "X"
+        }
+        assert raw[1]["ts"] == raw[2]["ts"] == pytest.approx(0.25e6)
+
+
+class TestDisabledPath:
+    def test_noop_singleton_allocates_nothing(self, monkeypatch):
+        assert NOOP_TRACER.span("a", x=1) is NOOP_TRACER.span("b")
+        assert NOOP_TRACER.point("c") is NOOP_TRACER.span("d")
+        # the overhead smoke: with tracing off, a full control-plane run
+        # must construct ZERO Span objects
+        def forbid(*a, **k):
+            raise AssertionError("Span allocated on the disabled path")
+
+        monkeypatch.setattr(tracing.Span, "__init__", forbid)
+        h = Harness(nodes=make_nodes(8))
+        assert h.cluster.tracer is NOOP_TRACER
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        h.advance(5.0)
+        assert NOOP_TRACER.finished == ()
+        assert h.debug_dump()["tracing"] == {"enabled": False}
+
+    def test_enable_tracing_idempotent_and_config_driven(self):
+        h = traced_harness()
+        t1 = h.cluster.tracer
+        assert t1.enabled
+        assert h.cluster.enable_tracing() is t1
+        assert h.kubelet.tracer is t1
+        assert h.manager.tracer is t1
+        assert h.scheduler.tracer is t1
+
+    def test_tracing_config_validated(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(Exception) as ei:
+            load_operator_config({"tracing": {"max_spans": 0}})
+        assert "tracing.max_spans" in str(ei.value)
+        with pytest.raises(Exception) as ei:
+            load_operator_config(
+                {"tracing": {"flight_recorder_capacity": -1}}
+            )
+        assert "flight_recorder_capacity" in str(ei.value)
+
+    def test_bounded_span_ring(self):
+        tr = Tracer(max_spans=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.finished) == 4
+        assert tr.spans_started == 10
+        s = tr.summary()
+        assert s["spans_retained"] == 4 and s["spans_started"] == 10
+
+
+class StrictEngine(PlacementEngine):
+    """PlacementEngine with a pre-tracing signature: no `tracer`
+    keyword, no **kwargs — the shape of a user-supplied engine class
+    written before this layer existed."""
+
+    def __init__(self, snapshot, top_k=8, native_repair=True,
+                 commit_chunk=32, bucket_min=8, metrics=None):
+        super().__init__(snapshot, top_k=top_k,
+                         native_repair=native_repair,
+                         commit_chunk=commit_chunk,
+                         bucket_min=bucket_min, metrics=metrics)
+
+
+class TestTracerInjectionGate:
+    def test_accepts_tracer_kwarg(self):
+        assert tracing.accepts_tracer_kwarg(PlacementEngine)
+        assert not tracing.accepts_tracer_kwarg(StrictEngine)
+
+        class VarKw:
+            def __init__(self, snapshot, **kwargs):
+                pass
+
+        assert tracing.accepts_tracer_kwarg(VarKw)
+
+    def test_strict_engine_survives_always_on_chaos_tracing(self):
+        # regression: ChaosHarness force-enables tracing for the flight
+        # recorder, and the scheduler used to unconditionally inject
+        # tracer= into the engine kwargs — a custom engine class with a
+        # strict signature died with TypeError at its first solve. It
+        # must instead run untraced.
+        ch = ChaosHarness(
+            FaultPlan.from_seed(3, chaos_steps=0),
+            nodes=make_nodes(4),
+            engine_cls=StrictEngine,
+        )
+        assert ch.harness.cluster.tracer.enabled
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.settle()
+        assert "tracer" not in ch.harness.scheduler._engine_kwargs
+        # the gang still binds end-to-end; only ENGINE sub-spans are
+        # missing, the scheduler/kubelet lifecycle is still traced
+        hist = ch.harness.cluster.metrics.histogram(
+            "grove_scheduler_gang_bind_latency_seconds"
+        )
+        assert hist.count == 1
+        tls = GangTimeline(ch.harness.cluster.tracer.finished).timelines()
+        assert len(tls) == 1 and next(iter(tls.values()))["complete"]
