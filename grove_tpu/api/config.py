@@ -94,6 +94,19 @@ class SolverConfig:
     # capacity-starved higher-priority gangs may evict lower-priority
     # SCALED gangs (never base gangs) and re-solve.
     preemption_enabled: bool = True
+    # Device-resident cluster state (solver/engine.py): the free-capacity
+    # matrix lives on the accelerator across solves behind an epoch
+    # counter; warm solves ship row deltas (or nothing) instead of the
+    # full [N, R] re-encode, and dispatch-adoption staleness becomes an
+    # O(1) epoch compare. Off = the pre-delta behavior (full re-encode
+    # per solve + content-compare guard), kept for A/B benches and the
+    # CI equivalence smoke.
+    device_state_cache: bool = True
+    # Debug assert: re-run the O(N*R) content compare next to every epoch
+    # decision and raise on disagreement (a note_free_rows superset-
+    # contract breach). Costs exactly the compare the cache exists to
+    # avoid — tests and chaos sweeps only.
+    device_state_verify: bool = False
 
 
 @dataclass
@@ -325,6 +338,19 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append("config.solver.native_repair: must be a bool")
     if not isinstance(sv.preemption_enabled, bool):
         errs.append("config.solver.preemption_enabled: must be a bool")
+    if not isinstance(sv.device_state_cache, bool):
+        errs.append("config.solver.device_state_cache: must be a bool")
+    if not isinstance(sv.device_state_verify, bool):
+        errs.append("config.solver.device_state_verify: must be a bool")
+    elif sv.device_state_verify and sv.device_state_cache is False:
+        # the tripwire re-checks the cache's epoch decisions; with the
+        # cache off there is nothing to verify and the flag would be
+        # silently inert — reject rather than hand out false confidence
+        errs.append(
+            "config.solver.device_state_verify: requires "
+            "device_state_cache (the verify tripwire checks the cache's "
+            "epoch guard; with the cache off it never runs)"
+        )
 
     le = cfg.leader_election
     if not isinstance(le.enabled, bool):

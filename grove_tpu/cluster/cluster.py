@@ -103,6 +103,15 @@ class Cluster:
         self._usage: dict[str, dict[str, float]] | None = None
         self._usage_cursor = 0
         self._req_cache: dict[int, tuple] = {}
+        #: free-delta journal for the solver's device-resident state:
+        #: node names whose usage changed since the last
+        #: consume_free_dirty() drain. None = unknown (nobody consumed
+        #: yet, or a full usage rebuild crossed a compaction horizon) —
+        #: consumers must fall back to a full content diff.
+        self._free_dirty: set[str] | None = None
+        #: monotonic free-content epoch stamped onto snapshots (bumped
+        #: whenever usage() observed any capacity-moving pod transition)
+        self._free_epoch = 0
 
     # -- tracing ------------------------------------------------------------
     def enable_tracing(self, max_spans: int | None = None,
@@ -310,6 +319,10 @@ class Cluster:
             events = None  # compacted past the cursor: rebuild below
         if events is None or self._usage is None:
             self._usage_cursor = self.store.last_seq
+            # full rebuild: per-row change tracking is lost — consumers
+            # of the free journal must fall back to a full diff
+            self._free_dirty = None
+            self._free_epoch += 1
             self._usage = out = {}
             for pod in self.store.scan(Pod.KIND):
                 if self._counted(pod):
@@ -320,6 +333,7 @@ class Cluster:
         if events:
             self._usage_cursor = events[-1].seq
         out = self._usage
+        moved = False
         for ev in events:
             if ev.kind != Pod.KIND:
                 continue
@@ -341,6 +355,11 @@ class Cluster:
             sign = 1.0 if now_ else -1.0
             for res, amount in self._pod_requests(pod).items():
                 per_node[res] = per_node.get(res, 0.0) + sign * amount
+            moved = True
+            if self._free_dirty is not None:
+                self._free_dirty.add(pod.node_name)
+        if moved:
+            self._free_epoch += 1
         return out
 
     def live_topology(self) -> ClusterTopology:
@@ -375,11 +394,29 @@ class Cluster:
                 usage=self.usage(),
             )
             self._snapshot_key, self._snapshot_cache = key, snap
+            snap.free_epoch = self._free_epoch
             return snap
         from ..topology.encoding import apply_usage
 
         apply_usage(snap, self.usage())
+        snap.free_epoch = self._free_epoch
         return snap
+
+    def consume_free_dirty(self, snapshot: TopologySnapshot) -> list[int] | None:
+        """Drain the free-delta journal: row indices (into `snapshot`)
+        whose free capacity MAY have changed since the previous drain, or
+        None when the set is unknowable (first drain, or a usage rebuild
+        crossed a compaction horizon). Superset contract, same as
+        PlacementEngine.note_free_rows — the scheduler feeds the result
+        straight through so a warm solve's device-state sync checks a
+        handful of rows instead of running the full O(N*R) diff. Call
+        AFTER topology_snapshot() so the journal reflects every event the
+        usage accounting has drained."""
+        dirty, self._free_dirty = self._free_dirty, set()
+        if dirty is None:
+            return None
+        index = snapshot.node_index
+        return [index[n] for n in dirty if n in index]
 
     def pod_demand_fn(self, resource_names: list[str]):
         """pod_demand callable for solver.problem.encode_podgangs."""

@@ -37,7 +37,7 @@ from ..observability.events import (
     REASON_PODGANG_SCHEDULED,
     REASON_PODGANG_UNSCHEDULABLE,
 )
-from ..observability.tracing import accepts_tracer_kwarg
+from ..observability.tracing import accepts_kwarg, accepts_tracer_kwarg
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import (
     UNRESOLVED_LEVEL,
@@ -83,6 +83,17 @@ class GangScheduler:
             bucket_min=cfg.solver.gang_bucket_minimum,
             metrics=cluster.metrics,
         )
+        # device-resident free-state knobs, each gated like the tracer: a
+        # strict-signature custom engine runs without the capability
+        # rather than dying on an unexpected keyword
+        if accepts_kwarg(engine_cls, "state_cache"):
+            self._engine_kwargs["state_cache"] = (
+                cfg.solver.device_state_cache
+            )
+        if accepts_kwarg(engine_cls, "state_verify"):
+            self._engine_kwargs["state_verify"] = (
+                cfg.solver.device_state_verify
+            )
         if cluster.tracer.enabled and accepts_tracer_kwarg(engine_cls):
             # only injected when tracing is on AND the engine can take
             # it: a custom engine class with a strict signature keeps
@@ -131,9 +142,9 @@ class GangScheduler:
         self._prio_cache: tuple[int, dict[str, float], float] | None = None
         #: async solve prepared by pre_round: (event-log seq at dispatch,
         #: backlog keys, PodGang copies, encoded SolverGangs,
-        #: engine.SolveDispatch — whose free0 carries the free matrix).
-        #: Consumed (or discarded as stale) by the same round's
-        #: _reconcile — see pre_round.
+        #: engine.SolveDispatch — which carries the device-state epoch
+        #: its scores were computed against). Consumed (or discarded as
+        #: stale) by the same round's _reconcile — see pre_round.
         self._pending = None
         #: seqs of OUR OWN PodGang status writes (bind/evict/phase/
         #: unschedulable): gang-status output never feeds gang-status
@@ -142,6 +153,12 @@ class GangScheduler:
         #: settle at stress scale — one round after every real one. Same
         #: expectations-style pattern as podclique._own_events.
         self._own_events: set[int] = set()
+        #: snapshot free_epoch at the last journal drain: the cluster
+        #: stamps it whenever usage moved, and the free-delta journal can
+        #: only gain rows when it moves, so an unchanged stamp lets
+        #: _feed_free_journal skip the drain entirely (-1 = never drained;
+        #: the first drain must run, it returns the unknown-scope None)
+        self._free_epoch_seen = -1
 
     def _mark_own(self) -> None:
         """Record the seq of a PodGang status write this scheduler just
@@ -283,10 +300,53 @@ class GangScheduler:
     def _engine_for(self, snapshot):
         """Engine bound to the snapshot, reused while the static encoding
         is unchanged (identity check against the cluster cache) — rebuilding
-        the domain index over 5k nodes per reconcile was measurable."""
-        if getattr(self._engine, "snapshot", None) is not snapshot:
-            self._engine = self.engine_cls(snapshot, **self._engine_kwargs)
+        the domain index over 5k nodes per reconcile was measurable. On a
+        snapshot rebuild the engine is offered a rebind first: node
+        cordon/uncordon and Ready/NotReady transitions only flip
+        `schedulable` bits, and a rebound engine keeps its device-resident
+        free state (the flipped rows ride the delta upload) instead of
+        paying a rebuild + full H2D re-encode per lifecycle transition."""
+        engine = self._engine
+        if getattr(engine, "snapshot", None) is not snapshot:
+            rebind = getattr(engine, "rebind", None)
+            if rebind is None or not rebind(snapshot):
+                self._engine = self.engine_cls(
+                    snapshot, **self._engine_kwargs
+                )
         return self._engine
+
+    def _note_free_rows(self, engine, rows) -> None:
+        """Forward a free-mutation declaration to the engine's device-
+        state cache when it has one (note_free_rows superset contract;
+        None = unknown). Every scheduler-side mutation of the round's
+        free matrix — reservation commits, vacated-hint binds, serial
+        singles — flows through here, so a warm solve's sync checks a
+        handful of rows instead of diffing the full [N, R] matrix."""
+        note = getattr(engine, "note_free_rows", None)
+        if note is not None:
+            note(rows)
+
+    def _feed_free_journal(self, engine, snapshot) -> None:
+        """Drain the cluster's free-delta journal (node rows whose usage
+        changed since the last drain — pod bind/unbind/terminal
+        transitions, evictions, node-loss sweeps) into the engine's
+        device-state cache. Runs right before every dispatch/solve; the
+        journal is only consumed when the engine can accept it, so a
+        custom engine without the cache loses nothing. The snapshot's
+        free_epoch stamp short-circuits the drain: the journal can only
+        gain rows when the cluster's usage accounting moved, and every
+        such move bumps the stamp."""
+        if getattr(engine, "note_free_rows", None) is None:
+            return
+        if snapshot.free_epoch == self._free_epoch_seen:
+            # nothing moved since the last drain — declare the EMPTY row
+            # set (not nothing): an undeclared sync falls back to the
+            # full O(N*R) content diff, which would invert this
+            # optimization on exactly the no-op retry rounds it targets
+            engine.note_free_rows(())
+            return
+        self._free_epoch_seen = snapshot.free_epoch
+        engine.note_free_rows(self.cluster.consume_free_dirty(snapshot))
 
     def _fetch_and_encode(self, backlog_keys, snapshot):
         """Backlog fetch (real copies — status writes follow) + solver
@@ -345,6 +405,7 @@ class GangScheduler:
                 return
             snapshot = self.cluster.topology_snapshot()
             engine = self._engine_for(snapshot)
+            self._feed_free_journal(engine, snapshot)
             if getattr(engine, "dispatch", None) is None:
                 return  # custom engine without async support (tests)
             backlog, encoded = self._fetch_and_encode(backlog_keys, snapshot)
@@ -467,6 +528,7 @@ class GangScheduler:
 
         snapshot = self.cluster.topology_snapshot()
         engine = self._engine_for(snapshot)
+        self._feed_free_journal(engine, snapshot)
         free = snapshot.free.copy()
         demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
         sched_fn = self.cluster.pod_scheduling_fn()
@@ -537,7 +599,7 @@ class GangScheduler:
         solver_by_name = {g.name: g for g in encoded}
         by_name = {g.metadata.name: g for g in backlog}
         solver_gangs = self._try_reserved(
-            encoded, by_name, snapshot, free
+            encoded, by_name, snapshot, free, engine
         )
         result = (
             engine.solve(solver_gangs, free=free, dispatch=dispatch)
@@ -759,7 +821,8 @@ class GangScheduler:
 
     # -- reservation reuse (podgang.go:66-72; exceeds the reference, which
     # declares the field but never consumes it) ------------------------------
-    def _try_reserved(self, solver_gangs, by_name, snapshot, free):
+    def _try_reserved(self, solver_gangs, by_name, snapshot, free,
+                      engine=None):
         """Before general search, try to place gangs that name a
         predecessor in reuse_reservation_ref onto that predecessor's
         remembered nodes (exact fit semantics, mutating free on success).
@@ -828,6 +891,12 @@ class GangScheduler:
                 # reservation gone/too small: general solve handles it
                 remaining.append(sg)
                 continue
+            # declare the committed rows to the device-state cache NOW,
+            # even if the no-inversion trial below rolls the commit back:
+            # the rollback's subtract-then-add float round trip need not
+            # be bitwise, and note_free_rows is a superset contract —
+            # over-declaring an unchanged row costs one row compare
+            self._note_free_rows(engine, assign.tolist())
             if higher:
                 # exact no-inversion check: commit only if the skipped
                 # higher-priority gangs all still place AFTER this
@@ -1202,6 +1271,7 @@ class GangScheduler:
                             )
                         ):
                             free[i] -= demand
+                            self._note_free_rows(engine, (int(i),))
                             del self._vacated[key]
                             continue
                     singles.append(
@@ -1235,6 +1305,12 @@ class GangScheduler:
 
             result = solve_serial(snapshot, singles, free=free)
             record_solve_metrics(self.metrics, result, len(singles))
+            # the serial path committed into `free` outside the engine's
+            # sight: declare its rows to the device-state cache
+            for placement in result.placed.values():
+                self._note_free_rows(
+                    engine, placement.node_indices.tolist()
+                )
         else:
             result = engine.solve(singles, free=free)
         for placement in result.placed.values():

@@ -165,20 +165,26 @@ class NoopTracer:
 NOOP_TRACER = NoopTracer()
 
 
-def accepts_tracer_kwarg(cls) -> bool:
-    """True when `cls(...)` can take a `tracer` keyword — named parameter
+def accepts_kwarg(cls, name: str) -> bool:
+    """True when `cls(...)` can take the `name` keyword — named parameter
     or **kwargs. Engine holders (GangScheduler, PlacementService) gate
-    tracer injection on this so a custom engine class with a strict
-    signature keeps working untraced instead of dying on an unexpected
-    keyword at the first solve."""
+    optional-capability kwargs (tracer injection, device-state knobs) on
+    this so a custom engine class with a strict signature keeps working
+    with the capability off instead of dying on an unexpected keyword at
+    the first solve."""
     try:
         params = inspect.signature(cls).parameters.values()
     except (TypeError, ValueError):  # uninspectable (C-level): assume yes
         return True
     return any(
-        p.kind is inspect.Parameter.VAR_KEYWORD or p.name == "tracer"
+        p.kind is inspect.Parameter.VAR_KEYWORD or p.name == name
         for p in params
     )
+
+
+def accepts_tracer_kwarg(cls) -> bool:
+    """accepts_kwarg specialization kept for its existing callers."""
+    return accepts_kwarg(cls, "tracer")
 
 
 class Tracer:

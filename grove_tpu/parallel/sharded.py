@@ -29,15 +29,26 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..solver.engine import (
     PlacementEngine,
+    _scatter_rows,
     commit_scan,
     membership_matrix,
     value_from_aggregates,
 )
 from ..topology.encoding import TopologySnapshot
+
+try:
+    # jax >= 0.5: shard_map is top level and the replication checker is
+    # spelled check_vma
+    _shard_map = jax.shard_map
+    _CHECK_KW = {"check_vma": True}
+except AttributeError:  # jax 0.4.x: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = {"check_rep": True}
 
 
 def make_solver_mesh(devices=None, gang_axis: int | None = None) -> Mesh:
@@ -79,7 +90,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
     and leaving replication asserted by parity tests alone)."""
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(
             P("nodes", None),    # free        [N, R]
@@ -96,7 +107,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             P(),                 # cap_scale   [R]
         ),
         out_specs=(P("gangs", None), P()),  # value [G, D], dom_free [D, R]
-        check_vma=True,
+        **_CHECK_KW,
     )
     def score(free, gdom, dom_level, total_demand, u_sig_demand,
               u_sig_mask, elig_masks, sig_idx, required_level,
@@ -148,6 +159,13 @@ class ShardedPlacementEngine(PlacementEngine):
             min(self.top_k, self.space.num_domains),
             self.commit_chunk,
         )  # jit caches per input shape; one wrapper serves all of them
+        #: mesh placement for the resident free state (shard_map's free
+        #: in_spec); uploads go through make_array_from_callback — each
+        #: process materializes its own addressable shards from the
+        #: (identical) host matrix, with no collective — NOT
+        #: jax.device_put, whose host-value equality check is a
+        #: collective the multi-process CPU backend cannot run.
+        self._free_sharding = NamedSharding(mesh, P("nodes", None))
 
     def _pad_nodes(self, arr: np.ndarray, axis: int, mult: int) -> np.ndarray:
         n = arr.shape[axis]
@@ -171,8 +189,37 @@ class ShardedPlacementEngine(PlacementEngine):
             gdom, ((0, 0), (0, pad)), constant_values=self.space.num_domains
         )
 
-    def _device_begin(self, dev_free, total_demand, sig, required_level,
+    def _state_put(self, masked: np.ndarray):
+        """Device-resident free state for the mesh: the masked matrix is
+        padded to the nodes axis (zero-capacity dummy rows) and committed
+        with the same P("nodes", None) sharding the score fn expects, so
+        warm solves hand the resident buffer straight to shard_map with
+        no placement work."""
+        padded = self._pad_nodes(masked, 0, self.mesh.shape["nodes"])
+        return jax.make_array_from_callback(
+            padded.shape, self._free_sharding, lambda idx: padded[idx]
+        )
+
+    def _state_delta(self, dev, upd: np.ndarray):
+        """Scatter-update rows of the sharded resident state. The update
+        rows are first committed replicated (make_array_from_callback —
+        multi-process-safe, see _state_put), then the jitted scatter runs
+        on the mesh; no donation, so the buffer's sharding survives.
+        Padding rows target real row index N, which on the padded mesh
+        buffer is a zero dummy row receiving zeros — a no-op by
+        construction."""
+        upd_dev = jax.make_array_from_callback(
+            upd.shape, NamedSharding(self.mesh, P()), lambda idx: upd[idx]
+        )
+        return _scatter_rows(dev, upd_dev)
+
+    def _device_begin(self, total_demand, sig, required_level,
                       preferred_level, valid, cap_scale):
+        if self._state.dev is None:
+            raise RuntimeError(
+                "device free state not synced: _device_begin requires a "
+                "_sync_free call first (solve/dispatch do this)"
+            )
         nodes_axis = self.mesh.shape["nodes"]
         gangs_axis = self.mesh.shape["gangs"]
         # pad gang arrays (already bucketed to a power of two upstream) if
@@ -186,22 +233,40 @@ class ShardedPlacementEngine(PlacementEngine):
         # them per in_specs onto the MESH's devices. An eager jnp.asarray
         # here would commit them to the default backend instead — under the
         # driver env that default is a TPU client the dry run must not touch.
-        top_val, top_dom = self._fn(
-            self._pad_nodes(dev_free, 0, nodes_axis),
-            self._pad_gdom(self.space.gdom, nodes_axis),
-            self.space.dom_level,
-            self.space.anc_ids,
+        # (The free matrix is the exception: it lives mesh-resident behind
+        # _sync_free/_state_put across solves.)
+        gang_inputs = (
             pad_g(total_demand),
             u_sig_demand,
             u_sig_mask,
-            # dummy node columns get mask 0 (ineligible); they carry zero
-            # free capacity anyway, but a zero-demand signature row would
-            # otherwise count them as fitting
-            self._pad_nodes(elig_masks, 1, nodes_axis),
             pad_g(sig_idx),
             pad_g(required_level),
             pad_g(preferred_level),
             pad_g(valid),
+        )
+        # dummy node columns get mask 0 (ineligible); they carry zero
+        # free capacity anyway, but a zero-demand signature row would
+        # otherwise count them as fitting
+        masks = self._pad_nodes(elig_masks, 1, nodes_axis)
+        # unlike the single-device io_pack path there is no bit-identical
+        # reuse here (shard_map re-places per call), so every solve ships
+        # these — count them or the sharded transport story reads as
+        # "inputs never move", inverting the documented health signal
+        self._count_bytes("inputs", sum(a.nbytes for a in gang_inputs))
+        self._count_bytes("masks", masks.nbytes)
+        top_val, top_dom = self._fn(
+            self._state.dev,
+            self._pad_gdom(self.space.gdom, nodes_axis),
+            self.space.dom_level,
+            self.space.anc_ids,
+            gang_inputs[0],
+            gang_inputs[1],
+            gang_inputs[2],
+            masks,
+            gang_inputs[3],
+            gang_inputs[4],
+            gang_inputs[5],
+            gang_inputs[6],
             cap_scale,
         )
         top_val.copy_to_host_async()
@@ -210,4 +275,6 @@ class ShardedPlacementEngine(PlacementEngine):
 
     def _device_end(self, token):
         top_val, top_dom, g = token
-        return np.asarray(top_val)[:g], np.asarray(top_dom)[:g]
+        val, dom = np.asarray(top_val)[:g], np.asarray(top_dom)[:g]
+        self._count_bytes("results", val.nbytes + dom.nbytes)
+        return val, dom

@@ -74,6 +74,14 @@ class TopologySnapshot:
     capacity: np.ndarray                  # float32 [N, R] allocatable
     free: np.ndarray                      # float32 [N, R] allocatable - used
     schedulable: np.ndarray               # bool [N]
+    #: monotonic free-content stamp (Cluster.topology_snapshot bumps it
+    #: whenever the usage underlying `free` changed since the previous
+    #: snapshot refresh). An unchanged stamp proves the cluster's
+    #: free-delta journal gained no rows, letting the scheduler skip the
+    #: journal drain before a solve (GangScheduler._feed_free_journal —
+    #: the cluster-side half of the solver's device-resident state
+    #: discipline in solver/engine.py _sync_free).
+    free_epoch: int = 0
     node_labels: list[dict] = field(default_factory=list, repr=False)
     node_taints: list[list] = field(default_factory=list, repr=False)
     _memberships: dict[int, np.ndarray] = field(default_factory=dict, repr=False)

@@ -73,6 +73,19 @@ class TestConfigDecode:
                 {"authorization": {"enabled": True, "operator_identity": ""}}
             )
 
+    def test_device_state_verify_requires_cache(self):
+        errs = validate_operator_config(
+            load_operator_config(
+                {"solver": {"device_state_cache": False}}
+            )
+        )
+        assert errs == []  # cache off alone is a valid A/B regime
+        with pytest.raises(ValidationError, match="device_state_verify"):
+            load_operator_config(
+                {"solver": {"device_state_cache": False,
+                            "device_state_verify": True}}
+            )
+
     def test_backoff_fields_decode_and_defaults(self):
         cfg = load_operator_config({})
         assert cfg.controllers.error_backoff_base_seconds == 1.0
@@ -198,6 +211,8 @@ class TestConfigChangesBehavior:
                     "commit_chunk": 16,
                     "gang_bucket_minimum": 4,
                     "native_repair": False,
+                    "device_state_cache": True,
+                    "device_state_verify": True,
                 }
             },
         )
@@ -209,6 +224,8 @@ class TestConfigChangesBehavior:
             "commit_chunk": 16,
             "bucket_min": 4,
             "native_repair": False,
+            "state_cache": True,
+            "state_verify": True,
         }
         assert all(p.node_name for p in h.store.list(Pod.KIND))
 

@@ -1,0 +1,478 @@
+"""Device-resident free-state tests (solver/engine.py _sync_free et al).
+
+The delta upload path is an optimization of WHERE the free matrix lives,
+never of what is computed: after any seeded sequence of declared
+mutations the resident device buffer must decode bit-equal to a fresh
+full encode, the O(1) epoch guard must make exactly the adopt/reject
+decisions the old O(N*R) content compare made, and a mutation that
+bypasses the note_free_rows superset contract must fail loudly under
+solver.device_state_verify — never be adopted silently. The chaos class
+asserts the end-to-end version: identical pod placements between the
+delta and full engines under seeded node_flap / domain_outage storms.
+"""
+
+import dataclasses
+import io
+
+import numpy as np
+import pytest
+
+from grove_tpu.cluster import Cluster, make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability import MetricsRegistry
+from grove_tpu.observability.tracing import Tracer
+from grove_tpu.solver import PlacementEngine
+
+from test_cluster import make_pod
+from test_solver import cluster, gang
+
+
+def flip_schedulable(snap, rows):
+    """A rebuild-shaped snapshot: same statics, `rows` toggled."""
+    sched = snap.schedulable.copy()
+    sched[list(rows)] = ~sched[list(rows)]
+    return dataclasses.replace(snap, schedulable=sched)
+
+
+def decoded_state(eng):
+    """Host view of the resident device buffer (unpadded rows)."""
+    return np.asarray(eng._state.dev)[: eng.snapshot.num_nodes]
+
+
+class TestStateSync:
+    def test_seeded_deltas_decode_bit_equal_to_full_encode(self):
+        """Property: after K seeded random rounds of declared row
+        mutations, unknown-scope declarations, and schedulable flips via
+        rebind, the device buffer always decodes bit-equal to a fresh
+        full encode of the current free matrix."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=16.0)
+        eng = PlacementEngine(snap)
+        rng = np.random.default_rng(11)
+        n = snap.num_nodes
+        free = snap.free.copy()
+        eng._sync_free(free)
+        epochs = [eng._state.epoch]
+        for k in range(40):
+            kind = rng.integers(4)
+            if kind == 0:  # declared row churn (bind/unbind shape)
+                rows = rng.choice(n, size=int(rng.integers(1, 5)),
+                                  replace=False)
+                free[rows] *= rng.uniform(
+                    0.3, 1.0, size=(rows.size, 1)
+                ).astype(np.float32)
+                eng.note_free_rows(rows.tolist())
+            elif kind == 1:  # unknown-scope declaration (full diff)
+                row = int(rng.integers(n))
+                free[row] = snap.capacity[row]
+                eng.note_free_rows(None)
+            elif kind == 2:  # schedulable flip riding rebind's delta
+                rows = rng.choice(n, size=2, replace=False)
+                snap2 = flip_schedulable(eng.snapshot, rows)
+                assert eng.rebind(snap2)
+            # kind == 3: no mutation at all (pure hit round)
+            eng._sync_free(free)
+            masked = eng._masked_free(free)
+            np.testing.assert_array_equal(eng._state.mirror, masked)
+            np.testing.assert_array_equal(decoded_state(eng), masked)
+            epochs.append(eng._state.epoch)
+        # epochs are monotonic and moved only on content change
+        assert epochs == sorted(epochs)
+        st = eng._state
+        assert st.hits > 0 and st.delta_uploads > 0 and st.full_uploads >= 1
+
+    def test_unchanged_content_is_a_hit_not_an_upload(self):
+        snap = cluster()
+        eng = PlacementEngine(snap)
+        free = snap.free.copy()
+        e0 = eng._sync_free(free)
+        e1 = eng._sync_free(snap.free.copy())  # same content, other array
+        assert e0 == e1
+        assert eng._state.hits == 1
+        assert eng._state.full_uploads == 1
+        assert eng._state.delta_uploads == 0
+
+    def test_bulk_divergence_falls_back_to_full_upload(self):
+        snap = cluster(blocks=4, racks=4, hosts=8, cpu=16.0)  # 128 nodes
+        eng = PlacementEngine(snap)
+        assert snap.num_nodes > eng._delta_rows_max
+        free = snap.free.copy()
+        eng._sync_free(free)
+        free *= 0.5  # every row moved: a delta would ship the matrix
+        eng.note_free_rows(range(snap.num_nodes))
+        eng._sync_free(free)
+        assert eng._state.full_uploads == 2
+        assert eng._state.delta_uploads == 0
+        np.testing.assert_array_equal(
+            decoded_state(eng), eng._masked_free(free)
+        )
+
+    def test_undeclared_mutation_raises_under_verify(self):
+        """A row mutated OUTSIDE a row-scoped declaration is the breach:
+        the sync only re-reads the declared rows, so the mirror goes
+        stale and the verify tripwire must fire. (With no declaration at
+        all the sync runs the full diff and stays correct by itself.)"""
+        snap = cluster()
+        eng = PlacementEngine(snap, state_verify=True)
+        free = snap.free.copy()
+        eng._sync_free(free)
+        free[1] *= 0.5
+        eng.note_free_rows((1,))  # declared: fine
+        free[3] *= 0.5  # contract breach: mutated, never declared
+        with pytest.raises(RuntimeError, match="not declared"):
+            eng._sync_free(free)
+
+    def test_invalidate_forces_full_reupload_keeps_epoch_monotonic(self):
+        snap = cluster()
+        eng = PlacementEngine(snap)
+        free = snap.free.copy()
+        e0 = eng._sync_free(free)
+        eng.invalidate_device_state()
+        e1 = eng._sync_free(free)
+        assert e1 > e0  # never reuses an epoch a dispatch may hold
+        assert eng._state.full_uploads == 2
+
+    def test_out_of_range_declarations_are_ignored(self):
+        snap = cluster()
+        eng = PlacementEngine(snap)
+        free = snap.free.copy()
+        eng._sync_free(free)
+        eng.note_free_rows([-3, snap.num_nodes + 7])
+        e = eng._sync_free(free)
+        assert e == 1 and eng._state.hits == 1
+
+
+class TestEpochGuard:
+    def test_unchanged_dispatch_adopted_via_epoch(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0), gang("b", pods=4, cpu=6.0)]
+        eng = PlacementEngine(snap)
+        fresh = eng.solve(gangs)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        assert handle.free0 is None  # the cache drops the O(N*R) payload
+        res = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        for name in fresh.placed:
+            np.testing.assert_array_equal(
+                res.placed[name].node_indices,
+                fresh.placed[name].node_indices,
+            )
+
+    def test_declared_mutation_bumps_epoch_and_rejects_dispatch(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng = PlacementEngine(snap)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[0] -= 1.0
+        eng.note_free_rows((0,))
+        res = eng.solve(gangs, free=free, dispatch=handle)
+        assert "dispatch_overlap" not in res.stats
+        assert res.num_placed == 1
+
+    def test_epoch_guard_decides_like_the_content_compare(self):
+        """Under state_verify the engine re-runs the O(N*R) compare next
+        to every epoch decision and raises on disagreement — both the
+        adopt and the reject branch must pass it."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng = PlacementEngine(snap, state_verify=True)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        assert handle.free0 is not None  # verify retains the payload
+        res = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[1] -= 2.0
+        eng.note_free_rows((1,))
+        res = eng.solve(gangs, free=free, dispatch=handle)
+        assert "dispatch_overlap" not in res.stats
+
+    def test_cache_off_keeps_legacy_content_compare(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng = PlacementEngine(snap, state_cache=False)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        assert handle.free0 is not None
+        res = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[0] -= 1.0  # no declaration needed: content compare
+        res = eng.solve(gangs, free=free, dispatch=handle)
+        assert "dispatch_overlap" not in res.stats
+
+    def test_rebind_between_dispatch_and_solve_rejects_stale_mask(self):
+        """Cordoning a capacity-bearing node between dispatch and solve
+        changes the MASKED content while the raw matrix is untouched —
+        both regimes must refuse the stale-mask scores (a raw content
+        compare would adopt them)."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        for kwargs in ({"state_cache": False}, {"state_verify": True}):
+            eng = PlacementEngine(snap, **kwargs)
+            handle = eng.dispatch(gangs, free=snap.free.copy())
+            snap2 = flip_schedulable(snap, [0])
+            assert eng.rebind(snap2)
+            # must neither adopt nor (verify regime) false-alarm a
+            # note_free_rows breach: the epoch guard and the masked
+            # content compare agree the dispatch is stale
+            res = eng.solve(gangs, free=snap2.free.copy(), dispatch=handle)
+            assert "dispatch_overlap" not in res.stats
+            assert res.num_placed == 1
+            used = np.concatenate(
+                [p.node_indices for p in res.placed.values()]
+            )
+            assert 0 not in used
+
+    def test_cache_off_adopted_dispatch_pays_one_upload(self):
+        """With the cache off, the full H2D belongs to the device phase
+        that actually runs: an adopted dispatch must not trigger a
+        second, never-consumed upload in solve()."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng = PlacementEngine(snap, state_cache=False)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        res = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        assert eng._state.full_uploads == 1
+
+    def test_cache_off_matches_cache_on_placements(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        gangs = [
+            gang("a", pods=2, cpu=2.0),
+            gang("b", pods=4, cpu=6.0, required=1),
+            gang("c", pods=3, cpu=3.0, preferred=2),
+        ]
+        on = PlacementEngine(snap).solve(gangs, free=snap.free.copy())
+        off = PlacementEngine(snap, state_cache=False).solve(
+            gangs, free=snap.free.copy()
+        )
+        assert set(on.placed) == set(off.placed)
+        for name in on.placed:
+            np.testing.assert_array_equal(
+                on.placed[name].node_indices, off.placed[name].node_indices
+            )
+
+
+class TestRebind:
+    def test_schedulable_flip_rides_the_delta_path(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True)
+        eng._sync_free(snap.free.copy())
+        full0 = eng._state.full_uploads
+        snap2 = flip_schedulable(snap, [0])  # cordon-shaped rebuild
+        assert eng.rebind(snap2)
+        assert eng.snapshot is snap2
+        eng._sync_free(snap2.free.copy())
+        assert eng._state.full_uploads == full0  # no rebuild re-encode
+        assert eng._state.delta_uploads == 1
+        # the cordoned row is zeroed in the resident state
+        assert (decoded_state(eng)[0] == 0.0).all()
+        # and solves avoid it
+        res = eng.solve([gang(f"g{i}", pods=2, cpu=8.0) for i in range(4)],
+                        free=snap2.free.copy())
+        used = np.concatenate(
+            [p.node_indices for p in res.placed.values()]
+        )
+        assert 0 not in used
+
+    def test_rebind_rejects_static_encoding_change(self):
+        snap = cluster(blocks=2, racks=2, hosts=2)
+        eng = PlacementEngine(snap)
+        other = cluster(blocks=2, racks=2, hosts=4)  # node set differs
+        assert not eng.rebind(other)
+        cap = cluster(blocks=2, racks=2, hosts=2, cpu=16.0)  # capacity
+        assert not eng.rebind(cap)
+
+
+class TestClusterFreeJournal:
+    def test_first_drain_is_unknown_then_tracks_rows(self):
+        c = Cluster(nodes=make_nodes(4))
+        snap = c.topology_snapshot()
+        assert c.consume_free_dirty(snap) is None  # nobody consumed yet
+        assert c.consume_free_dirty(snap) == []
+        c.store.create(make_pod("p", node="node-2"))
+        c.kubelet.run_to_quiesce()
+        snap = c.topology_snapshot()
+        assert c.consume_free_dirty(snap) == [2]
+        assert c.consume_free_dirty(snap) == []
+
+    def test_rebuild_past_compaction_resets_to_unknown(self):
+        c = Cluster(nodes=make_nodes(4))
+        snap = c.topology_snapshot()
+        c.consume_free_dirty(snap)
+        c.store.create(make_pod("p", node="node-1"))
+        c.kubelet.run_to_quiesce()
+        # compact past the usage cursor: incremental accounting must
+        # rebuild, and per-row tracking is lost
+        c.store.compact_events(c.store.last_seq + 1)
+        snap = c.topology_snapshot()
+        assert c.consume_free_dirty(snap) is None
+
+    def test_snapshot_free_epoch_moves_with_usage(self):
+        c = Cluster(nodes=make_nodes(4))
+        e0 = c.topology_snapshot().free_epoch
+        assert c.topology_snapshot().free_epoch == e0  # no usage motion
+        c.store.create(make_pod("p", node="node-0"))
+        c.kubelet.run_to_quiesce()
+        assert c.topology_snapshot().free_epoch > e0
+
+
+class TestObservability:
+    def test_upload_metrics_span_and_debug_summary(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        registry = MetricsRegistry()
+        tracer = Tracer()
+        eng = PlacementEngine(snap, metrics=registry, tracer=tracer)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        free = snap.free.copy()
+        eng.solve(gangs, free=free)  # full upload
+        free2 = snap.free.copy()
+        free2[5] -= 1.0
+        eng.note_free_rows((5,))
+        eng.solve(gangs, free=free2)  # delta upload
+        ups = registry.counter("grove_solver_state_uploads_total")
+        assert ups.value(kind="full") == 1.0
+        assert ups.value(kind="delta") >= 1.0
+        tb = registry.counter("grove_solver_transport_bytes_total")
+        assert tb.value(kind="state_full") > 0
+        assert tb.value(kind="state_delta") > 0
+        assert tb.value(kind="results") > 0
+        kinds = {
+            s.attrs.get("kind") for s in tracer.finished
+            if s.name == "engine.delta_apply"
+        }
+        assert {"full", "delta"} <= kinds
+        ds = eng.debug_summary()["device_state"]
+        assert ds["cache_enabled"] and ds["resident"]
+        assert ds["full_uploads"] == 1 and ds["delta_uploads"] >= 1
+        assert ds["epoch"] >= 2 and ds["checksum"] is not None
+
+    def test_cache_off_summary_reports_disabled(self):
+        snap = cluster()
+        ds = PlacementEngine(snap, state_cache=False).debug_summary()[
+            "device_state"
+        ]
+        assert not ds["cache_enabled"]
+        assert ds["checksum"] is None
+
+
+class TestSchedulerContract:
+    """End-to-end superset-contract enforcement: a full control-plane run
+    under solver.device_state_verify must never trip the O(N*R) debug
+    compare — every free mutation (bind commits, reservation reuse,
+    vacated-hint singles, node lifecycle) reaches note_free_rows."""
+
+    CFG = {"solver": {"device_state_verify": True}}
+
+    def test_bind_cordon_fail_recover_under_verify(self):
+        from test_e2e_basic import clique, simple_pcs
+
+        h = Harness(nodes=make_nodes(16), config=self.CFG)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=6)], replicas=2))
+        h.settle()
+        from grove_tpu.api.types import Pod
+
+        bound = [p for p in h.store.scan(Pod.KIND) if p.node_name]
+        assert len(bound) == 12
+        victim = bound[0].node_name
+        h.cluster.cordon(victim)
+        h.settle()
+        h.cluster.fail_node(victim)
+        h.clock.advance(120.0)
+        h.settle()
+        h.cluster.recover_node(victim)
+        h.cluster.uncordon(victim)
+        h.settle()
+        # repaired: every pod bound again, no verify RuntimeError raised
+        assert all(p.node_name for p in h.store.scan(Pod.KIND))
+
+    def test_scale_and_delete_under_verify(self):
+        from test_e2e_basic import clique, simple_pcs
+
+        h = Harness(nodes=make_nodes(16), config=self.CFG)
+        pcs = simple_pcs(cliques=[clique("w", replicas=4)], replicas=1)
+        h.apply(pcs)
+        h.settle()
+        obj = h.store.get(pcs.KIND, "default", pcs.metadata.name)
+        obj.spec.replicas = 3
+        h.store.update(obj)
+        h.settle()
+        h.store.delete(pcs.KIND, "default", pcs.metadata.name)
+        h.settle()
+        from grove_tpu.api.types import Pod
+
+        assert not list(h.store.scan(Pod.KIND))
+
+
+class TestKwargGating:
+    def test_partial_capability_engine_gets_only_accepted_kwargs(self):
+        """An engine naming state_cache but NOT state_verify (no
+        **kwargs) must be constructed with only the knob it accepts —
+        each capability kwarg is gated individually."""
+        from test_e2e_basic import clique, simple_pcs
+
+        class PartialEngine(PlacementEngine):
+            def __init__(self, snapshot, top_k=8, commit_chunk=32,
+                         bucket_min=8, native_repair=True, metrics=None,
+                         state_cache=True):
+                super().__init__(
+                    snapshot, top_k=top_k, commit_chunk=commit_chunk,
+                    bucket_min=bucket_min, native_repair=native_repair,
+                    metrics=metrics, state_cache=state_cache,
+                )
+
+        h = Harness(
+            nodes=make_nodes(8),
+            engine_cls=PartialEngine,
+            config={"solver": {"device_state_verify": True}},
+        )
+        h.apply(simple_pcs(cliques=[clique("w", replicas=4)], replicas=1))
+        h.settle()
+        from grove_tpu.api.types import Pod
+
+        assert all(p.node_name for p in h.store.scan(Pod.KIND))
+
+
+def _placements(store) -> dict:
+    from grove_tpu.api.types import Pod
+
+    return {
+        (p.metadata.namespace, p.metadata.name): p.node_name
+        for p in store.scan(Pod.KIND)
+    }
+
+
+@pytest.mark.chaos
+class TestChaosEquivalence:
+    """Seeded node-fault storms (node_flap, domain_outage) solved by the
+    delta engine (with the verify tripwire armed) and the full-re-encode
+    engine must land every pod on the SAME node: chaos draws are
+    bit-reproducible per seed, so any divergence is the state cache
+    changing placements."""
+
+    @pytest.mark.parametrize("seed", (3, 9))
+    def test_node_fault_seed_places_identically(self, seed):
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        from test_chaos import chaos_workload, quiet
+
+        runs = []
+        for cfg in (
+            {"solver": {"device_state_cache": True,
+                        "device_state_verify": True}},
+            {"solver": {"device_state_cache": False}},
+        ):
+            plan = FaultPlan.from_seed(
+                seed,
+                node_flap_rate=0.12,
+                domain_outage_rate=0.04,
+            )
+            ch = quiet(ChaosHarness(plan, nodes=make_nodes(24), config=cfg))
+            ch.apply(chaos_workload())
+            ch.run_chaos()
+            assert ch.plan.counts.get("node_flap", 0) + ch.plan.counts.get(
+                "domain_outage", 0
+            ) > 0, "a storm that injects no node faults proves nothing"
+            runs.append(_placements(ch.raw_store))
+        assert runs[0] == runs[1]
