@@ -395,6 +395,7 @@ class ChaosHarness:
         from ..api.meta import get_condition
         from ..api.podgang import PodGang, PodGangConditionType
 
+        decisions = self.harness.cluster.decisions
         unscheduled = []
         for g in self.raw_store.scan(PodGang.KIND):
             cond = get_condition(
@@ -407,6 +408,13 @@ class ChaosHarness:
                     "phase": g.status.phase.value,
                     "reason": cond.reason if cond is not None else None,
                     "message": cond.message if cond is not None else None,
+                    # the decision audit of the wedged gang (reason code,
+                    # elimination funnel, preemption attempts) rides next
+                    # to the flight-recorder spans — the postmortem names
+                    # WHY, not just WHO (observability/explain.py)
+                    "explain": decisions.explain(
+                        g.metadata.namespace, g.metadata.name
+                    ),
                 })
         stuck_pods = []
         for p in self.raw_store.scan(Pod.KIND):
@@ -463,3 +471,33 @@ class ChaosHarness:
                 json.dump(dump, fh)
                 fh.write("\n")
         return dump
+
+    def dump_explain(self, path: str | None = None) -> dict[str, Any] | None:
+        """Decision records of every gang UNSCHEDULED at settle, or None
+        when all gangs scheduled. Written by scripts/chaos_sweep.py
+        --explain-dir alongside the flight postmortems; render with
+        `python -m grove_tpu.observability.explain <path>`."""
+        import json
+
+        from ..api.meta import get_condition
+        from ..api.podgang import PodGang, PodGangConditionType
+
+        decisions = self.harness.cluster.decisions
+        out: dict[str, Any] = {}
+        for g in self.raw_store.scan(PodGang.KIND):
+            cond = get_condition(
+                g.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            if cond is not None and cond.status == "True":
+                continue
+            key = f"{g.metadata.namespace}/{g.metadata.name}"
+            out[key] = decisions.explain(
+                g.metadata.namespace, g.metadata.name
+            ) or {"gang": key, "records": []}
+        if not out:
+            return None
+        if path:
+            with open(path, "w") as fh:
+                json.dump(out, fh)
+                fh.write("\n")
+        return out

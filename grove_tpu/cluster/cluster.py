@@ -21,6 +21,7 @@ from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
 from ..api.types import ClusterTopology, Node, Pod, PodPhase, TopologyLevel
 from ..observability import Logger, MetricsRegistry
+from ..observability.explain import DecisionLog
 from ..observability.tracing import NOOP_TRACER
 from ..topology.encoding import TopologySnapshot, default_cluster_topology, encode_topology
 from .clock import SimClock
@@ -40,6 +41,12 @@ class Cluster:
         # the /metrics text exposition read it (SURVEY §5: the reference has
         # no custom scheduler metrics; the north-star numbers live here).
         self.metrics = MetricsRegistry()
+        # Placement-decision audit ring (observability/explain.py):
+        # cluster-owned — like the metrics registry — so explanations
+        # survive scheduler engine rebuilds and manager crash-restarts.
+        # The scheduler injects it into every engine it builds; bounded,
+        # so always on.
+        self.decisions = DecisionLog()
         self.logger = Logger(
             level=self.config.log.level, format=self.config.log.format
         )

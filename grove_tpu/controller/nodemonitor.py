@@ -107,6 +107,11 @@ class NodeMonitor:
         #: True while any draining node still holds active pods — gates
         #: the Pod-event wakeups (drains are rare; pod churn is not)
         self._drain_in_flight = False
+        #: node -> last exported lifecycle state: the change tracker
+        #: behind the per-node grove_node_lifecycle_states series, so a
+        #: reconcile writes O(changed) gauge series, and a DELETED node's
+        #: series is removed instead of lingering in /metrics forever
+        self._node_states: dict[str, str] = {}
 
     # -- watch plumbing ------------------------------------------------------
     def map_event(self, event: Event) -> list[Request]:
@@ -237,28 +242,50 @@ class NodeMonitor:
             )
 
         drain_pending = self._reconcile_drains(draining, live_names)
-        # state gauge from POST-write state, one state per node (a
-        # partition: summing over states gives the live node count)
-        counts = {"ready": 0, "not_ready": 0, "unschedulable": 0,
-                  "draining": 0}
+        # per-node one-hot state series from POST-write state (the
+        # kube-state-metrics shape: sum by (state) recovers the old
+        # aggregate counts, and each node carries exactly one series).
+        # Change-tracked: a reconcile writes O(changed states) series,
+        # and a deleted node's series is REMOVED — /metrics must never
+        # carry ghosts of departed inventory.
+        states: dict[str, str] = {}
         for node in self.store.scan(Node.KIND):
             if node.metadata.deletion_timestamp is not None:
                 continue
+            name = node.metadata.name
             if not node_ready(node):
-                counts["not_ready"] += 1
+                states[name] = "not_ready"
             elif node.metadata.annotations.get(constants.ANNOTATION_DRAIN):
-                counts["draining"] += 1
+                states[name] = "draining"
             elif node.unschedulable:
-                counts["unschedulable"] += 1
+                states[name] = "unschedulable"
             else:
-                counts["ready"] += 1
+                states[name] = "ready"
         gauge = self.metrics.gauge(
             "grove_node_lifecycle_states",
-            "nodes by lifecycle state, one state per node "
-            "(not_ready > draining > unschedulable > ready)",
+            "one series per live node, value 1 at its current lifecycle "
+            "state (not_ready > draining > unschedulable > ready); "
+            "sum by (state) for fleet counts",
         )
-        for state, value in counts.items():
-            gauge.set(float(value), state=state)
+        prev = self._node_states
+        if not prev:
+            # fresh monitor over a long-lived registry (manager
+            # crash-restart): adopt the gauge's existing series as the
+            # baseline so nodes deleted while the manager was down get
+            # their series removed too
+            for labels in gauge.label_sets():
+                if "node" in labels:
+                    prev.setdefault(labels["node"], labels.get("state", ""))
+        for name, state in states.items():
+            was = prev.get(name)
+            if was == state:
+                continue
+            if was is not None:
+                gauge.remove(node=name, state=was)
+            gauge.set(1.0, node=name, state=state)
+        for gone in set(prev) - set(states):
+            gauge.remove(node=gone, state=prev[gone])
+        self._node_states = states
         requeue = None
         if next_deadline is not None:
             requeue = max(next_deadline - now, _EPS)

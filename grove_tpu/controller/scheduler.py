@@ -37,6 +37,7 @@ from ..observability.events import (
     REASON_PODGANG_SCHEDULED,
     REASON_PODGANG_UNSCHEDULABLE,
 )
+from ..observability.explain import unsat_code, unsat_preemptible
 from ..observability.tracing import accepts_kwarg, accepts_tracer_kwarg
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import (
@@ -94,6 +95,12 @@ class GangScheduler:
             self._engine_kwargs["state_verify"] = (
                 cfg.solver.device_state_verify
             )
+        if accepts_kwarg(engine_cls, "decision_log"):
+            # the CLUSTER-owned decision ring (observability/explain.py):
+            # injected so placement explanations survive engine rebuilds
+            # (topology changes) and surface in debug_dump()["explain"].
+            # A strict-signature custom engine simply records nothing.
+            self._engine_kwargs["decision_log"] = cluster.decisions
         if cluster.tracer.enabled and accepts_tracer_kwarg(engine_cls):
             # only injected when tracing is on AND the engine can take
             # it: a custom engine class with a strict signature keeps
@@ -633,6 +640,15 @@ class GangScheduler:
             self._bind(by_name[name], placement)
         for name, reason in result.unplaced.items():
             gang = by_name[name]
+            code = unsat_code(reason)
+            # per-solve outcome counter, labeled by the structured code
+            # (distinct from gangs_unschedulable_total, which counts
+            # state TRANSITIONS): "what is blocking my backlog" as a
+            # queryable time series
+            self.metrics.counter(
+                "grove_scheduler_unplaced_total",
+                "unplaced gang solve outcomes by structured reason code",
+            ).inc(reason=code.value if code is not None else "Unknown")
             before = clone(gang.status)
             prev = get_condition(
                 gang.status.conditions, PodGangConditionType.SCHEDULED.value
@@ -642,7 +658,11 @@ class GangScheduler:
                 gang.status.conditions,
                 PodGangConditionType.SCHEDULED.value,
                 "False",
-                reason="Unschedulable",
+                # the condition carries the STRUCTURED code as its
+                # machine-readable reason (k8s CamelCase convention);
+                # free-form strings from custom engines keep the legacy
+                # "Unschedulable". The human message stays the full text.
+                reason=code.value if code is not None else "Unschedulable",
                 message=reason,
                 now=self.store.clock.now(),
             )
@@ -981,8 +1001,11 @@ class GangScheduler:
         starved = [
             (name, reason)
             for name, reason in result.unplaced.items()
-            if reason == "no feasible domain" and name in by_name
-        ]  # unresolved-topology holds are not capacity problems
+            if unsat_preemptible(reason) and name in by_name
+        ]  # keyed off the structured code (explain.PREEMPTIBLE_CODES):
+        # unresolved-topology holds are not capacity problems, and the
+        # old "no feasible domain" magic-string match is gone (the
+        # legacy string from custom engines still maps preemptible)
         starved.sort(
             key=lambda kv: (-self._priority_of(by_name[kv[0]]), kv[0])
         )
@@ -1020,6 +1043,10 @@ class GangScheduler:
                 avail[int(dom)] = sched_free[sel].sum(axis=0)
             freed: dict[int, np.ndarray] = {}
             chosen: list[PodGang] = []
+            #: audit trail for the decision log: every victim examined
+            #: and why it was (not) disturbed
+            considered: list[dict] = []
+            trial_failures = 0
             satisfied = False
             for vprio, vname, victim in evictable:
                 if vprio >= prio:
@@ -1041,8 +1068,16 @@ class GangScheduler:
                         dom = int(dom_of[i])
                         cur = contrib.get(dom)
                         contrib[dom] = d if cur is None else cur + d
+                entry = {
+                    "victim": f"{victim.metadata.namespace}/{vname}",
+                    "priority": vprio,
+                }
+                considered.append(entry)
                 if not contrib:
-                    continue  # victim frees nothing the preemptor can use
+                    # victim frees nothing the preemptor can use
+                    entry["outcome"] = "frees-nothing-usable"
+                    continue
+                entry["outcome"] = "chosen"
                 chosen.append(victim)
                 for dom, vec in contrib.items():
                     cur = freed.get(dom)
@@ -1063,7 +1098,27 @@ class GangScheduler:
                     ):
                         satisfied = True
                         break
+                    trial_failures += 1
             if not chosen or not satisfied:
+                # nothing is disturbed — record WHY for explain():
+                # satisfied is necessarily False here (it requires a
+                # chosen victim), so chosen-but-insufficient victims roll
+                # back to undisturbed status in the audit trail
+                for entry in considered:
+                    if entry.get("outcome") == "chosen":
+                        entry["outcome"] = "insufficient-even-with-victims"
+                if not chosen:
+                    note = "no victim frees usable capacity"
+                elif trial_failures:
+                    note = ("exact trial placement failed with every "
+                            "victim set")
+                else:
+                    note = ("aggregate capacity never reached even with "
+                            "every usable victim")
+                self._record_preemption(
+                    pg, considered, evicted=[], satisfied=False,
+                    trial_failures=trial_failures, note=note,
+                )
                 continue  # no victim set makes the preemptor feasible
             self._preempted_for.add(key)
             chosen_names = {v.metadata.name for v in chosen}
@@ -1073,7 +1128,34 @@ class GangScheduler:
             for victim in chosen:
                 self._evict(victim, preemptor=name)
             evicted_gangs += len(chosen)
+            self._record_preemption(
+                pg, considered,
+                evicted=[
+                    f"{v.metadata.namespace}/{v.metadata.name}"
+                    for v in chosen
+                ],
+                satisfied=True, trial_failures=trial_failures,
+            )
         return evicted_gangs
+
+    def _record_preemption(self, pg: PodGang, considered, evicted,
+                           satisfied: bool, trial_failures: int,
+                           note: str | None = None) -> None:
+        """Attach one preemption attempt (victims considered, why
+        rejected candidates were rejected, the eviction outcome) to the
+        preemptor's latest decision record — the audit half of "why is my
+        gang still pending after preemption ran"."""
+        info = {
+            "considered": considered,
+            "evicted": evicted,
+            "satisfied": satisfied,
+            "trial_failures": trial_failures,
+        }
+        if note:
+            info["note"] = note
+        self.cluster.decisions.attach_preemption(
+            pg.metadata.namespace, pg.metadata.name, info
+        )
 
     def _trial_place(
         self, sg, snapshot, free, victims, demand_fn, node_index

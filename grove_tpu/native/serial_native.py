@@ -207,9 +207,14 @@ def solve_serial_native(
     result.placed = _build_placements(
         snapshot, order, pod_offsets, assign, demand, free
     )
+    from ..observability.explain import diagnose_unplaced
+
     for g in order:
         if g.name not in result.placed:
-            result.unplaced[g.name] = "no feasible domain"
+            # same structured diagnosis as the Python paths (reason code
+            # + elimination funnel), against the residual free matrix
+            # _build_placements just committed into
+            result.unplaced[g.name] = diagnose_unplaced(g, snapshot, free)
     result.wall_seconds = time.perf_counter() - t0
     return result
 

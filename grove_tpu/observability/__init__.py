@@ -11,15 +11,33 @@ reads rather than re-deriving.
 """
 
 from .events import ClusterEvent, EventRecorder
+from .explain import (
+    DecisionLog,
+    DecisionRecord,
+    UnsatCode,
+    UnsatDiagnosis,
+    diagnose_unplaced,
+    score_decomposition,
+    unsat_code,
+    unsat_preemptible,
+)
 from .logging import Logger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 
 __all__ = [
     "ClusterEvent",
     "Counter",
+    "DecisionLog",
+    "DecisionRecord",
     "EventRecorder",
     "Gauge",
     "Histogram",
     "Logger",
     "MetricsRegistry",
+    "UnsatCode",
+    "UnsatDiagnosis",
+    "diagnose_unplaced",
+    "score_decomposition",
+    "unsat_code",
+    "unsat_preemptible",
 ]

@@ -70,12 +70,23 @@ def manager_dump(manager) -> dict[str, Any]:
 
 
 def store_dump(store) -> dict[str, Any]:
+    counts = store.object_counts()
     return {
-        "objects_by_kind": store.object_counts(),
+        "objects_by_kind": counts,
         "event_log_length": store.event_log_length,
         "last_seq": store.last_seq,
         "compacted_seq": store.compaction_horizon,
         "label_index_buckets": store.label_index_size,
+        # ClusterEvent retention (events.EventRecorder TTL sweep): the
+        # retained count plus the GC's bookkeeping, so a long run can
+        # verify the event store is actually bounded
+        "events": {
+            "retained": counts.get("Event", 0),
+            **getattr(
+                store, "event_gc_stats",
+                {"swept_total": 0, "last_sweep_at": None},
+            ),
+        },
     }
 
 
@@ -95,7 +106,17 @@ def harness_dump(harness) -> dict[str, Any]:
     if monitor is not None:
         out["node_lifecycle"] = monitor.debug_state()
     out["tracing"] = tracing_dump(harness.cluster)
+    out["explain"] = explain_dump(harness.cluster)
     return out
+
+
+def explain_dump(cluster) -> dict[str, Any]:
+    """The explain section of debug dumps: decision-ring occupancy plus
+    the latest record of every gang whose last decision was UNPLACED (the
+    actionable set — reason code, elimination funnel, preemption audit).
+    Point-query one gang with cluster.decisions.explain(ns, name) or the
+    `python -m grove_tpu.observability.explain` CLI."""
+    return cluster.decisions.summary()
 
 
 def tracing_dump(cluster) -> dict[str, Any]:

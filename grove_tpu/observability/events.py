@@ -53,7 +53,23 @@ class ClusterEvent:
 
 class EventRecorder:
     """Store-backed recorder; dedup key is (namespace, involved kind+name,
-    reason) with count/last_timestamp compaction."""
+    reason) with count/last_timestamp compaction.
+
+    Retention (the kube-apiserver --event-ttl analog): recording
+    opportunistically garbage-collects ClusterEvents not touched for
+    TTL_SECONDS — and enforces the MAX_EVENTS hard cap, oldest-first —
+    so long chaos runs and the 10^5-gang bench never accumulate events
+    without bound. The sweep runs at most once per SWEEP_INTERVAL of
+    virtual time, its cursor shared across every recorder instance via
+    the store (same pattern as the flight-recorder hook), and its stats
+    surface as debug_dump()["store"]["events"]."""
+
+    #: events untouched (no dedup bump) this long are dropped
+    TTL_SECONDS = 3600.0
+    #: hard retained-count cap, enforced oldest-last_timestamp-first
+    MAX_EVENTS = 10_000
+    #: minimum virtual seconds between sweeps (amortizes the scan)
+    SWEEP_INTERVAL = 300.0
 
     def __init__(self, store, controller: str = ""):
         self.store = store
@@ -92,6 +108,7 @@ class EventRecorder:
             existing.message = message
             existing.last_timestamp = now
             self.store.update(existing)
+            self._maybe_gc(now)
             return
         self.store.create(
             ClusterEvent(
@@ -107,9 +124,61 @@ class EventRecorder:
             ),
             owned=True,
         )
+        self._maybe_gc(now)
+
+    def _maybe_gc(self, now: float) -> None:
+        """Rate-limited retention sweep (see class docstring). The
+        next-sweep cursor lives on the STORE so every recorder over it
+        shares one cadence; best-effort — a transient store fault (chaos)
+        on one delete never fails the record that triggered the sweep."""
+        due = getattr(self.store, "event_gc_at", None)
+        if due is not None and now < due:
+            return
+        self.store.event_gc_at = now + self.SWEEP_INTERVAL
+        swept = sweep_events(
+            self.store, ttl=self.TTL_SECONDS, max_events=self.MAX_EVENTS,
+            now=now,
+        )
+        stats = getattr(
+            self.store, "event_gc_stats", None
+        ) or {"swept_total": 0, "last_sweep_at": None}
+        stats = {
+            "swept_total": stats["swept_total"] + swept,
+            "last_sweep_at": now,
+        }
+        self.store.event_gc_stats = stats
 
     def normal(self, involved, reason: str, message: str) -> None:
         self.event(involved, TYPE_NORMAL, reason, message)
 
     def warning(self, involved, reason: str, message: str) -> None:
         self.event(involved, TYPE_WARNING, reason, message)
+
+
+def sweep_events(store, ttl: float, max_events: int, now: float) -> int:
+    """One ClusterEvent retention pass: drop events whose last activity
+    is older than `ttl`, then enforce the `max_events` cap oldest-first.
+    Returns the number deleted. Best-effort per event — a failed delete
+    (chaos write fault, a concurrent deletion) skips that event; the
+    next sweep retries it."""
+    live: list[tuple[float, str, str]] = []
+    expired: list[tuple[str, str]] = []
+    for ev in store.scan(ClusterEvent.KIND):
+        key = (ev.metadata.namespace, ev.metadata.name)
+        if now - ev.last_timestamp > ttl:
+            expired.append(key)
+        else:
+            live.append((ev.last_timestamp, key[0], key[1]))
+    if len(live) > max_events:
+        live.sort()
+        expired.extend(
+            (ns, name) for _, ns, name in live[: len(live) - max_events]
+        )
+    swept = 0
+    for ns, name in expired:
+        try:
+            store.delete(ClusterEvent.KIND, ns, name)
+            swept += 1
+        except Exception:
+            continue
+    return swept

@@ -31,6 +31,13 @@ class Counter:
     def value(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
 
+    def remove(self, **labels: str) -> bool:
+        """Drop one label series from the exposition (Gauge parity).
+        Counters are cumulative by contract — only remove a series whose
+        OWNING OBJECT is gone (a deleted node's per-node series), never
+        to reset a live one. Returns whether the series existed."""
+        return self._values.pop(_label_key(labels), None) is not None
+
     def total(self) -> float:
         return sum(self._values.values())
 
@@ -46,6 +53,17 @@ class Gauge:
 
     def value(self, **labels: str) -> float:
         return self._values.get(_label_key(labels), 0.0)
+
+    def remove(self, **labels: str) -> bool:
+        """Drop one label series so /metrics stops exporting it — the
+        per-object-series hygiene call (a deleted node's lifecycle
+        series must not linger forever). Returns whether it existed."""
+        return self._values.pop(_label_key(labels), None) is not None
+
+    def label_sets(self) -> list[dict[str, str]]:
+        """The label set of every live series (public enumeration for
+        owners reconciling per-object series after a restart)."""
+        return [dict(key) for key in self._values]
 
 
 @dataclass

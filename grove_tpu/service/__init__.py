@@ -1,14 +1,38 @@
-"""Placement service: the engine behind a gRPC boundary (SURVEY §7)."""
+"""Placement service: the engine behind a gRPC boundary (SURVEY §7).
 
-from .client import RemotePlacementEngine
-from .server import PlacementService, RotatingTLSServer, serve, snapshot_epoch
-from .tls import CertRotator
+The service extras (grpcio, cryptography) are optional — importing this
+package without them still exposes what works: the numpy codec always,
+the server/client when grpc is present, TLS rotation only with
+cryptography. Missing names simply aren't exported (their ImportError
+surfaces at first use), so codec-only consumers — explainability tests,
+offline tooling — never pay for extras they don't touch, the same
+graceful degradation the operations tour exercises.
+"""
 
-__all__ = [
-    "CertRotator",
-    "PlacementService",
-    "RemotePlacementEngine",
-    "RotatingTLSServer",
-    "serve",
-    "snapshot_epoch",
-]
+__all__ = []
+
+try:
+    from .client import RemotePlacementEngine  # needs grpc
+    from .server import (
+        PlacementService,
+        RotatingTLSServer,
+        serve,
+        snapshot_epoch,
+    )
+
+    __all__ += [
+        "PlacementService",
+        "RemotePlacementEngine",
+        "RotatingTLSServer",
+        "serve",
+        "snapshot_epoch",
+    ]
+except ImportError:  # pragma: no cover - exercised without the extra
+    pass
+
+try:
+    from .tls import CertRotator  # needs cryptography
+
+    __all__.append("CertRotator")
+except ImportError:  # pragma: no cover
+    pass

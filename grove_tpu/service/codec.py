@@ -103,6 +103,13 @@ def encode_solve_request(
                 for members, req, pref in g.constraint_groups
             ],
             "unschedulable_reason": g.unschedulable_reason,
+            # the structured half of an UnsatDiagnosis hold (json flattens
+            # the str subclass to its message): the server re-hydrates so
+            # its diagnoses keep the UnresolvedTopologyLevel code instead
+            # of degrading to a legacy label across the wire
+            "unschedulable_code": getattr(
+                getattr(g.unschedulable_reason, "code", None), "value", None
+            ),
             "has_elig": g.pod_elig is not None,
         })
         demands.append(g.demand)
@@ -169,10 +176,26 @@ def decode_solve_request(
                 (list(m), int(r), int(p))
                 for m, r, p in meta["constraint_groups"]
             ],
-            unschedulable_reason=meta["unschedulable_reason"],
+            unschedulable_reason=_decode_hold(meta),
             pod_elig=pod_elig,
         ))
     return header["epoch"], gangs, np.asarray(npz["free"], np.float32)
+
+
+def _decode_hold(meta: dict):
+    """Re-hydrate a gang's unschedulable hold: message + structured code
+    when the client shipped one (see encode_solve_request), the plain
+    string otherwise (older clients / custom vocabularies)."""
+    reason = meta["unschedulable_reason"]
+    code = meta.get("unschedulable_code")
+    if reason is None or code is None:
+        return reason
+    from ..observability.explain import UnsatCode, UnsatDiagnosis
+
+    try:
+        return UnsatDiagnosis(reason, code=UnsatCode(code))
+    except ValueError:  # newer client vocabulary: keep the text
+        return reason
 
 
 # -- solve response ---------------------------------------------------------
@@ -183,11 +206,25 @@ def encode_solve_response(result: SolveResult) -> bytes:
         names.append(name)
         scores.append(placement.placement_score)
         assigns.append(np.asarray(placement.node_indices, np.int64))
+    # unplaced messages ship as plain strings (back-compat); the
+    # structured halves of an UnsatDiagnosis (reason code + elimination
+    # funnel, observability/explain.py) ride in a parallel map so the
+    # client re-hydrates full diagnoses — preemption eligibility and
+    # explain() must not degrade across the service boundary
+    unsat = {
+        name: {
+            "code": reason.code.value,
+            "funnel": reason.funnel,
+        }
+        for name, reason in result.unplaced.items()
+        if getattr(reason, "code", None) is not None
+    }
     return _pack(
         {
             "placed": names,
             "scores": scores,
-            "unplaced": dict(result.unplaced),
+            "unplaced": {k: str(v) for k, v in result.unplaced.items()},
+            "unsat": unsat,
             "stats": {k: float(v) for k, v in result.stats.items()},
             "wall_seconds": result.wall_seconds,
             "lens": [len(a) for a in assigns],
@@ -222,7 +259,22 @@ def decode_solve_response(
             node_indices=idx,
             placement_score=float(score),
         )
-    result.unplaced.update(header["unplaced"])
+    unsat = header.get("unsat", {})
+    for name, message in header["unplaced"].items():
+        meta = unsat.get(name)
+        if meta is not None:
+            from ..observability.explain import UnsatCode, UnsatDiagnosis
+
+            try:
+                code = UnsatCode(meta["code"])
+            except ValueError:  # newer server vocabulary: keep the text
+                result.unplaced[name] = message
+                continue
+            result.unplaced[name] = UnsatDiagnosis(
+                message, code=code, funnel=meta.get("funnel")
+            )
+        else:
+            result.unplaced[name] = message
     result.stats.update(header["stats"])
     result.wall_seconds = float(header["wall_seconds"])
     return result

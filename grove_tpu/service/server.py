@@ -55,13 +55,24 @@ class PlacementService:
         #: it; the Debug RPC reports its summary). Default disabled —
         #: and the recording Tracer is single-threaded, so enable it only
         #: with max_workers=1 or for in-process/debug use.
-        from ..observability.tracing import NOOP_TRACER, accepts_tracer_kwarg
+        from ..observability.explain import DecisionLog
+        from ..observability.tracing import (
+            NOOP_TRACER,
+            accepts_kwarg,
+            accepts_tracer_kwarg,
+        )
 
         if tracer is None:
             tracer = NOOP_TRACER
         self.tracer = tracer
         if tracer.enabled and accepts_tracer_kwarg(engine_cls):
             self.engine_kwargs.setdefault("tracer", tracer)
+        #: service-owned placement-decision ring shared by every cached
+        #: engine (epochs come and go; explanations persist) — surfaced
+        #: by the Debug RPC's "explain" section
+        self.decisions = DecisionLog()
+        if accepts_kwarg(engine_cls, "decision_log"):
+            self.engine_kwargs.setdefault("decision_log", self.decisions)
         self._engines: dict[str, PlacementEngine] = {}
         import time as _time
 
@@ -160,6 +171,10 @@ class PlacementService:
             # same bounded shape as harness.debug_dump()["tracing"]:
             # {"enabled": False} unless a tracer was injected
             "tracing": self.tracer.summary(),
+            # same shape as harness.debug_dump()["explain"]: ring
+            # occupancy + the latest record of every still-unplaced gang
+            # (render with python -m grove_tpu.observability.explain)
+            "explain": self.decisions.summary(),
         }).encode()
 
 

@@ -56,6 +56,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.explain import DecisionLog, diagnose_unplaced
 from ..topology.encoding import TopologySnapshot
 from .fit import place_gang_in_domain, placement_score_for_nodes
 from .problem import SolverGang
@@ -407,6 +408,7 @@ class PlacementEngine:
         tracer=None,
         state_cache: bool = True,
         state_verify: bool = False,
+        decision_log=None,
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
@@ -435,6 +437,14 @@ class PlacementEngine:
         #: the O(N*R) content compare next to every epoch decision and
         #: raise on disagreement (a broken note_free_rows contract)
         self.state_verify = state_verify
+        #: placement-decision audit ring (observability/explain.py):
+        #: every solve records its placed decompositions and unplaced
+        #: diagnoses here. The scheduler injects the cluster-owned log so
+        #: history survives engine rebuilds; direct users (bench, tests)
+        #: get a private ring. Host-side O(1) appends only — nothing
+        #: rides the device path. Set the attribute to None to disable
+        #: recording entirely (A/B microbenches).
+        self.decisions = DecisionLog() if decision_log is None else decision_log
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
         self._cap_scale = np.maximum(
             snapshot.capacity.max(axis=0), 1e-9
@@ -459,6 +469,14 @@ class PlacementEngine:
         #: for nothing
         self._io_cache: tuple[np.ndarray, object] | None = None
         self._masks_cache: tuple[np.ndarray, object] | None = None
+        #: unsat-diagnosis memo: a wedged cluster re-solves the same
+        #: unplaceable gangs on every retry tick, and the elimination
+        #: funnel's inputs (gang constraints/demand/eligibility + the
+        #: residual free content + the schedulable set) are usually
+        #: unchanged — keyed by content fingerprints, cleared on rebind
+        #: (schedulable flips). Bounded; the funnel recompute it avoids
+        #: is several O(N*R) passes per gang per tick.
+        self._diag_cache: dict[tuple, object] = {}
 
     # -- device-resident cluster state ---------------------------------------
     def note_free_rows(self, rows) -> None:
@@ -516,6 +534,9 @@ class PlacementEngine:
         self.snapshot = snapshot
         self.space.snapshot = snapshot
         self._sched_nodes = np.flatnonzero(snapshot.schedulable)
+        # the funnel memo keys on mask identities + the schedulable set,
+        # both owned by the outgoing snapshot — never carry it across
+        self._diag_cache.clear()
         if changed.size:
             self.note_free_rows(changed.tolist())
         return True
@@ -769,6 +790,8 @@ class PlacementEngine:
             result.wall_seconds = time.perf_counter() - t0
             if self.metrics is not None:
                 self._record_metrics(result, len(gangs))
+            if self.decisions is not None:
+                self.decisions.record_solve(result, snapshot, gangs)
             return result
 
         order = sorted(solvable, key=gang_sort_key)
@@ -826,15 +849,41 @@ class PlacementEngine:
                     )
                 ).tolist()
             )
+        free_fp = None
         for gang in order:
             if gang.name in placed_map:
                 result.placed[gang.name] = placed_map[gang.name]
             else:
-                result.unplaced[gang.name] = "no feasible domain"
+                # structured diagnosis against the residual free matrix
+                # (gangs committed in priority order ahead of this one):
+                # reason code + elimination funnel, message-compatible
+                # with the old "no feasible domain" string consumers.
+                # Memoized: a retry tick re-solving an unchanged wedge
+                # pays one adler pass, not the per-level funnel sweeps.
+                if free_fp is None:
+                    free_fp = zlib.adler32(free.tobytes())
+                key = (
+                    gang.name,
+                    gang.required_level,
+                    zlib.adler32(gang.demand.tobytes()),
+                    0 if gang.pod_elig is None else tuple(
+                        0 if m is None else id(m) for m in gang.pod_elig
+                    ),
+                    free_fp,
+                )
+                diag = self._diag_cache.get(key)
+                if diag is None:
+                    diag = diagnose_unplaced(gang, snapshot, free)
+                    if len(self._diag_cache) > 4096:
+                        self._diag_cache.clear()
+                    self._diag_cache[key] = diag
+                result.unplaced[gang.name] = diag
         result.stats["fallbacks"] = float(fallbacks)
         result.wall_seconds = time.perf_counter() - t0
         if self.metrics is not None:
             self._record_metrics(result, len(gangs))
+        if self.decisions is not None:
+            self.decisions.record_solve(result, snapshot, gangs)
         return result
 
     def _record_metrics(self, result: SolveResult, backlog: int) -> None:
@@ -1082,6 +1131,14 @@ class PlacementEngine:
             "num_nodes": self.snapshot.num_nodes,
             "num_domains": self.space.num_domains,
             "device_statics_resident": self._dev_static is not None,
+            "decisions": (
+                {
+                    "gangs_tracked": len(self.decisions),
+                    "records_total": self.decisions.records_total,
+                }
+                if self.decisions is not None
+                else None
+            ),
             "device_state": {
                 "cache_enabled": self.state_cache,
                 "resident": st.dev is not None,

@@ -15,6 +15,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..api.podgang import PodGang, TopologyConstraint
+from ..observability.explain import UnsatCode, UnsatDiagnosis
 from ..topology.encoding import TopologySnapshot
 
 #: Sentinel for a REQUIRED pack level whose label key is absent from the
@@ -249,9 +250,13 @@ def encode_podgangs(
                 cgroups.append((members, cg_req, cg_pref))
         reason = None
         if unresolved:
-            reason = (
+            # structured: the scheduler/status surfaces key off the code
+            # (a hold, never a capacity problem — preemption is futile);
+            # the str content stays the operator-facing message
+            reason = UnsatDiagnosis(
                 "required topology level(s) unavailable: "
-                + ",".join(sorted(set(unresolved)))
+                + ",".join(sorted(set(unresolved))),
+                code=UnsatCode.UNRESOLVED_LEVEL,
             )
         gangs.append(
             SolverGang(

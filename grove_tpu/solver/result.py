@@ -22,7 +22,12 @@ class GangPlacement:
 @dataclass(slots=True)
 class SolveResult:
     placed: dict[str, GangPlacement] = field(default_factory=dict)
-    unplaced: dict[str, str] = field(default_factory=dict)  # gang -> reason
+    #: gang -> unplaced reason. Values from the in-tree solve paths are
+    #: observability.explain.UnsatDiagnosis (a str subclass carrying the
+    #: structured `.code` + elimination `.funnel`); plain str only from
+    #: custom/external engines. Key off explain.unsat_code(), never the
+    #: message text.
+    unplaced: dict[str, str] = field(default_factory=dict)
     wall_seconds: float = 0.0
     stats: dict[str, float] = field(default_factory=dict)
 

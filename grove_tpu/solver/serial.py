@@ -20,6 +20,7 @@ import time
 
 import numpy as np
 
+from ..observability.explain import diagnose_unplaced
 from ..topology.encoding import TopologySnapshot
 from .fit import (
     _order_domains_tightest,
@@ -56,7 +57,13 @@ def solve_serial(
             continue
         placed = _place_one(gang, snapshot, free, sched_nodes)
         if placed is None:
-            result.unplaced[gang.name] = "no feasible domain"
+            # structured diagnosis instead of the old "no feasible
+            # domain" magic string: reason code + elimination funnel
+            # (observability/explain.py), message-compatible (str
+            # subclass) for every legacy consumer
+            result.unplaced[gang.name] = diagnose_unplaced(
+                gang, snapshot, free
+            )
         else:
             result.placed[gang.name] = placed
     result.wall_seconds = time.perf_counter() - t0

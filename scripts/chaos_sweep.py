@@ -91,7 +91,8 @@ def sweep_workload():
 
 
 def run_seed(seed: int, nodes: int, baseline: dict,
-             trace_dir: Path | None = None) -> dict:
+             trace_dir: Path | None = None,
+             explain_dir: Path | None = None) -> dict:
     plan = FaultPlan.from_seed(seed)
     trace_path = (
         str(trace_dir / f"seed-{seed}-flight.json")
@@ -131,6 +132,16 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         # settles fine — the flight ring is how you see WHY it diverged)
         ch.dump_flight(trace_path)
         result["flight_dump"] = trace_path
+    if explain_dir is not None:
+        # placement-decision dump for every gang UNSCHEDULED at settle —
+        # written for passing seeds too (a gang can settle unscheduled
+        # legally); render with python -m grove_tpu.observability.explain
+        try:
+            explain_path = str(explain_dir / f"seed-{seed}-explain.json")
+            if ch.dump_explain(explain_path) is not None:
+                result["explain_dump"] = explain_path
+        except Exception as exc:  # never fail the sweep on the dump
+            result["explain_error"] = f"{type(exc).__name__}: {exc}"
     return result
 
 
@@ -154,11 +165,23 @@ def main(argv=None) -> int:
                          "events + the wedged-object summary) for every "
                          "FAILING seed; open with python -m "
                          "grove_tpu.observability.trace")
+    ap.add_argument("--explain-dir", dest="explain_dir", default=None,
+                    metavar="DIR",
+                    help="write a placement-decision dump "
+                         "(seed-N-explain.json: reason codes + "
+                         "elimination funnels + preemption audits) for "
+                         "every seed that settles with unscheduled "
+                         "gangs; render with python -m "
+                         "grove_tpu.observability.explain")
     args = ap.parse_args(argv)
     trace_dir = None
     if args.trace_dir:
         trace_dir = Path(args.trace_dir)
         trace_dir.mkdir(parents=True, exist_ok=True)
+    explain_dir = None
+    if args.explain_dir:
+        explain_dir = Path(args.explain_dir)
+        explain_dir.mkdir(parents=True, exist_ok=True)
 
     baseline_h = Harness(nodes=make_nodes(args.nodes))
     baseline_h.apply(sweep_workload())
@@ -168,7 +191,8 @@ def main(argv=None) -> int:
     results = []
     failed = []
     for seed in range(args.start, args.start + args.seeds):
-        result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir)
+        result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir,
+                          explain_dir=explain_dir)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:

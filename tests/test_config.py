@@ -219,6 +219,9 @@ class TestConfigChangesBehavior:
         h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
         h.settle()
         assert captured.pop("metrics") is h.cluster.metrics
+        # the scheduler injects the CLUSTER-owned decision ring so
+        # explanations survive engine rebuilds (observability/explain.py)
+        assert captured.pop("decision_log") is h.cluster.decisions
         assert captured == {
             "top_k": 3,
             "commit_chunk": 16,

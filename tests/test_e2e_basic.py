@@ -228,7 +228,10 @@ class TestNodeSelectorEndToEnd:
         gang = harness.store.list(PodGang.KIND)[0]
         cond = get_condition(gang.status.conditions, "Scheduled")
         assert cond is not None and cond.status == "False"
-        assert cond.reason == "Unschedulable"
+        # the condition carries the STRUCTURED reason code (explain.py):
+        # the selector excludes every node, so eligibility is the verdict
+        assert cond.reason == "EligibilityExcluded"
+        assert "eligibility" in cond.message
 
     def test_tainted_nodes_repel_untolerated_pods(self):
         nodes = make_nodes(8, racks_per_block=2, hosts_per_rack=4)

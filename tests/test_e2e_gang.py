@@ -33,7 +33,10 @@ class TestGS_AllOrNothing:
         gang = h.store.get(PodGang.KIND, "default", "simple1-0")
         sched = cond(gang, PodGangConditionType.SCHEDULED.value)
         assert sched is not None and sched.status == "False"
-        assert sched.reason == "Unschedulable"
+        # structured reason code (explain.py): 9 cpu demanded, 8 free —
+        # a capacity verdict, with the binding resource in the message
+        assert sched.reason == "InsufficientCapacity"
+        assert "cpu" in sched.message
         pcs = h.store.get(PodCliqueSet.KIND, "default", "simple1")
         assert pcs.status.available_replicas == 0  # never-scheduled != available
 

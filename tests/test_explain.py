@@ -1,0 +1,594 @@
+"""Placement explainability (observability/explain.py): structured unsat
+diagnosis, the elimination funnel, score decomposition, the decision audit
+ring, and every surface it feeds (conditions, metrics, debug dumps, chaos
+postmortems, the CLI)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import constants
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.podgang import PodGang, PodGangConditionType
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability.explain import (
+    DecisionLog,
+    DecisionRecord,
+    UnsatCode,
+    UnsatDiagnosis,
+    diagnose_unplaced,
+    render_verdict,
+    score_decomposition,
+    unsat_code,
+    unsat_preemptible,
+)
+from grove_tpu.solver import PlacementEngine, SolverGang, solve_serial
+
+from test_e2e_basic import clique, simple_pcs
+from test_solver import cluster, gang
+
+
+def funnel_partitions(diag):
+    """The funnel invariant: every candidate domain is attributed to
+    exactly one elimination (or survives), so the counts PARTITION the
+    domain total."""
+    f = diag.funnel
+    assert f is not None
+    assert sum(f["cut"].values()) + f["feasible"] == f["domains_total"]
+    return f
+
+
+def raw_gang(name, demand_rows, required=-1, pod_elig=None, priority=0.0):
+    """SolverGang with explicit per-pod demand rows [cpu, mem, tpu]."""
+    demand = np.asarray(demand_rows, dtype=np.float32)
+    p = demand.shape[0]
+    return SolverGang(
+        name=name,
+        namespace="default",
+        demand=demand,
+        pod_names=[f"{name}-p{i}" for i in range(p)],
+        group_ids=np.zeros(p, np.int32),
+        group_names=["g0"],
+        group_required_level=np.asarray([-1], np.int32),
+        group_preferred_level=np.asarray([-1], np.int32),
+        required_level=required,
+        priority=priority,
+        pod_elig=pod_elig,
+    )
+
+
+class TestUnsatCodes:
+    def test_diagnosis_is_a_str(self):
+        d = UnsatDiagnosis("nope", code=UnsatCode.CAPACITY)
+        assert isinstance(d, str) and d == "nope"
+        assert d.code is UnsatCode.CAPACITY
+        assert json.dumps({"r": d}) == '{"r": "nope"}'
+
+    def test_unsat_code_mapping(self):
+        assert unsat_code(UnsatDiagnosis("x", code=UnsatCode.CORDONED)) is (
+            UnsatCode.CORDONED
+        )
+        # the legacy magic string from custom engines keeps its meaning
+        assert unsat_code("no feasible domain") is UnsatCode.NO_FEASIBLE_DOMAIN
+        assert unsat_code("some private engine text") is None
+
+    def test_preemption_eligibility_keys_off_the_code(self):
+        assert unsat_preemptible(
+            UnsatDiagnosis("x", code=UnsatCode.CAPACITY)
+        )
+        assert unsat_preemptible("no feasible domain")  # legacy engines
+        assert not unsat_preemptible(
+            UnsatDiagnosis("x", code=UnsatCode.UNRESOLVED_LEVEL)
+        )
+        assert not unsat_preemptible("anything else")
+
+
+class TestEliminationFunnel:
+    def test_capacity_unsat_names_binding_resource(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        res = solve_serial(snap, [gang("a", pods=3, cpu=6.0)])
+        diag = res.unplaced["a"]
+        assert unsat_code(diag) is UnsatCode.CAPACITY
+        f = funnel_partitions(diag)
+        assert f["feasible"] == 0
+        binding = f["binding"]
+        assert binding["resource"] == "cpu"
+        assert binding["shortfall"] > 0
+        assert "cpu" in diag  # the message names the binding resource
+
+    def test_engine_and_serial_emit_identical_codes(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        gangs = [gang("a", pods=3, cpu=6.0)]
+        ser = solve_serial(snap, gangs)
+        eng = PlacementEngine(cluster(blocks=1, racks=1, hosts=2,
+                                      cpu=8.0)).solve(gangs)
+        assert unsat_code(ser.unplaced["a"]) is unsat_code(eng.unplaced["a"])
+        funnel_partitions(eng.unplaced["a"])
+
+    def test_topology_unresolved_is_a_hold(self):
+        snap = cluster()
+        held = gang("held", pods=2, cpu=1.0)
+        held.required_level = -2  # UNRESOLVED_LEVEL sentinel
+        held.unschedulable_reason = UnsatDiagnosis(
+            "required topology level(s) unavailable: zone",
+            code=UnsatCode.UNRESOLVED_LEVEL,
+        )
+        res = solve_serial(snap, [held])
+        assert unsat_code(res.unplaced["held"]) is UnsatCode.UNRESOLVED_LEVEL
+        assert not unsat_preemptible(res.unplaced["held"])
+
+    def test_cordoned_cluster_verdict(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        snap.schedulable[:] = False
+        res = solve_serial(snap, [gang("a", pods=1, cpu=1.0)])
+        diag = res.unplaced["a"]
+        assert unsat_code(diag) is UnsatCode.CORDONED
+        f = funnel_partitions(diag)
+        assert f["cut"]["cordoned"] == f["domains_total"]
+
+    def test_eligibility_verdict(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        mask = np.zeros(snap.num_nodes, dtype=bool)  # excludes every node
+        g = raw_gang("sel", [[1.0, 1.0, 0.0]], pod_elig=[mask])
+        res = solve_serial(snap, [g])
+        diag = res.unplaced["sel"]
+        assert unsat_code(diag) is UnsatCode.ELIGIBILITY
+        f = funnel_partitions(diag)
+        assert f["cut"]["eligibility"] > 0
+
+    def test_conflict_verdict_for_fragmentation(self):
+        # 2 hosts x 4 cpu; pods [3, 3, 2]: aggregate 8 <= 8 and the max
+        # pod fits a node, but no packing works -> statically feasible,
+        # exactly unplaceable
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=4.0)
+        g = raw_gang("frag", [[3, 1, 0], [3, 1, 0], [2, 1, 0]])
+        res = solve_serial(snap, [g])
+        diag = res.unplaced["frag"]
+        assert unsat_code(diag) is UnsatCode.CONFLICT
+        f = funnel_partitions(diag)
+        assert f["feasible"] > 0
+
+    def test_required_level_funnel_counts_topology_cut(self):
+        snap = cluster(hosts=2, cpu=8.0)  # levels: block=0, rack=1
+        # 4 pods x 6 cpu cannot fit one rack (2 hosts x 8 cpu)
+        res = solve_serial(snap, [gang("a", pods=4, cpu=6.0, required=1)])
+        diag = res.unplaced["a"]
+        f = funnel_partitions(diag)
+        # the root + every block-level domain are broader than the
+        # required rack level -> topology cut
+        assert f["cut"]["topology"] >= 1 + int(snap.num_domains[0])
+        assert unsat_code(diag) is UnsatCode.CAPACITY
+
+    def test_node_binding_never_mixes_resources_across_nodes(self):
+        # two complementary nodes: (4 cpu, ~0 mem) and (~0 cpu, 4 mem).
+        # A (2 cpu, 2 mem) pod fits NEITHER, but the per-resource maxima
+        # ACROSS nodes (4, 4) would wrongly say everything fits — the
+        # binding must come from one real node and carry a positive
+        # shortfall on the resource that node actually lacks
+        from grove_tpu.topology import (
+            default_cluster_topology,
+            encode_topology,
+        )
+        from test_solver import make_node
+        from grove_tpu.api.types import TopologyLevel
+
+        nodes = [
+            make_node("n0", {"t/rack": "r0"}, cpu=4.0, mem=0.001, tpu=0.0),
+            make_node("n1", {"t/rack": "r0"}, cpu=0.001, mem=4.0, tpu=0.0),
+        ]
+        import dataclasses
+
+        ct = default_cluster_topology(
+            [TopologyLevel(domain="rack", key="t/rack")]
+        )
+        snap = encode_topology(ct, nodes)
+        # drop the implicit per-node hostname level so no single-node
+        # domain exists — the node-granularity fallback must then find
+        # the binding itself (a custom-topology shape)
+        snap = dataclasses.replace(
+            snap,
+            level_keys=snap.level_keys[:1],
+            level_domains=snap.level_domains[:1],
+            domain_ids=snap.domain_ids[:1],
+            num_domains=snap.num_domains[:1],
+        )
+        g = raw_gang("shape", [[2.0, 2.0, 0.0]])
+        res = solve_serial(snap, [g])
+        diag = res.unplaced["shape"]
+        assert unsat_code(diag) is UnsatCode.CAPACITY
+        binding = funnel_partitions(diag)["binding"]
+        assert binding["granularity"] == "node"
+        assert binding["resource"] in ("cpu", "memory")
+        assert binding["shortfall"] > 0
+
+    def test_engine_memoizes_retry_diagnoses(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        eng = PlacementEngine(snap)
+        gangs = [gang("big", pods=3, cpu=6.0)]
+        d1 = eng.solve(gangs, free=snap.free.copy()).unplaced["big"]
+        # unchanged wedge re-solved: the funnel is NOT recomputed
+        d2 = eng.solve(gangs, free=snap.free.copy()).unplaced["big"]
+        assert d2 is d1
+        # free content moved: the memo must miss
+        free = snap.free.copy()
+        free[0] *= 0.5
+        d3 = eng.solve(gangs, free=free).unplaced["big"]
+        assert d3 is not d1
+
+    def test_seeded_funnels_always_partition(self):
+        rng = np.random.default_rng(7)
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        for i in range(20):
+            pods = int(rng.integers(1, 6))
+            cpu = float(rng.uniform(2.0, 12.0))
+            req = int(rng.integers(-1, snap.num_levels))
+            res = solve_serial(
+                snap, [gang(f"g{i}", pods=pods, cpu=cpu, required=req)]
+            )
+            for diag in res.unplaced.values():
+                funnel_partitions(diag)
+                assert unsat_code(diag) is not None
+
+
+class TestScoreDecomposition:
+    def test_terms_recombine_to_placement_score(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        gangs = [
+            gang("packed", pods=2, cpu=2.0),
+            gang("spread", pods=4, cpu=6.0, required=0),  # spans a block
+        ]
+        res = solve_serial(snap, gangs)
+        assert set(res.placed) == {"packed", "spread"}
+        for placement in res.placed.values():
+            decomp = score_decomposition(snap, placement.node_indices)
+            total = sum(t["contribution"] for t in decomp["terms"])
+            assert total == pytest.approx(placement.placement_score)
+            assert decomp["score"] == pytest.approx(
+                placement.placement_score
+            )
+
+    def test_unsatisfied_terms_carry_spans(self):
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=8.0)
+        # 4 pods x 6 cpu: can't fit one rack (16 cpu), spans two
+        res = solve_serial(snap, [gang("g", pods=4, cpu=6.0, required=0)])
+        decomp = score_decomposition(snap, res.placed["g"].node_indices)
+        by_term = {t["term"]: t for t in decomp["terms"]}
+        rack = by_term["packed@t/rack"]
+        assert not rack["satisfied"]
+        assert rack["domains_spanned"] > 1
+        assert rack["lost"] == pytest.approx(1.0 / (snap.num_levels + 1))
+
+
+class TestDecisionLog:
+    def test_ring_bounds(self):
+        log = DecisionLog(max_gangs=4, per_gang=2)
+        for i in range(10):
+            for j in range(3):
+                log.record(DecisionRecord(
+                    namespace="ns", gang=f"g{i}", outcome="unplaced",
+                    wall_time=0.0, detail={"round": j},
+                ))
+        assert len(log) == 4  # LRU-evicted down to the cap
+        assert log.explain("ns", "g0") is None  # oldest evicted
+        ex = log.explain("ns", "g9")
+        assert len(ex["records"]) == 2  # per-gang ring keeps the last 2
+        assert ex["records"][-1]["detail"]["round"] == 2
+        assert log.records_total == 30
+
+    def test_engine_records_solves(self):
+        snap = cluster(blocks=1, racks=1, hosts=2, cpu=8.0)
+        eng = PlacementEngine(snap)
+        eng.solve([gang("ok", pods=1, cpu=1.0),
+                   gang("toobig", pods=3, cpu=6.0)])
+        placed = eng.decisions.explain("default", "ok")
+        assert placed["records"][-1]["outcome"] == "placed"
+        decomp = placed["records"][-1]["detail"]["decomposition"]
+        assert sum(t["contribution"] for t in decomp["terms"]) == (
+            pytest.approx(placed["records"][-1]["detail"]["score"])
+        )
+        lost = eng.decisions.explain("default", "toobig")
+        assert lost["records"][-1]["outcome"] == "unplaced"
+        assert lost["records"][-1]["detail"]["code"] == "InsufficientCapacity"
+        assert eng.debug_summary()["decisions"]["records_total"] == 2
+
+    def test_attach_preemption(self):
+        log = DecisionLog()
+        log.record(DecisionRecord(namespace="ns", gang="g",
+                                  outcome="unplaced", wall_time=0.0))
+        log.attach_preemption("ns", "g", {"evicted": [], "satisfied": False})
+        rec = log.explain("ns", "g")["records"][-1]
+        assert rec["preemption"]["satisfied"] is False
+
+    def test_summary_lists_only_pending(self):
+        log = DecisionLog()
+        log.record(DecisionRecord(namespace="", gang="a",
+                                  outcome="placed", wall_time=0.0))
+        log.record(DecisionRecord(namespace="", gang="b",
+                                  outcome="unplaced", wall_time=0.0))
+        s = log.summary()
+        assert set(s["unplaced"]) == {"b"}
+        assert s["gangs_tracked"] == 2
+
+
+class TestControlPlaneSurfaces:
+    def unsat_harness(self):
+        h = Harness(nodes=make_nodes(
+            2, allocatable={"cpu": 4.0, "memory": 8.0, "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        return h
+
+    def test_debug_dump_explains_pending_gangs(self):
+        h = self.unsat_harness()
+        explain = h.debug_dump()["explain"]
+        assert "default/simple1-0" in explain["unplaced"]
+        rec = explain["unplaced"]["default/simple1-0"]
+        assert rec["detail"]["code"] == "InsufficientCapacity"
+        funnel = rec["detail"]["funnel"]
+        assert (
+            sum(funnel["cut"].values()) + funnel["feasible"]
+            == funnel["domains_total"]
+        )
+        # the whole dump must stay JSON-able (the Debug RPC ships it)
+        json.dumps(explain)
+
+    def test_unplaced_metric_labeled_by_code(self):
+        h = self.unsat_harness()
+        counter = h.cluster.metrics.counter("grove_scheduler_unplaced_total")
+        assert counter.value(reason="InsufficientCapacity") >= 1
+
+    def test_condition_carries_code_and_survives_retry(self):
+        h = self.unsat_harness()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        g = h.store.get(PodGang.KIND, "default", "simple1-0")
+        sched = get_condition(
+            g.status.conditions, PodGangConditionType.SCHEDULED.value
+        )
+        assert sched.reason == "InsufficientCapacity"
+        assert "cpu" in sched.message
+
+    def test_explain_survives_engine_rebuild(self):
+        h = self.unsat_harness()
+        # a topology change rebuilds the engine; the CLUSTER-owned ring
+        # must keep the history
+        for node in make_nodes(1, name_prefix="late",
+                               allocatable={"cpu": 0.5, "memory": 8.0,
+                                            "tpu": 0.0}):
+            h.store.create(node)
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        ex = h.cluster.decisions.explain("default", "simple1-0")
+        assert ex is not None and len(ex["records"]) >= 2
+
+    def test_preemption_audit_attached(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+        from grove_tpu.api.meta import ObjectMeta
+        from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+        h = Harness(nodes=make_nodes(
+            4, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}))
+        low = simple_pcs(
+            name="low",
+            cliques=[clique("w", replicas=2, cpu=1.0)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2,
+                min_available=1)],
+        )
+        h.apply(low)
+        h.settle()
+        h.store.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=1000.0))
+        hi = simple_pcs(name="hi", cliques=[clique("w", replicas=2,
+                                                   cpu=1.0)])
+        hi.spec.template.priority_class_name = "gold"
+        h.apply(hi)
+        h.settle()
+        h.advance(constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1)
+        ex = h.cluster.decisions.explain("default", "hi-0")
+        pre = next(
+            (r["preemption"] for r in reversed(ex["records"])
+             if r.get("preemption")),
+            None,
+        )
+        assert pre is not None, ex
+        assert pre["satisfied"] is True
+        assert pre["evicted"]  # victims named
+        assert any(v["outcome"] == "chosen" for v in pre["considered"])
+
+
+class TestCodecRoundTrip:
+    def test_diagnosis_survives_the_wire(self):
+        from grove_tpu.service import codec
+        from grove_tpu.solver.result import SolveResult
+
+        result = SolveResult()
+        result.unplaced["g"] = UnsatDiagnosis(
+            "insufficient capacity: cpu short 3",
+            code=UnsatCode.CAPACITY,
+            funnel={"domains_total": 3,
+                    "cut": {"topology": 0, "cordoned": 0, "capacity": 3,
+                            "eligibility": 0},
+                    "feasible": 0, "binding": None},
+        )
+        result.unplaced["legacy"] = "some custom engine text"
+        data = codec.encode_solve_response(result)
+        back = codec.decode_solve_response(data, {}, [])
+        diag = back.unplaced["g"]
+        assert diag == "insufficient capacity: cpu short 3"
+        assert unsat_code(diag) is UnsatCode.CAPACITY
+        assert diag.funnel["domains_total"] == 3
+        assert back.unplaced["legacy"] == "some custom engine text"
+        assert unsat_code(back.unplaced["legacy"]) is None
+
+
+class TestMetricsHygiene:
+    def test_gauge_and_counter_remove(self):
+        from grove_tpu.observability import MetricsRegistry
+
+        m = MetricsRegistry()
+        g = m.gauge("g")
+        g.set(1.0, node="n0", state="ready")
+        g.set(1.0, node="n1", state="ready")
+        assert g.remove(node="n0", state="ready") is True
+        assert g.remove(node="n0", state="ready") is False
+        assert {ls["node"] for ls in g.label_sets()} == {"n1"}
+        c = m.counter("c")
+        c.inc(node="n0")
+        assert c.remove(node="n0") is True
+        assert c.total() == 0.0
+        assert "n0" not in m.render()
+
+    def test_node_delete_removes_lifecycle_series(self):
+        from grove_tpu.api.types import Node
+
+        h = Harness(nodes=make_nodes(4, racks_per_block=2,
+                                     hosts_per_rack=2))
+        h.apply(simple_pcs())
+        h.settle()
+        gauge = h.cluster.metrics.gauge("grove_node_lifecycle_states")
+        nodes = {ls["node"] for ls in gauge.label_sets()}
+        assert len(nodes) == 4  # one series per live node
+        victim = sorted(nodes)[0]
+        assert gauge.value(node=victim, state="ready") == 1.0
+        # empty the node, then delete it and let the monitor reconcile
+        for p in h.store.list(Node.KIND):
+            pass
+        for p in list(h.store.list("Pod")):
+            if p.node_name == victim:
+                h.store.delete("Pod", p.metadata.namespace,
+                               p.metadata.name)
+        h.store.delete(Node.KIND, "default", victim)
+        h.settle()
+        nodes_after = {ls["node"] for ls in gauge.label_sets()}
+        assert victim not in nodes_after, "deleted node's series lingers"
+        assert f'node="{victim}"' not in h.cluster.metrics.render()
+
+
+class TestEventRetention:
+    def test_ttl_sweep_bounds_the_event_store(self, monkeypatch):
+        from grove_tpu.observability.events import EventRecorder
+
+        monkeypatch.setattr(EventRecorder, "TTL_SECONDS", 50.0)
+        monkeypatch.setattr(EventRecorder, "SWEEP_INTERVAL", 10.0)
+        h = self._unsat_harness()
+        store = h.store
+        n0 = len(store.list("Event"))
+        assert n0 >= 1  # the Unschedulable warning at least
+        # long idle: everything ages past the TTL. The GC is
+        # opportunistic (it rides event RECORDING — accumulation implies
+        # recording), so a fresh workload's events trigger the sweep.
+        h.clock.advance(1000.0)
+        h.apply(simple_pcs(name="late",
+                           cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        events = store.list("Event")
+        # old events swept; whatever remains was (re)recorded just now
+        assert all(
+            h.clock.now() - e.last_timestamp <= 50.0 for e in events
+        )
+        dump = h.debug_dump()["store"]["events"]
+        assert dump["swept_total"] >= 1
+        assert dump["retained"] == len(events)
+
+    def test_max_events_cap(self, monkeypatch):
+        from grove_tpu.cluster.cluster import Cluster
+        from grove_tpu.observability.events import EventRecorder
+
+        monkeypatch.setattr(EventRecorder, "MAX_EVENTS", 5)
+        monkeypatch.setattr(EventRecorder, "SWEEP_INTERVAL", 0.0)
+        c = Cluster(nodes=make_nodes(1))
+        rec = EventRecorder(c.store, controller="t")
+        node = c.store.list("Node")[0]
+        for i in range(20):
+            c.clock.advance(1.0)
+            rec.normal(node, f"Reason{i}", "m")
+        assert len(c.store.list("Event")) <= 6  # cap + the triggering one
+
+    def _unsat_harness(self):
+        h = Harness(nodes=make_nodes(
+            2, allocatable={"cpu": 4.0, "memory": 8.0, "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        return h
+
+
+class TestChaosPostmortem:
+    def test_wedged_gang_carries_its_decision_record(self, tmp_path):
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        plan = FaultPlan.from_seed(3)
+        ch = ChaosHarness(plan, nodes=make_nodes(
+            2, allocatable={"cpu": 4.0, "memory": 8.0, "tpu": 0.0}))
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        ch.settle()
+        wedged = ch.wedged_summary()
+        entry = next(
+            e for e in wedged["unscheduled_gangs"]
+            if e["name"] == "default/simple1-0"
+        )
+        assert entry["explain"] is not None
+        rec = entry["explain"]["records"][-1]
+        assert rec["detail"]["code"] == "InsufficientCapacity"
+        # the flight dump stays JSON-able with the explain payload inside
+        path = tmp_path / "flight.json"
+        ch.dump_flight(str(path))
+        data = json.loads(path.read_text())
+        names = [e["name"] for e in data["wedged"]["unscheduled_gangs"]]
+        assert "default/simple1-0" in names
+        # and the standalone explain dump renders through the CLI
+        epath = tmp_path / "explain.json"
+        assert ch.dump_explain(str(epath)) is not None
+        from grove_tpu.observability import explain as explain_cli
+
+        assert explain_cli.main([str(epath)]) == 0
+
+
+class TestCLI:
+    def test_demo_capacity_names_binding_resource(self, capsys):
+        from grove_tpu.observability import explain as explain_cli
+
+        assert explain_cli.main(["--demo", "capacity", "--seed", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "InsufficientCapacity" in out
+        assert "cpu" in out
+        assert "binding:" in out
+
+    @pytest.mark.parametrize("scenario,code", [
+        ("cordon", "NodesUnavailable"),
+        ("eligibility", "EligibilityExcluded"),
+        ("topology", "UnresolvedTopologyLevel"),
+    ])
+    def test_demo_scenarios(self, capsys, scenario, code):
+        from grove_tpu.observability import explain as explain_cli
+
+        assert explain_cli.main(["--demo", scenario]) == 0
+        assert code in capsys.readouterr().out
+
+    def test_renders_debug_dump_file(self, tmp_path, capsys):
+        h = Harness(nodes=make_nodes(
+            2, allocatable={"cpu": 4.0, "memory": 8.0, "tpu": 0.0}))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3, cpu=3.0)]))
+        h.settle()
+        path = tmp_path / "dump.json"
+        path.write_text(json.dumps(h.debug_dump()))
+        from grove_tpu.observability import explain as explain_cli
+
+        assert explain_cli.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "UNPLACED" in out and "InsufficientCapacity" in out
+
+    def test_render_verdict_placed(self):
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=8.0)
+        res = solve_serial(snap, [gang("g", pods=4, cpu=6.0, required=0)])
+        decomp = score_decomposition(snap, res.placed["g"].node_indices)
+        text = render_verdict({
+            "gang": "default/g",
+            "records": [{
+                "outcome": "placed",
+                "detail": {"score": decomp["score"], "pods": 4,
+                           "decomposition": decomp},
+            }],
+        })
+        assert "PLACED" in text and "unsatisfied" in text

@@ -62,7 +62,12 @@ class TestShardedEngine:
         single = PlacementEngine(snap).solve(gangs)
         # "b" needs 24 cpu in one rack (16 available) -> infeasible on both
         assert set(res.placed) == set(single.placed) == {"a", "c"}
-        assert res.unplaced == {"b": "no feasible domain"}
+        assert set(res.unplaced) == {"b"}
+        # structured diagnosis (explain.py): a capacity verdict naming cpu
+        from grove_tpu.observability.explain import UnsatCode, unsat_code
+
+        assert unsat_code(res.unplaced["b"]) == UnsatCode.CAPACITY
+        assert "cpu" in res.unplaced["b"]
 
 
 class TestPadDomainAbsorption:
