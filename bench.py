@@ -157,6 +157,19 @@ def main() -> int:
                     "control-plane benches' reconcile/solve spans. "
                     "Tracing adds a little overhead — leave unset for "
                     "record runs (see docs/observability.md)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant sustained-churn regime: drive a "
+                    "Zipf-skewed gang arrival stream across N tenant "
+                    "queues (quota + DRF fairness enabled) and assert "
+                    "the north-star fairness contract — zero starved "
+                    "tenants and bounded max fairness error "
+                    "(|dominant share - entitlement| over burst-eligible "
+                    "tenants). 0 disables; the ROADMAP regime is "
+                    "--tenants 50")
+    ap.add_argument("--fairness-bound", type=float, default=0.1,
+                    help="--tenants: max tolerated fairness error as a "
+                    "fraction of cluster dominant capacity (exit 1 "
+                    "above it)")
     ap.add_argument("--service", action="store_true",
                     help="benchmark the solve THROUGH the placement-service "
                     "gRPC boundary (server spawned as a subprocess on this "
@@ -180,10 +193,27 @@ def main() -> int:
     if args.small:
         args.nodes, args.gangs, args.iters = 512, 64, 3
         args.cp_replicas = min(args.cp_replicas, 20)
+        # clamps are LOUD: a capped churn run must not read as a full one
+        # (the JSON reports the clamped rate with no other trace)
+        if args.churn_rate > 20.0:
+            print(
+                f"bench --small: clamping --churn-rate "
+                f"{args.churn_rate:g} -> 20.0 gangs/s",
+                file=sys.stderr,
+            )
         args.churn_rate = min(args.churn_rate, 20.0)
+        if args.churn_duration > 3.0:
+            print(
+                f"bench --small: clamping --churn-duration "
+                f"{args.churn_duration:g} -> 3.0 virtual seconds",
+                file=sys.stderr,
+            )
         args.churn_duration = min(args.churn_duration, 3.0)
         if args.serial_sample == 0:
             args.serial_sample = 32
+
+    if args.tenants > 0:
+        return bench_tenants(args)
 
     snapshot = make_cluster(args.nodes)
     gangs = make_gangs(args.gangs)
@@ -597,6 +627,38 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
             free_d, free_f,
         )
         free = free_d  # carry the committed state forward
+
+    # 5) tenant fairness terms (grove_tpu/tenancy): seeded per-gang DRF
+    #    weights reorder the commit scan and ride the cost tensor as an
+    #    extra column — fairness-weighted solves must stay bit-identical
+    #    across the device-state regimes, including a fairness-stamped
+    #    dispatch adopted through the epoch guard
+    fair = {
+        g.name: round(float(rng.uniform(-0.5, 1.5)), 6) for g in gangs
+    }
+    # continue from the churn-carried content (a rewind to the pristine
+    # matrix would need a note_free_rows(None) unknown-scope declaration;
+    # carrying forward keeps the delta engine on the row-scoped path)
+    free_d, free_f = free.copy(), free.copy()
+    compare(
+        "fairness",
+        eng_d.solve(gangs, free=free_d, fairness=fair),
+        eng_f.solve(gangs, free=free_f, fairness=fair),
+        free_d, free_f,
+    )
+    handle = eng_d.dispatch(gangs, free=free.copy(), fairness=fair)
+    free_d, free_f = free.copy(), free.copy()
+    res_d = eng_d.solve(gangs, free=free_d, dispatch=handle, fairness=fair)
+    if not res_d.stats.get("dispatch_overlap"):
+        failures.append(
+            "fairness-dispatch: unchanged fairness-stamped dispatch not "
+            "adopted"
+        )
+    compare(
+        "fairness-dispatch", res_d,
+        eng_f.solve(gangs, free=free_f, fairness=fair),
+        free_d, free_f,
+    )
 
     ds = eng_d.debug_summary()["device_state"]
     out = {
@@ -1074,6 +1136,182 @@ def bench_churn(
     if trace_groups is not None:
         trace_groups["churn"] = h.cluster.tracer
     return {f"churn_{k}": v for k, v in stats.items()}
+
+
+def bench_tenants(args) -> int:
+    """Multi-tenant sustained-churn regime (`--tenants N`, ROADMAP item
+    3's "millions of users" scenario): N tenant queues with guaranteed/
+    burst cpu quota and equal DRF weight, driven by a Zipf-skewed gang
+    arrival stream (tenant 0 offers ~an order of magnitude more load
+    than the tail) against the full control plane with tenancy enabled.
+
+    Asserts the fairness contract and exits nonzero on violation:
+      - ZERO starved tenants: every tenant that offered load gets at
+        least one gang bound (the guarantee band must hold under skew);
+      - bounded fairness error: the max |dominant share - entitlement|
+        over burst-eligible tenants, sampled every batch, stays under
+        --fairness-bound (DRF must keep redistributing the burst band).
+
+    Prints one JSON line (same shape as the other bench modes) carrying
+    the per-tenant outcome distribution, shed counts and the sampled
+    fairness-error peak."""
+    import collections
+
+    from grove_tpu.api.meta import get_condition
+    from grove_tpu.api.naming import base_podgang_name
+    from grove_tpu.api.podgang import PodGang, PodGangConditionType
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+    from grove_tpu.tuning import tune_gc
+
+    T = args.tenants
+    tenants = [f"t{i:03d}" for i in range(T)]
+    # quota: every tenant is guaranteed 2 gangs' worth of cpu and may
+    # burst to 5; the cluster itself has headroom, so sheds come from
+    # QUOTA (the admission contract under test), not raw capacity
+    gang_cpu = 8.0  # 8 pods x 1 cpu
+    config = {
+        "tenancy": {
+            "enabled": True,
+            "fairness_weight": 0.5,
+            "tenants": [
+                {
+                    "name": t,
+                    "guaranteed": {"cpu": 2 * gang_cpu},
+                    "burst": {"cpu": 5 * gang_cpu},
+                    "weight": 1.0,
+                }
+                for t in tenants
+            ],
+        }
+    }
+    h = Harness(
+        nodes=make_nodes(
+            args.nodes,
+            allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+        ),
+        config=config,
+    )
+    h.settle()
+    tune_gc()
+
+    rng = np.random.default_rng(11)
+    batch_dt = 0.5
+    n_arrivals = max(int(round(args.churn_rate * args.churn_duration)),
+                     3 * T)
+    # skewed offered load with full coverage: the first T arrivals hit
+    # every tenant once (a tenant that never offers load cannot starve),
+    # the rest draw Zipf — tenant 0 dominates the offered stream
+    zipf_w = 1.0 / np.arange(1, T + 1, dtype=np.float64) ** 1.2
+    zipf_w /= zipf_w.sum()
+    sequence = list(rng.permutation(T)) + list(
+        rng.choice(T, size=max(0, n_arrivals - T), p=zipf_w)
+    )
+    batch = max(1, int(round(args.churn_rate * batch_dt)))
+    population = 4 * T
+
+    alive: collections.deque[tuple[str, str]] = collections.deque()
+    pending: dict[tuple[str, str], str] = {}  # (ns, gang) -> tenant
+    created = collections.Counter()
+    bound = collections.Counter()
+    max_fairness_error = 0.0
+    seq = 0
+    t0 = time.perf_counter()
+
+    def sample_bound() -> None:
+        done = []
+        for (ns, gname), tenant in pending.items():
+            gang = h.store.peek(PodGang.KIND, ns, gname)
+            if gang is None:
+                continue
+            cond = get_condition(
+                gang.status.conditions,
+                PodGangConditionType.SCHEDULED.value,
+            )
+            if cond is not None and cond.status == "True":
+                bound[tenant] += 1
+                done.append((ns, gname))
+        for key in done:
+            del pending[key]
+
+    while sequence:
+        for tenant_idx in sequence[:batch]:
+            tenant = tenants[int(tenant_idx)]
+            name = f"mt-{seq}"
+            seq += 1
+            pcs = _churn_pcs(name)
+            pcs.metadata.namespace = tenant
+            h.apply(pcs)
+            alive.append((tenant, name))
+            pending[(tenant, base_podgang_name(name, 0))] = tenant
+            created[tenant] += 1
+        sequence = sequence[batch:]
+        while len(alive) > population:
+            tenant, victim = alive.popleft()
+            h.store.delete("PodCliqueSet", tenant, victim)
+            pending.pop((tenant, base_podgang_name(victim, 0)), None)
+        h.clock.advance(batch_dt)
+        h.settle()
+        h.compact_events()
+        sample_bound()
+        snapshot = h.cluster.topology_snapshot()
+        h.cluster.tenancy.refresh_and_export(
+            h.store, snapshot,
+            h.cluster.pod_demand_fn(snapshot.resource_names),
+        )
+        max_fairness_error = max(
+            max_fairness_error, h.cluster.tenancy.fairness_error()
+        )
+    # drain: fire the scheduler's quota-retry timers a few times so
+    # gangs shed at peak skew get their post-churn admission chance
+    for _ in range(4):
+        h.advance(6.0)
+        sample_bound()
+    wall = time.perf_counter() - t0
+
+    starved = sorted(
+        t for t in tenants if created[t] > 0 and bound[t] == 0
+    )
+    sheds = h.cluster.metrics.counter("grove_tenant_gangs_shed_total")
+    preempts = h.cluster.metrics.counter(
+        "grove_tenant_preemption_evictions_total"
+    )
+    bound_counts = [bound[t] for t in tenants]
+    out = {
+        "metric": f"multi-tenant skewed churn ({T} tenants, "
+        f"{args.nodes} nodes, Zipf offered load)",
+        "value": round(sum(bound_counts) / wall, 1) if wall else 0.0,
+        "unit": "gangs/sec",
+        "vs_baseline": 0.0,
+        "tenants": T,
+        "tenants_offered": sum(1 for t in tenants if created[t] > 0),
+        "tenants_starved": len(starved),
+        "starved": starved[:8],
+        "created": int(sum(created.values())),
+        "bound": int(sum(bound_counts)),
+        "unbound_final": len(pending),
+        "sheds": int(sheds.total()),
+        "preemption_evictions": int(preempts.total()),
+        "bound_per_tenant_min": int(min(bound_counts)) if bound_counts else 0,
+        "bound_per_tenant_max": int(max(bound_counts)) if bound_counts else 0,
+        "max_fairness_error": round(max_fairness_error, 4),
+        "fairness_bound": args.fairness_bound,
+        "wall_seconds": round(wall, 2),
+        "backend": __import__("jax").default_backend(),
+        "engine": "single",
+    }
+    print(json.dumps(out))
+    ok = not starved and max_fairness_error <= args.fairness_bound
+    if starved:
+        print(f"TENANT BENCH FAILURE: {len(starved)} starved tenant(s): "
+              f"{starved[:8]}", file=sys.stderr)
+    if max_fairness_error > args.fairness_bound:
+        print(
+            f"TENANT BENCH FAILURE: max fairness error "
+            f"{max_fairness_error:.4f} > bound {args.fairness_bound}",
+            file=sys.stderr,
+        )
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,7 +1,7 @@
 """Workload + scheduler-contract API surface of grove_tpu."""
 
 from . import constants, naming
-from .defaulting import default_podcliqueset
+from .defaulting import default_podcliqueset, default_podgang
 from .meta import (
     Condition,
     NamespacedName,
@@ -65,6 +65,7 @@ from .validation import (
     validate_cluster_topology,
     validate_podcliqueset,
     validate_podcliqueset_update,
+    validate_podgang,
 )
 from .config import (
     AuthorizationConfig,
@@ -73,6 +74,7 @@ from .config import (
     LogConfig,
     OperatorConfig,
     SolverConfig,
+    TenancyConfig,
     TopologyAwareSchedulingConfig,
     WorkloadDefaultsConfig,
     load_operator_config,

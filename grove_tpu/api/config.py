@@ -109,6 +109,67 @@ class SolverConfig:
     device_state_verify: bool = False
 
 
+#: built-in priority-tier ladder seeded as PriorityClass objects when
+#: tenancy is enabled (highest first; `value` feeds the scheduler's
+#: backlog ordering and preemption exactly like any PriorityClass)
+DEFAULT_TENANCY_TIERS = (
+    {"name": "system", "value": 10000.0},
+    {"name": "high", "value": 1000.0},
+    {"name": "standard", "value": 100.0},
+    {"name": "low", "value": 0.0},
+)
+
+
+@dataclass
+class TenancyConfig:
+    """Multi-tenant scheduling (grove_tpu/tenancy/): hierarchical tenant
+    queues with guaranteed/burst quota per resource, dominant-resource
+    fairness weighted into the solver's cost tensor, priority tiers, and
+    admission control that sheds over-quota gangs with a structured
+    `UnsatCode.QuotaExceeded` instead of queueing them silently.
+
+    The reference delegates all of this to the external KAI scheduler
+    (its e2e applies queues.yaml; PodGang merely carries
+    PriorityClassName — SURVEY §4); grove_tpu owns the scheduler, so it
+    owns tenant arbitration.
+
+    `tenants` entries are mappings (like topology_aware_scheduling.levels):
+      name               tenant id; gangs map to it by the grove.io/tenant
+                         label or by namespace == name
+      guaranteed         {resource: amount} always-admitted quota
+                         (absent resource = 0: anything is burst)
+      burst              {resource: amount} hard ceiling; admission sheds
+                         above it (absent resource = unlimited)
+      weight             DRF weight (> 0, default 1.0)
+      tier               priority tier name (default `default_tier`)
+      parent             parent queue name ("" = top level); ancestors'
+                         quota applies to every descendant's admission
+      disruption_budget  max gangs of this tenant evictable per
+                         preemption round (absent = unbounded)
+    """
+
+    enabled: bool = False
+    #: PodGang/PodCliqueSet label naming the owning tenant; namespace ==
+    #: tenant name is the fallback attribution
+    tenant_label: str = "grove.io/tenant"
+    #: tenant for gangs that match no configured tenant ("" = exempt:
+    #: admitted untracked with zero fairness weight)
+    default_tenant: str = ""
+    #: tier assumed for tenants (and defaulted onto PodGangs with an
+    #: empty priority_class_name) that don't name one
+    default_tier: str = "standard"
+    #: scale of the DRF fairness term stamped onto solver gangs (0
+    #: disables fairness ordering while keeping quota admission)
+    fairness_weight: float = 0.5
+    #: priority-tier ladder, each {name, value}; seeded as PriorityClass
+    #: objects at cluster construction when tenancy is enabled, and the
+    #: allowed vocabulary for PodGang.spec.priority_class_name admission
+    tiers: list[dict] = field(
+        default_factory=lambda: [dict(t) for t in DEFAULT_TENANCY_TIERS]
+    )
+    tenants: list[dict] = field(default_factory=list)
+
+
 @dataclass
 class AutoscalerConfig:
     """k8s HPA controller knobs."""
@@ -181,6 +242,7 @@ class OperatorConfig:
     controllers: ControllerConfig = field(default_factory=ControllerConfig)
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
     topology_aware_scheduling: TopologyAwareSchedulingConfig = field(
@@ -223,6 +285,7 @@ _TYPES = {
     "ControllerConfig": ControllerConfig,
     "ClusterConfig": ClusterConfig,
     "SolverConfig": SolverConfig,
+    "TenancyConfig": TenancyConfig,
     "AutoscalerConfig": AutoscalerConfig,
     "AuthorizationConfig": AuthorizationConfig,
     "TopologyAwareSchedulingConfig": TopologyAwareSchedulingConfig,
@@ -352,6 +415,8 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             "epoch guard; with the cache off it never runs)"
         )
 
+    errs += _validate_tenancy(cfg.tenancy)
+
     le = cfg.leader_election
     if not isinstance(le.enabled, bool):
         errs.append("config.leader_election.enabled: must be a bool")
@@ -409,6 +474,143 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     if not _int(tr.flight_recorder_capacity) or tr.flight_recorder_capacity < 1:
         errs.append(
             "config.tracing.flight_recorder_capacity: must be an int >= 1"
+        )
+    return errs
+
+
+def _validate_tenancy(tn: TenancyConfig) -> list[str]:
+    """Aggregated semantic validation of the tenancy block. Structural
+    problems (a malformed tier/tenant entry) short-circuit per entry so
+    one bad mapping doesn't cascade into attribute errors."""
+    errs: list[str] = []
+    if not isinstance(tn.enabled, bool):
+        errs.append("config.tenancy.enabled: must be a bool")
+    if not isinstance(tn.tenant_label, str) or not tn.tenant_label:
+        errs.append("config.tenancy.tenant_label: must be a non-empty string")
+    if not _num(tn.fairness_weight) or tn.fairness_weight < 0:
+        errs.append("config.tenancy.fairness_weight: must be a number >= 0")
+
+    tier_names: set[str] = set()
+    if not isinstance(tn.tiers, list):
+        errs.append("config.tenancy.tiers: must be a list")
+    else:
+        for i, tier in enumerate(tn.tiers):
+            path = f"config.tenancy.tiers[{i}]"
+            if not isinstance(tier, dict) or set(tier) != {"name", "value"}:
+                errs.append(f"{path}: must be a {{name, value}} mapping")
+                continue
+            if not isinstance(tier["name"], str) or not tier["name"]:
+                errs.append(f"{path}.name: must be a non-empty string")
+                continue
+            if tier["name"] in tier_names:
+                errs.append(f"{path}.name: duplicate tier {tier['name']!r}")
+            tier_names.add(tier["name"])
+            if not _num(tier["value"]):
+                errs.append(f"{path}.value: must be a number")
+    if isinstance(tn.tiers, list) and not tn.tiers and tn.enabled is True:
+        # an enabled-but-tierless config would wedge every PodGang
+        # create: defaulting stamps default_tier onto empty names and
+        # admission then rejects the unconfigured tier
+        errs.append(
+            "config.tenancy.tiers: must not be empty when tenancy is "
+            "enabled (PodGang defaulting stamps default_tier, which "
+            "admission validates against this set)"
+        )
+    if tn.tiers and tn.default_tier not in tier_names:
+        errs.append(
+            f"config.tenancy.default_tier: {tn.default_tier!r} is not a "
+            f"configured tier (have {sorted(tier_names)})"
+        )
+
+    tenant_names: set[str] = set()
+    parents: dict[str, str] = {}
+    if not isinstance(tn.tenants, list):
+        errs.append("config.tenancy.tenants: must be a list")
+        return errs
+    allowed_keys = {
+        "name", "guaranteed", "burst", "weight", "tier", "parent",
+        "disruption_budget",
+    }
+    for i, t in enumerate(tn.tenants):
+        path = f"config.tenancy.tenants[{i}]"
+        if not isinstance(t, dict):
+            errs.append(f"{path}: must be a mapping")
+            continue
+        unknown = set(t) - allowed_keys
+        if unknown:
+            errs.append(f"{path}: unknown field(s) {sorted(unknown)}")
+        name = t.get("name")
+        if not isinstance(name, str) or not name:
+            errs.append(f"{path}.name: must be a non-empty string")
+            continue
+        if name in tenant_names:
+            errs.append(f"{path}.name: duplicate tenant {name!r}")
+        tenant_names.add(name)
+        guaranteed = t.get("guaranteed", {})
+        burst = t.get("burst", {})
+        for fname, quota in (("guaranteed", guaranteed), ("burst", burst)):
+            if not isinstance(quota, dict):
+                errs.append(f"{path}.{fname}: must be a {{resource: amount}} "
+                            "mapping")
+                continue
+            for res, amount in quota.items():
+                if not isinstance(res, str) or not res:
+                    errs.append(f"{path}.{fname}: resource names must be "
+                                "non-empty strings")
+                elif not _num(amount) or amount < 0:
+                    errs.append(
+                        f"{path}.{fname}[{res!r}]: must be a number >= 0"
+                    )
+        if isinstance(guaranteed, dict) and isinstance(burst, dict):
+            for res, cap in burst.items():
+                g = guaranteed.get(res, 0.0)
+                if _num(cap) and _num(g) and cap < g:
+                    errs.append(
+                        f"{path}.burst[{res!r}]: must be >= guaranteed "
+                        f"({cap} < {g}) — burst is the ceiling over the "
+                        "guarantee, not a second floor"
+                    )
+        weight = t.get("weight", 1.0)
+        if not _num(weight) or weight <= 0:
+            errs.append(f"{path}.weight: must be a number > 0")
+        tier = t.get("tier", "")
+        if tier and tier_names and tier not in tier_names:
+            errs.append(
+                f"{path}.tier: unknown tier {tier!r} "
+                f"(configured: {sorted(tier_names)})"
+            )
+        budget = t.get("disruption_budget")
+        if budget is not None and (not _int(budget) or budget < 0):
+            errs.append(f"{path}.disruption_budget: must be an int >= 0")
+        parent = t.get("parent", "")
+        if parent:
+            if not isinstance(parent, str):
+                errs.append(f"{path}.parent: must be a string")
+            else:
+                parents[name] = parent
+    for name, parent in parents.items():
+        if parent not in tenant_names:
+            errs.append(
+                f"config.tenancy.tenants[{name!r}].parent: unknown tenant "
+                f"{parent!r}"
+            )
+    # the parent graph must be a forest: walk each chain with a visited
+    # set; revisiting a node inside one walk is a cycle
+    for name in parents:
+        seen = {name}
+        cur = parents.get(name)
+        while cur is not None:
+            if cur in seen:
+                errs.append(
+                    f"config.tenancy.tenants: parent cycle through {cur!r}"
+                )
+                break
+            seen.add(cur)
+            cur = parents.get(cur)
+    if tn.default_tenant and tn.default_tenant not in tenant_names:
+        errs.append(
+            f"config.tenancy.default_tenant: {tn.default_tenant!r} is not "
+            "a configured tenant"
         )
     return errs
 

@@ -24,6 +24,11 @@ LABEL_POD_INDEX = "grove.io/pod-index"
 # names may themselves contain hyphens, so the template name cannot be
 # recovered from the PodClique FQN by splitting.
 LABEL_CLIQUE_TEMPLATE = "grove.io/clique-template-name"
+# Owning tenant for multi-tenant scheduling (grove_tpu/tenancy): stamped
+# on a PodCliqueSet by the user and propagated onto its PodGangs; gangs
+# without it attribute by namespace == tenant name. The default value of
+# api.config.TenancyConfig.tenant_label.
+LABEL_TENANT = "grove.io/tenant"
 
 # Component values for LABEL_COMPONENT.
 COMPONENT_HEADLESS_SERVICE = "pcs-headless-service"

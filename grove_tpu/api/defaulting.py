@@ -61,3 +61,17 @@ def default_podcliqueset(pcs: PodCliqueSet, defaults=None) -> PodCliqueSet:
             sg.min_available = 1
 
     return pcs
+
+
+def default_podgang(pg, tier_of=None, default_tier: str = ""):
+    """PodGang defaulting (registered by Cluster when tenancy is
+    enabled): an EMPTY spec.priority_class_name — which previously
+    round-tripped silently and resolved to the global-default
+    PriorityClass — defaults to the gang's tenant tier (`tier_of(pg)`,
+    the TenancyManager hook) or the configured default tier, so every
+    admitted gang carries an explicit, validated tier. Set fields are
+    never touched (defaulting only fills unset fields)."""
+    if not pg.spec.priority_class_name:
+        tier = tier_of(pg) if tier_of is not None else ""
+        pg.spec.priority_class_name = tier or default_tier
+    return pg

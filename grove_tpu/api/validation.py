@@ -338,6 +338,25 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         raise ValidationError(errs)
 
 
+def validate_podgang(pg, allowed_priorities=None) -> None:
+    """PodGang admission (registered by Cluster when tenancy is enabled):
+    spec.priority_class_name must name a configured tenancy tier or a
+    known PriorityClass. Before this, ANY string silently round-tripped
+    and resolved to priority 0 at solve time — a typo'd tier demoted a
+    workload with no signal anywhere. `allowed_priorities` None (tenancy
+    disabled) keeps the legacy round-trip behavior; an empty name is
+    legal here because defaulting fills it first."""
+    if allowed_priorities is None:
+        return
+    name = pg.spec.priority_class_name
+    if name and name not in allowed_priorities:
+        raise ValidationError([
+            f"spec.priority_class_name: {name!r} is not a configured "
+            f"priority tier or PriorityClass "
+            f"(allowed: {sorted(allowed_priorities)})"
+        ])
+
+
 def validate_cluster_topology(ct) -> None:
     """Admission-time validation for ClusterTopology (the reference enforces
     the domain enum via CRD schema, clustertopology.go:72-87). Callers of

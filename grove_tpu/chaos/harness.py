@@ -149,6 +149,10 @@ class ChaosHarness:
         self._hb_lost: set[str] = set()
         self._outage_domains: list[str] = []
         self._drained_nodes: list[str] = []
+        #: tenant-skew workloads injected this run ((namespace, name)
+        #: PCS keys; all deleted at disarm so the recovered fixpoint
+        #: matches the fault-free run)
+        self._skew_workloads: list[tuple[str, str]] = []
 
     #: drain storms are capped per run: an unbounded storm could cordon
     #: the whole inventory out from under the workload, and a drained
@@ -284,6 +288,72 @@ class ChaosHarness:
                 cluster.drain(name)
                 self._drained_nodes.append(name)
 
+    #: tenant namespaces the skew fault targets when the cluster has no
+    #: tenancy configured (load skew is meaningful either way; with
+    #: tenancy enabled the configured tenant set is used instead)
+    SKEW_TENANTS = ("skew-a", "skew-b")
+
+    def _skew_tenant_names(self) -> list[str]:
+        tenancy = getattr(self.harness.cluster, "tenancy", None)
+        if tenancy is not None and tenancy.enabled and tenancy.queues:
+            return sorted(tenancy.queues)
+        return list(self.SKEW_TENANTS)
+
+    def _inject_tenant_skew(self) -> None:
+        """Tenant-skew load fault: a burst of single-replica gangs lands
+        in ONE seeded tenant's namespace — the skewed-offered-load shape
+        quota admission and DRF fairness must absorb. With tenancy
+        enabled the burst exercises the real admission bands (some of it
+        sheds with QuotaExceeded); without it the burst is plain load
+        skew. Injected PCS are tracked and deleted at disarm (see
+        _repair_infrastructure), so the post-chaos fixpoint equals the
+        fault-free one."""
+        from ..api.meta import ObjectMeta
+        from ..api.types import (
+            Container,
+            PodCliqueSet,
+            PodCliqueSetSpec,
+            PodCliqueSetTemplateSpec,
+            PodCliqueSpec,
+            PodCliqueTemplateSpec,
+            PodSpec,
+        )
+
+        plan = self.plan
+        tenants = self._skew_tenant_names()
+        ns = tenants[plan.pick(len(tenants))]
+        for _ in range(max(1, plan.tenant_skew_burst)):
+            name = f"skew-{len(self._skew_workloads)}"
+            pcs = PodCliqueSet(
+                metadata=ObjectMeta(name=name, namespace=ns),
+                spec=PodCliqueSetSpec(
+                    replicas=1,
+                    template=PodCliqueSetTemplateSpec(
+                        cliques=[
+                            PodCliqueTemplateSpec(
+                                name="w",
+                                spec=PodCliqueSpec(
+                                    replicas=2,
+                                    pod_spec=PodSpec(
+                                        containers=[
+                                            Container(
+                                                name="m",
+                                                resources={"cpu": 1.0},
+                                            )
+                                        ]
+                                    ),
+                                ),
+                            )
+                        ]
+                    ),
+                ),
+            )
+            # injected via the RAW store: the fault driver must not fault
+            # its own injections (the chaos proxy would raise transient
+            # write failures / ManagerCrash at the driver level)
+            self.raw_store.create(pcs)
+            self._skew_workloads.append((ns, name))
+
     def _tick_node_faults(self) -> None:
         """End-of-step flap timers: expired flaps resume heartbeating
         (the node then rides the monitor's stable-ready window back in)."""
@@ -314,6 +384,14 @@ class ChaosHarness:
         for name in self._drained_nodes:
             cluster.uncordon(name)
         self._drained_nodes = []
+        for ns, name in self._skew_workloads:
+            # the skew load leaves with the faults: the convergence
+            # contract measures the recovered fixpoint against the
+            # fault-free workload, and the injected PCS cascade-delete
+            # (finalizers -> pods -> gangs) during the recovery settle
+            if self.raw_store.peek(PodCliqueSet.KIND, ns, name) is not None:
+                self.raw_store.delete(PodCliqueSet.KIND, ns, name)
+        self._skew_workloads = []
 
     def run_chaos(self) -> None:
         """The chaos phase: `plan.chaos_steps` driver steps of manager
@@ -336,6 +414,14 @@ class ChaosHarness:
                 if plan.flip(plan.compaction_rate):
                     self.chaos_store.force_compaction()
                 self._inject_node_faults()
+                # guarded on rate > 0 BEFORE any draw: pre-existing seeds
+                # (rate 0 by default) keep their exact draw sequence and
+                # verified convergence
+                if plan.tenant_skew_rate > 0 and plan.flip(
+                    plan.tenant_skew_rate
+                ):
+                    self._record("tenant_skew")
+                    self._inject_tenant_skew()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")

@@ -75,6 +75,18 @@ class FaultPlan:
     domain_outage_rate: float = 0.015
     drain_storm_rate: float = 0.015
 
+    # multi-tenant load faults (per chaos step): tenant_skew applies a
+    # burst of extra workload in one (seeded) tenant's namespace —
+    # skewed offered load mid-chaos, the thing quota admission + DRF
+    # fairness must absorb without starving anyone. Injected workload is
+    # deleted at disarm so the convergence contract's fixpoint is
+    # unchanged. DEFAULT 0: the runtime draw is guarded on rate > 0 (see
+    # ChaosHarness), so every pre-existing seed's draw sequence — and
+    # therefore its verified convergence — is bit-identical.
+    tenant_skew_rate: float = 0.0
+    #: gangs per injected skew burst
+    tenant_skew_burst: int = 3
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

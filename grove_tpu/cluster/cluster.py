@@ -12,13 +12,16 @@ import numpy as np
 
 from ..api import (
     default_podcliqueset,
+    default_podgang,
     validate_cluster_topology,
     validate_podcliqueset,
     validate_podcliqueset_update,
+    validate_podgang,
 )
 from ..api.auxiliary import PriorityClass
 from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
+from ..api.podgang import PodGang
 from ..api.types import ClusterTopology, Node, Pod, PodPhase, TopologyLevel
 from ..observability import Logger, MetricsRegistry
 from ..observability.explain import DecisionLog
@@ -47,6 +50,13 @@ class Cluster:
         # The scheduler injects it into every engine it builds; bounded,
         # so always on.
         self.decisions = DecisionLog()
+        # Multi-tenant arbitration (grove_tpu/tenancy): cluster-owned for
+        # the same reason — tenant accounting and quota state survive
+        # scheduler rebuilds. Built unconditionally (cheap); a disabled
+        # config makes every hook a no-op.
+        from ..tenancy import TenancyManager
+
+        self.tenancy = TenancyManager(self.config.tenancy, metrics=self.metrics)
         self.logger = Logger(
             level=self.config.log.level, format=self.config.log.format
         )
@@ -70,6 +80,26 @@ class Cluster:
         self.store.register_admission(
             "ClusterTopology", Admission(validate=validate_cluster_topology)
         )
+        if self.tenancy.enabled:
+            # PodGang admission under tenancy: an empty priority class
+            # defaults to the gang's tenant tier, and a set one must name
+            # a configured tier or a known PriorityClass — before this,
+            # any string silently round-tripped and resolved to priority
+            # 0 at solve time. The allowed set is computed at admission
+            # time so user-created PriorityClasses count.
+            self.store.register_admission(
+                PodGang.KIND,
+                Admission(
+                    default=lambda pg: default_podgang(
+                        pg,
+                        tier_of=self.tenancy.tier_of_gang,
+                        default_tier=self.config.tenancy.default_tier,
+                    ),
+                    validate=lambda pg: validate_podgang(
+                        pg, allowed_priorities=self._allowed_priorities()
+                    ),
+                ),
+            )
         if self.config.authorization.enabled:
             from ..api.authorization import make_authorizer
 
@@ -101,6 +131,24 @@ class Cluster:
                     metadata=ObjectMeta(name=pc_name, namespace=""), value=value
                 )
             )
+        if self.tenancy.enabled:
+            # the configured tenancy tiers ARE PriorityClasses: seeding
+            # them here makes tier names resolve through the scheduler's
+            # existing _priority_of path and drive the existing
+            # preemption machinery with zero new priority plumbing. The
+            # default tier is the global default so even pre-tenancy
+            # gangs with an empty name land on it.
+            for tier in self.config.tenancy.tiers:
+                self.store.create(
+                    PriorityClass(
+                        metadata=ObjectMeta(name=tier["name"], namespace=""),
+                        value=float(tier["value"]),
+                        global_default=(
+                            tier["name"] == self.config.tenancy.default_tier
+                        ),
+                        description="tenancy priority tier",
+                    )
+                )
         for node in nodes or []:
             self.store.create(node)
         #: topology_snapshot static-encoding cache (see topology_snapshot)
@@ -119,6 +167,16 @@ class Cluster:
         #: monotonic free-content epoch stamped onto snapshots (bumped
         #: whenever usage() observed any capacity-moving pod transition)
         self._free_epoch = 0
+
+    def _allowed_priorities(self) -> set[str]:
+        """PodGang admission vocabulary under tenancy: the configured
+        tier names plus every PriorityClass in the store (system-* and
+        user-created classes stay legal). Computed per admission — the
+        class population is tiny and user classes may arrive any time."""
+        allowed = self.tenancy.tier_names()
+        for pc in self.store.scan(PriorityClass.KIND):
+            allowed.add(pc.metadata.name)
+        return allowed
 
     # -- tracing ------------------------------------------------------------
     def enable_tracing(self, max_spans: int | None = None,

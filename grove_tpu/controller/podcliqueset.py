@@ -710,6 +710,15 @@ class PodCliqueSetReconciler:
             base_labels(name),
             **{constants.LABEL_COMPONENT: constants.COMPONENT_PODGANG},
         )
+        # tenant attribution rides the owning PCS's label onto every gang
+        # it creates (grove_tpu/tenancy; namespace == tenant name is the
+        # label-less fallback). NOT folded into comp_labels: the orphan-GC
+        # scan below selects on comp_labels, and gangs created before a
+        # PCS grew its tenant label must stay collectable.
+        tenant_labels = {}
+        tenant = pcs.metadata.labels.get(constants.LABEL_TENANT)
+        if tenant:
+            tenant_labels[constants.LABEL_TENANT] = tenant
         deferred = False
         for gang_name, (replica, spec, extra_labels) in expected.items():
             pods_by_group = {}
@@ -747,6 +756,7 @@ class PodCliqueSetReconciler:
                 labels = dict(
                     comp_labels,
                     **{constants.LABEL_PCS_REPLICA_INDEX: str(replica)},
+                    **tenant_labels,
                     **extra_labels,
                 )
                 self.store.create(

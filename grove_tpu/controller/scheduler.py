@@ -107,6 +107,22 @@ class GangScheduler:
             # working untraced even under ChaosHarness, which always
             # enables tracing for the flight recorder
             self._engine_kwargs["tracer"] = cluster.tracer
+        #: tenant arbitration (grove_tpu/tenancy): cluster-owned manager,
+        #: None when the cluster predates it (custom test fixtures);
+        #: every hook below checks enabled
+        self.tenancy = getattr(cluster, "tenancy", None)
+        #: fairness kwarg gates, same capability pattern as the
+        #: device-state knobs: the DRF weight vector is only passed to
+        #: solve/dispatch when the engine's signature takes it — a
+        #: strict-signature custom engine runs without tenant fairness
+        #: instead of dying on an unexpected keyword
+        self._fairness_solve_ok = accepts_kwarg(
+            getattr(engine_cls, "solve", None) or engine_cls, "fairness"
+        )
+        disp = getattr(engine_cls, "dispatch", None)
+        self._fairness_dispatch_ok = disp is not None and accepts_kwarg(
+            disp, "fairness"
+        )
         #: (namespace, gang name) pairs whose pods/status changed since the
         #: last reconcile — the incremental alternative to the r1 design of
         #: re-checking every pod reference of every scheduled gang on every
@@ -357,21 +373,39 @@ class GangScheduler:
 
     def _fetch_and_encode(self, backlog_keys, snapshot):
         """Backlog fetch (real copies — status writes follow) + solver
-        encoding. ONE code path shared by pre_round and the reconcile
-        fallback: the adoption guards trust that pre_round's encode equals
-        what the reconcile would compute, so the two must never diverge."""
+        encoding + tenant admission. ONE code path shared by pre_round
+        and the reconcile fallback: the adoption guards trust that
+        pre_round's encode equals what the reconcile would compute, so
+        the two must never diverge. Returns (backlog, encoded, fairness):
+        the tenancy pass classifies every encoded gang (stamping
+        QuotaExceeded holds on shed gangs and SolverGang.fairness on the
+        rest) and returns the {gang: weight} vector threaded into the
+        engine; fairness is None when tenancy is off (zero overhead)."""
         with self.tracer.span("scheduler.encode", gangs=len(backlog_keys)):
             backlog = [
                 self.store.get(PodGang.KIND, ns, name)
                 for ns, name in backlog_keys
             ]
+            demand_fn = self.cluster.pod_demand_fn(snapshot.resource_names)
             encoded = encode_podgangs(
-                backlog, snapshot,
-                self.cluster.pod_demand_fn(snapshot.resource_names),
+                backlog, snapshot, demand_fn,
                 priority_of=self._priority_of,
                 pod_scheduling=self.cluster.pod_scheduling_fn(),
             )
-            return backlog, encoded
+            fairness = None
+            if self.tenancy is not None and self.tenancy.enabled:
+                with self.tracer.span(
+                    "scheduler.tenancy", gangs=len(encoded)
+                ):
+                    # count=False: a round can run this twice (pre_round
+                    # speculation + the fallback when the dispatch is not
+                    # adopted) but consumes one pass — _solve_backlog
+                    # counts the consumed stamps exactly once
+                    fairness = self.tenancy.annotate(
+                        backlog, encoded, snapshot, self.store, demand_fn,
+                        count=False,
+                    )
+            return backlog, encoded, fairness
 
     def pre_round(self) -> None:
         """Manager pre_round hook (runtime.run_once): when a backlog is
@@ -415,11 +449,20 @@ class GangScheduler:
             self._feed_free_journal(engine, snapshot)
             if getattr(engine, "dispatch", None) is None:
                 return  # custom engine without async support (tests)
-            backlog, encoded = self._fetch_and_encode(backlog_keys, snapshot)
-            dispatch = engine.dispatch(encoded, free=snapshot.free.copy())
+            backlog, encoded, fairness = self._fetch_and_encode(
+                backlog_keys, snapshot
+            )
+            kw = (
+                {"fairness": fairness}
+                if fairness is not None and self._fairness_dispatch_ok
+                else {}
+            )
+            dispatch = engine.dispatch(
+                encoded, free=snapshot.free.copy(), **kw
+            )
             if dispatch is not None:
                 self._pending = (seq0, backlog_keys, backlog, encoded,
-                                 dispatch)
+                                 dispatch, fairness)
                 sp.set(dispatched=True)
 
     def reconcile(self, request: Request) -> Result:
@@ -594,24 +637,37 @@ class GangScheduler:
             and self._dispatch_unaffected(pending[0])
         ):
             # nothing the dispatched scores depend on was written since
-            # pre_round: adopt its fetches + encode + in-flight device
-            # phase (engine.solve still verifies gang identity + free)
-            _, _, backlog, encoded, dispatch = pending
+            # pre_round: adopt its fetches + encode + tenancy annotation
+            # + in-flight device phase (engine.solve still verifies gang
+            # identity + free). The fairness vector is the DISPATCH-time
+            # one by construction: annotate() reads only store state, and
+            # _dispatch_unaffected proved none of it moved.
+            _, _, backlog, encoded, dispatch, fairness = pending
         else:
             if pending is not None:
                 pending[4].cancel()  # stale: stop in-flight RPC work
-            backlog, encoded = self._fetch_and_encode(
+            backlog, encoded, fairness = self._fetch_and_encode(
                 backlog_keys, snapshot
             )
+        if fairness is not None:
+            # exactly one annotate pass is consumed per solve round
+            # (adopted: pre_round's; else: the fallback's) — its stamped
+            # admission decisions feed the per-tenant counters here
+            self.tenancy.count_decisions(encoded)
         solver_by_name = {g.name: g for g in encoded}
         by_name = {g.metadata.name: g for g in backlog}
         solver_gangs = self._try_reserved(
             encoded, by_name, snapshot, free, engine
         )
+        kw = (
+            {"fairness": fairness}
+            if fairness is not None and self._fairness_solve_ok
+            else {}
+        )
         result = (
-            engine.solve(solver_gangs, free=free, dispatch=dispatch)
+            engine.solve(solver_gangs, free=free, dispatch=dispatch, **kw)
             if dispatch is not None
-            else engine.solve(solver_gangs, free=free)
+            else engine.solve(solver_gangs, free=free, **kw)
         )
         # counted AFTER the solve (engine.solve may still reject the
         # dispatch — e.g. _try_reserved bound a reservation, mutating
@@ -966,7 +1022,15 @@ class GangScheduler:
         domain — victims that cannot help are never disturbed. Preemptors
         claim the eviction budget in priority order; one attempt per
         preemptor per backlog stay (no thrash when the preemptor stays
-        infeasible for deeper reasons)."""
+        infeasible for deeper reasons).
+
+        Under tenancy (grove_tpu/tenancy), priority tiers ARE the
+        priority order (tier names resolve through PriorityClass), and a
+        tenant's per-round DISRUPTION BUDGET bounds how many of its gangs
+        the whole round may evict: a victim whose tenant's budget is
+        spent is skipped with a distinct "disruption-budget-exhausted"
+        audit outcome, and every audit entry names the victim's tenant —
+        the tenant arithmetic is first-class in the preemption record."""
         evictable: list[tuple[float, str, PodGang]] = []
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
@@ -995,6 +1059,14 @@ class GangScheduler:
         if not evictable:
             return 0
         evictable.sort(key=lambda t: (t[0], t[1]))  # cheapest victims first
+        tenancy = (
+            self.tenancy
+            if self.tenancy is not None and self.tenancy.enabled
+            else None
+        )
+        #: gangs evicted per victim tenant across THIS preemption round —
+        #: what the per-tenant disruption budget bounds
+        evicted_by_tenant: dict[str, int] = {}
         node_index = snapshot.node_index
         sched_free = np.where(snapshot.schedulable[:, None], free, 0.0)
         evicted_gangs = 0
@@ -1043,6 +1115,8 @@ class GangScheduler:
                 avail[int(dom)] = sched_free[sel].sum(axis=0)
             freed: dict[int, np.ndarray] = {}
             chosen: list[PodGang] = []
+            chosen_tenants: dict[str, int] = {}
+            budget_blocked = False
             #: audit trail for the decision log: every victim examined
             #: and why it was (not) disturbed
             considered: list[dict] = []
@@ -1051,6 +1125,32 @@ class GangScheduler:
             for vprio, vname, victim in evictable:
                 if vprio >= prio:
                     break  # sorted: no cheaper victims remain
+                entry = {
+                    "victim": f"{victim.metadata.namespace}/{vname}",
+                    "priority": vprio,
+                }
+                vtenant = (
+                    tenancy.tenant_of_gang(victim)
+                    if tenancy is not None else None
+                )
+                if vtenant is not None:
+                    # the audit names the victim's tenant: "whose capacity
+                    # was reclaimed" is the multi-tenant half of "why was
+                    # my gang preempted"
+                    entry["tenant"] = vtenant
+                considered.append(entry)
+                if vtenant is not None:
+                    budget = tenancy.disruption_budget(vtenant)
+                    if budget is not None and (
+                        evicted_by_tenant.get(vtenant, 0)
+                        + chosen_tenants.get(vtenant, 0)
+                    ) >= budget:
+                        # the tenant's per-round disruption budget is
+                        # spent: this victim is off the table no matter
+                        # how useful its capacity would be
+                        entry["outcome"] = "disruption-budget-exhausted"
+                        budget_blocked = True
+                        continue
                 contrib: dict[int, np.ndarray] = {}
                 for group in victim.spec.pod_groups:
                     for ref in group.pod_references:
@@ -1068,17 +1168,16 @@ class GangScheduler:
                         dom = int(dom_of[i])
                         cur = contrib.get(dom)
                         contrib[dom] = d if cur is None else cur + d
-                entry = {
-                    "victim": f"{victim.metadata.namespace}/{vname}",
-                    "priority": vprio,
-                }
-                considered.append(entry)
                 if not contrib:
                     # victim frees nothing the preemptor can use
                     entry["outcome"] = "frees-nothing-usable"
                     continue
                 entry["outcome"] = "chosen"
                 chosen.append(victim)
+                if vtenant is not None:
+                    chosen_tenants[vtenant] = (
+                        chosen_tenants.get(vtenant, 0) + 1
+                    )
                 for dom, vec in contrib.items():
                     cur = freed.get(dom)
                     freed[dom] = vec if cur is None else cur + vec
@@ -1108,16 +1207,29 @@ class GangScheduler:
                     if entry.get("outcome") == "chosen":
                         entry["outcome"] = "insufficient-even-with-victims"
                 if not chosen:
-                    note = "no victim frees usable capacity"
+                    # distinct note when the budget (not capacity
+                    # arithmetic) was the blocker — "your tenant spent
+                    # its disruption budget" is actionable, "no victim
+                    # helps" is not
+                    note = (
+                        "per-tenant disruption budgets exhausted before "
+                        "any usable victim"
+                        if budget_blocked
+                        else "no victim frees usable capacity"
+                    )
                 elif trial_failures:
                     note = ("exact trial placement failed with every "
                             "victim set")
                 else:
                     note = ("aggregate capacity never reached even with "
                             "every usable victim")
+                if budget_blocked and chosen:
+                    note += ("; per-tenant disruption budgets excluded "
+                             "further victims")
                 self._record_preemption(
                     pg, considered, evicted=[], satisfied=False,
                     trial_failures=trial_failures, note=note,
+                    tenancy=tenancy,
                 )
                 continue  # no victim set makes the preemptor feasible
             self._preempted_for.add(key)
@@ -1127,6 +1239,17 @@ class GangScheduler:
             ]
             for victim in chosen:
                 self._evict(victim, preemptor=name)
+                if tenancy is not None:
+                    vt = tenancy.tenant_of_gang(victim)
+                    if vt is not None:
+                        evicted_by_tenant[vt] = (
+                            evicted_by_tenant.get(vt, 0) + 1
+                        )
+                        self.metrics.counter(
+                            "grove_tenant_preemption_evictions_total",
+                            "gangs evicted by preemption per victim "
+                            "tenant",
+                        ).inc(tenant=vt)
             evicted_gangs += len(chosen)
             self._record_preemption(
                 pg, considered,
@@ -1135,22 +1258,28 @@ class GangScheduler:
                     for v in chosen
                 ],
                 satisfied=True, trial_failures=trial_failures,
+                tenancy=tenancy,
             )
         return evicted_gangs
 
     def _record_preemption(self, pg: PodGang, considered, evicted,
                            satisfied: bool, trial_failures: int,
-                           note: str | None = None) -> None:
+                           note: str | None = None,
+                           tenancy=None) -> None:
         """Attach one preemption attempt (victims considered, why
         rejected candidates were rejected, the eviction outcome) to the
         preemptor's latest decision record — the audit half of "why is my
-        gang still pending after preemption ran"."""
+        gang still pending after preemption ran". Under tenancy the
+        record carries the preemptor's tenant next to the per-victim
+        tenants in `considered`."""
         info = {
             "considered": considered,
             "evicted": evicted,
             "satisfied": satisfied,
             "trial_failures": trial_failures,
         }
+        if tenancy is not None:
+            info["preemptor_tenant"] = tenancy.tenant_of_gang(pg)
         if note:
             info["note"] = note
         self.cluster.decisions.attach_preemption(

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..solver.problem import SolverGang
 from ..solver.result import GangPlacement, SolveResult
-from ..solver.serial import gang_sort_key
+from ..solver.serial import gang_sort_key, stamp_fairness
 from ..topology.encoding import TopologySnapshot
 from .build import load_library
 
@@ -129,13 +129,17 @@ def solve_serial_native(
     snapshot: TopologySnapshot,
     gangs: list[SolverGang],
     free: np.ndarray | None = None,
+    fairness: dict[str, float] | None = None,
 ) -> SolveResult | None:
     """Returns None when the native library is unavailable (no toolchain)
     — callers then fall back to the Python serial path, the semantic
-    reference."""
+    reference. `fairness` ({gang name: tenant DRF weight}) refines the
+    host-side commit order within equal priority (gang_sort_key); the C++
+    core itself is order-taking, so it needs no fairness plumbing."""
     lib = load_library()
     if lib is None:
         return None
+    stamp_fairness(gangs, fairness)
     t0 = time.perf_counter()
     result = SolveResult()
     solvable = []

@@ -107,6 +107,11 @@ def harness_dump(harness) -> dict[str, Any]:
         out["node_lifecycle"] = monitor.debug_state()
     out["tracing"] = tracing_dump(harness.cluster)
     out["explain"] = explain_dump(harness.cluster)
+    tenancy = getattr(harness.cluster, "tenancy", None)
+    if tenancy is not None and tenancy.enabled:
+        # the tenant-queue arithmetic behind admission/fairness decisions
+        # (grove_tpu/tenancy): shares, entitlements, deficits, budgets
+        out["tenancy"] = tenancy.debug_state()
     return out
 
 

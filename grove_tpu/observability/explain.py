@@ -79,6 +79,10 @@ class UnsatCode(str, Enum):
     #: all of them — per-node fragmentation, co-location constraint
     #: groups, or contention with higher-priority gangs in the same solve
     CONFLICT = "PlacementConflict"
+    #: tenant admission shed the gang: its tenant queue (or an ancestor
+    #: queue) would exceed its burst quota — load shedding, not a
+    #: capacity problem of the cluster (grove_tpu/tenancy)
+    QUOTA = "QuotaExceeded"
     #: the legacy magic string from a custom/older engine (kept
     #: preemption-eligible so external engines retain old behavior)
     NO_FEASIBLE_DOMAIN = "NoFeasibleDomain"
@@ -88,6 +92,9 @@ class UnsatCode(str, Enum):
 #: capacity. UNRESOLVED_LEVEL is a topology hold (evicting anything cannot
 #: materialize a missing label key), so it is excluded — the same rule the
 #: scheduler previously expressed by string-matching "no feasible domain".
+#: QUOTA is excluded too: a shed gang is over its own tenant's quota, and
+#: evicting other tenants' work cannot lower that tenant's usage of it —
+#: preemption on a shed gang would just destroy victims for nothing.
 PREEMPTIBLE_CODES = frozenset(
     (
         UnsatCode.CAPACITY,
@@ -623,7 +630,15 @@ def render_verdict(entry: dict) -> str:
         if detail.get("message"):
             lines.append(f"  {detail['message']}")
         funnel = detail.get("funnel")
-        if funnel:
+        if funnel and "quota" in funnel:
+            q = funnel["quota"]
+            lines.append(
+                f"  quota: tenant {q.get('tenant', '?')} queue "
+                f"{q.get('queue', '?')} over {q.get('band', '?')} on "
+                f"{q.get('resource', '?')} (usage {q.get('usage', 0):g} + "
+                f"demand {q.get('demand', 0):g} > limit {q.get('limit', 0):g})"
+            )
+        elif funnel:
             cut = funnel.get("cut", {})
             lines.append(
                 f"  funnel: {funnel.get('domains_total', '?')} domains"

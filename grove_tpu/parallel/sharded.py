@@ -104,6 +104,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
             P("gangs"),          # required_level [G]
             P("gangs"),          # preferred_level[G]
             P("gangs"),          # valid       [G]
+            P("gangs"),          # fairness    [G]
             P(),                 # cap_scale   [R]
         ),
         out_specs=(P("gangs", None), P()),  # value [G, D], dom_free [D, R]
@@ -111,7 +112,7 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
     )
     def score(free, gdom, dom_level, total_demand, u_sig_demand,
               u_sig_mask, elig_masks, sig_idx, required_level,
-              preferred_level, valid, cap_scale):
+              preferred_level, valid, fairness, cap_scale):
         m = membership_matrix(gdom, num_domains)             # [Nl, D]
         dom_free = jax.lax.psum(m.T @ free, "nodes")         # [D, R]
         node_fits = jnp.all(
@@ -122,18 +123,18 @@ def sharded_score_fn(mesh: Mesh, num_domains: int, top_k: int,
         ].min(axis=1)                                        # [Gl, D]
         value_l = value_from_aggregates(
             dom_free, cnt_fit, dom_level, total_demand, required_level,
-            preferred_level, valid, cap_scale,
+            preferred_level, valid, cap_scale, fairness,
         )                                                    # [Gl, D]
         return value_l, dom_free
 
     @jax.jit
     def fn(free, gdom, dom_level, anc_ids, total_demand, u_sig_demand,
            u_sig_mask, elig_masks, sig_idx, required_level, preferred_level,
-           valid, cap_scale):
+           valid, fairness, cap_scale):
         value, dom_free = score(
             free, gdom, dom_level, total_demand, u_sig_demand, u_sig_mask,
             elig_masks, sig_idx, required_level, preferred_level, valid,
-            cap_scale,
+            fairness, cap_scale,
         )
         return commit_scan(value, dom_free, anc_ids, total_demand,
                            top_k, chunk)
@@ -214,7 +215,7 @@ class ShardedPlacementEngine(PlacementEngine):
         return _scatter_rows(dev, upd_dev)
 
     def _device_begin(self, total_demand, sig, required_level,
-                      preferred_level, valid, cap_scale):
+                      preferred_level, valid, fairness, cap_scale):
         if self._state.dev is None:
             raise RuntimeError(
                 "device free state not synced: _device_begin requires a "
@@ -243,6 +244,7 @@ class ShardedPlacementEngine(PlacementEngine):
             pad_g(required_level),
             pad_g(preferred_level),
             pad_g(valid),
+            pad_g(fairness),
         )
         # dummy node columns get mask 0 (ineligible); they carry zero
         # free capacity anyway, but a zero-demand signature row would
@@ -267,6 +269,7 @@ class ShardedPlacementEngine(PlacementEngine):
             gang_inputs[4],
             gang_inputs[5],
             gang_inputs[6],
+            gang_inputs[7],
             cap_scale,
         )
         top_val.copy_to_host_async()

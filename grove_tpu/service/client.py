@@ -142,7 +142,7 @@ class RemotePlacementEngine:
             )
 
     def dispatch(
-        self, gangs, free: np.ndarray | None = None
+        self, gangs, free: np.ndarray | None = None, fairness=None
     ) -> RemoteSolveDispatch | None:
         """Begin the Solve RPC asynchronously (gRPC future): the server
         solves and the response streams back while the caller does host
@@ -153,7 +153,10 @@ class RemotePlacementEngine:
         fresh path carries the re-Sync / re-channel recovery)."""
         import time
 
+        from ..solver.serial import stamp_fairness
+
         t0 = time.perf_counter()
+        stamp_fairness(gangs, fairness)
         if free is None:
             free = self.snapshot.free.copy()
         if not gangs:
@@ -172,11 +175,17 @@ class RemotePlacementEngine:
         )
 
     def solve(
-        self, gangs, free: np.ndarray | None = None, dispatch=None
+        self, gangs, free: np.ndarray | None = None, dispatch=None,
+        fairness=None,
     ) -> SolveResult:
         import time
 
+        from ..solver.serial import stamp_fairness
+
         t0 = time.perf_counter()
+        # stamped client-side: the codec ships the per-gang field, so the
+        # server's sort sees the same tenant ordering as a local engine
+        stamp_fairness(gangs, fairness)
         if free is None:
             free = self.snapshot.free.copy()
         # Try to adopt an in-flight dispatch; a rejected one is CANCELLED

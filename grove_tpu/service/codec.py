@@ -98,6 +98,10 @@ def encode_solve_request(
             "required_level": g.required_level,
             "preferred_level": g.preferred_level,
             "priority": g.priority,
+            # tenant DRF weight (grove_tpu/tenancy): a remote solve must
+            # keep the client's fairness ordering or multi-tenant
+            # contention resolves differently across the service boundary
+            "fairness": getattr(g, "fairness", 0.0),
             "constraint_groups": [
                 [list(members), req, pref]
                 for members, req, pref in g.constraint_groups
@@ -172,6 +176,8 @@ def decode_solve_request(
             required_level=int(meta["required_level"]),
             preferred_level=int(meta["preferred_level"]),
             priority=float(meta["priority"]),
+            # absent on requests from older clients: no tenant ordering
+            fairness=float(meta.get("fairness", 0.0)),
             constraint_groups=[
                 (list(m), int(r), int(p))
                 for m, r, p in meta["constraint_groups"]

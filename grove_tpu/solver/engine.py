@@ -61,7 +61,7 @@ from ..topology.encoding import TopologySnapshot
 from .fit import place_gang_in_domain, placement_score_for_nodes
 from .problem import SolverGang
 from .result import GangPlacement, SolveResult
-from .serial import _place_one, gang_sort_key
+from .serial import _place_one, gang_sort_key, stamp_fairness
 
 _NEG = -1e9
 
@@ -141,11 +141,20 @@ def value_from_aggregates(
     preferred_level, # i32 [G]
     valid,           # bool [G]
     cap_scale,       # f32 [R]
+    fairness=None,   # f32 [G] per-gang tenant fairness weight (or None)
 ):
     """value[G, D]: pack narrowness dominates (it IS the placement score),
     then a bonus for satisfying the preferred level, minus normalized slack
     so tight domains win ties (best-fit at domain granularity). Rows/pairs
-    that are statically infeasible or hierarchy-violating get _NEG."""
+    that are statically infeasible or hierarchy-violating get _NEG.
+
+    `fairness` is the tenant DRF column (grove_tpu/tenancy): a constant
+    per-GANG offset on the gang's whole feasible row. Per-row constancy is
+    deliberate — it cannot perturb the gang's own domain ranking (pack
+    narrowness stays lexicographically dominant), while the row ORDER of
+    the commit scan (gang_sort_key: priority, then fairness) is where the
+    weight resolves cross-gang contention; the tensor column keeps the
+    reported values/alternates carrying the tenant arithmetic."""
     # Hierarchy mask: gangs may only use domains at least as narrow as their
     # required level; the root (-1) only when unconstrained.
     allowed = dom_level[None, :] >= required_level[:, None]
@@ -164,6 +173,8 @@ def value_from_aggregates(
         slack = cur if slack is None else jnp.maximum(slack, cur)
     slack = slack / (1.0 + jnp.abs(slack))  # squash: ordering, not magnitude
     value = level_score[None, :] + 1.0 * pref_bonus - 0.5 * slack
+    if fairness is not None:
+        value = value + fairness[:, None]
     static_mask = (cnt_fit >= 1.0) & allowed & valid[:, None]
     return jnp.where(static_mask, value, _NEG)
 
@@ -242,13 +253,13 @@ def _device_score(
     dom_level,       # i32 [D]               (device-resident static)
     anc_ids,         # i32 [D, L+1] ancestors(device-resident static)
     io_pack,         # f32 1D fused per-solve input buffer: gang_pack
-                     #   [G, R+3+S] (total_demand | required_level |
-                     #   preferred_level | valid | sig_idx) followed by
-                     #   u_pack [U, R+1] (unique signature max-pod demand
-                     #   rows | eligibility-mask row index). ONE buffer:
-                     #   each separate H2D transfer pays the dev tunnel's
-                     #   fixed latency, and the reshape/slices below are
-                     #   free under XLA fusion.
+                     #   [G, R+4+S] (total_demand | required_level |
+                     #   preferred_level | valid | fairness | sig_idx)
+                     #   followed by u_pack [U, R+1] (unique signature
+                     #   max-pod demand rows | eligibility-mask row
+                     #   index). ONE buffer: each separate H2D transfer
+                     #   pays the dev tunnel's fixed latency, and the
+                     #   reshape/slices below are free under XLA fusion.
     elig_masks,      # f32 [M, N] node-eligibility masks (row 0 = all ones)
     cap_scale,       # f32 [R]               (device-resident static)
     *,
@@ -261,14 +272,15 @@ def _device_score(
     sig_width: int,
 ):
     r = num_res
-    gw = r + 3 + sig_width
+    gw = r + 4 + sig_width
     gang_pack = io_pack[: num_gangs * gw].reshape(num_gangs, gw)
     u_pack = io_pack[num_gangs * gw :].reshape(num_sigs, r + 1)
     total_demand = gang_pack[:, :r]
     required_level = gang_pack[:, r].astype(jnp.int32)
     preferred_level = gang_pack[:, r + 1].astype(jnp.int32)
     valid = gang_pack[:, r + 2] > 0.5
-    sig_idx = gang_pack[:, r + 3:].astype(jnp.int32)        # [G, S]
+    fairness = gang_pack[:, r + 3]                          # [G]
+    sig_idx = gang_pack[:, r + 4:].astype(jnp.int32)        # [G, S]
     u_sig_demand = u_pack[:, :r]
     u_sig_mask = u_pack[:, r].astype(jnp.int32)
     m = membership_matrix(gdom, num_domains)
@@ -286,7 +298,7 @@ def _device_score(
     cnt_fit = (node_fits @ m)[sig_idx].min(axis=1)          # [G, D]
     value = value_from_aggregates(
         dom_free, cnt_fit, dom_level, total_demand, required_level,
-        preferred_level, valid, cap_scale,
+        preferred_level, valid, cap_scale, fairness,
     )
     top_val, top_dom = commit_scan(
         value, dom_free, anc_ids, total_demand, top_k, chunk
@@ -684,16 +696,20 @@ class PlacementEngine:
         required_level = np.full((g_pad,), -1, dtype=np.int32)
         preferred_level = np.full((g_pad,), -1, dtype=np.int32)
         valid = np.zeros((g_pad,), dtype=bool)
+        fairness = np.zeros((g_pad,), dtype=np.float32)
         for i, g in enumerate(order):
             total_demand[i] = g.total_demand()
             required_level[i] = g.required_level
             preferred_level[i] = g.preferred_level
             valid[i] = True
+            fairness[i] = getattr(g, "fairness", 0.0)
         sig = self._gang_signatures(order, g_pad, snapshot.num_nodes, r)
-        return (total_demand, sig, required_level, preferred_level, valid)
+        return (total_demand, sig, required_level, preferred_level, valid,
+                fairness)
 
     def dispatch(
-        self, gangs: list[SolverGang], free: np.ndarray | None = None
+        self, gangs: list[SolverGang], free: np.ndarray | None = None,
+        fairness: dict[str, float] | None = None,
     ) -> SolveDispatch | None:
         """Begin the device phase asynchronously and return a handle that
         a later solve(..., dispatch=handle) can adopt, overlapping device
@@ -705,8 +721,12 @@ class PlacementEngine:
         consuming solve — solve() verifies the gang list by identity and
         free-matrix currency by the device-state epoch (content compare
         when the state cache is off), and falls back to a fresh solve
-        when either changed (stale scores are never adopted silently)."""
+        when either changed (stale scores are never adopted silently).
+        `fairness` must be the same vector the consuming solve passes (or
+        already stamped on the gangs): a changed weight changes the sort
+        order and the adoption guard correctly rejects the handle."""
         t0 = time.perf_counter()
+        stamp_fairness(gangs, fairness)
         if free is None:
             free = self.snapshot.free.copy()
         solvable = [g for g in gangs if not g.unschedulable_reason]
@@ -771,8 +791,10 @@ class PlacementEngine:
         gangs: list[SolverGang],
         free: np.ndarray | None = None,
         dispatch: SolveDispatch | None = None,
+        fairness: dict[str, float] | None = None,
     ) -> SolveResult:
         t0 = time.perf_counter()
+        stamp_fairness(gangs, fairness)
         snapshot = self.snapshot
         if free is None:
             free = snapshot.free.copy()
@@ -1006,12 +1028,12 @@ class PlacementEngine:
         return u_sig_demand, u_sig_mask, elig_masks, sig_idx
 
     def _device_phase(self, total_demand, sig, required_level,
-                      preferred_level, valid, cap_scale):
+                      preferred_level, valid, fairness, cap_scale):
         """Blocking device scoring: begin + end in one call."""
         return self._device_end(
             self._device_begin(
                 total_demand, sig, required_level, preferred_level, valid,
-                cap_scale,
+                fairness, cap_scale,
             )
         )
 
@@ -1046,7 +1068,7 @@ class PlacementEngine:
         return dev
 
     def _device_begin(self, total_demand, sig, required_level,
-                      preferred_level, valid, cap_scale):
+                      preferred_level, valid, fairness, cap_scale):
         """Dispatch device scoring, returning the in-flight packed result
         (ShardedPlacementEngine overrides begin/end with the mesh-SPMD
         version, grove_tpu/parallel/sharded.py). `sig` is the
@@ -1081,14 +1103,15 @@ class PlacementEngine:
         g_pad, r = total_demand.shape
         s_pad = sig_idx.shape[1]
         u_pad = u_sig_demand.shape[0]
-        gw = r + 3 + s_pad
+        gw = r + 4 + s_pad
         io = np.empty((g_pad * gw + u_pad * (r + 1),), np.float32)
         gp = io[: g_pad * gw].reshape(g_pad, gw)
         gp[:, :r] = total_demand
         gp[:, r] = required_level
         gp[:, r + 1] = preferred_level
         gp[:, r + 2] = valid
-        gp[:, r + 3:] = sig_idx
+        gp[:, r + 3] = fairness
+        gp[:, r + 4:] = sig_idx
         up = io[g_pad * gw:].reshape(u_pad, r + 1)
         up[:, :r] = u_sig_demand
         up[:, r] = u_sig_mask

@@ -41,6 +41,13 @@ class SolverGang:
     required_level: int = -1
     preferred_level: int = -1
     priority: float = 0.0
+    # Tenant fairness weight (grove_tpu/tenancy): orders gangs of EQUAL
+    # priority in every solve path's commit order (gang_sort_key) and
+    # rides the batched cost tensor as an extra weighted column. 0.0 =
+    # no tenant arbitration (the default for every non-tenant workload).
+    # Stamped by TenancyManager.annotate, or by a solve's `fairness=`
+    # kwarg (engine.solve/dispatch, solve_serial, solve_serial_native).
+    fairness: float = 0.0
     # Constraint groups spanning subsets of groups (PCSG co-location inside a
     # base gang, podgang.go:121-132): (member group indices, required_level,
     # preferred_level).

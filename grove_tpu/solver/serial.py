@@ -32,21 +32,46 @@ from .result import GangPlacement, SolveResult
 
 
 def gang_sort_key(g: SolverGang):
-    """Deterministic scheduling order: priority desc, then name."""
-    return (-g.priority, g.name)
+    """Deterministic scheduling order: priority desc, tenant fairness
+    weight desc (the DRF term — under-served tenants win contention at
+    equal priority; 0.0 for every non-tenant gang, so workloads without
+    tenancy keep the exact pre-fairness order), then name."""
+    return (-g.priority, -getattr(g, "fairness", 0.0), g.name)
+
+
+def stamp_fairness(gangs: list[SolverGang], fairness) -> None:
+    """Apply a fairness-weight vector onto the gangs — the shared
+    injection point of every solve path's `fairness=` kwarg
+    (engine.solve/dispatch, solve_serial, solve_serial_native). Keys are
+    namespace-qualified "namespace/name" (what TenancyManager.annotate
+    emits — same-named gangs in two tenants' namespaces must not share a
+    weight) with bare gang names accepted as a fallback for direct
+    single-namespace callers. Missing gangs keep their current stamp (a
+    partial vector is additive, not a reset)."""
+    if not fairness:
+        return
+    for g in gangs:
+        w = fairness.get(f"{g.namespace}/{g.name}")
+        if w is None:
+            w = fairness.get(g.name)
+        if w is not None:
+            g.fairness = float(w)
 
 
 def solve_serial(
     snapshot: TopologySnapshot,
     gangs: list[SolverGang],
     free: np.ndarray | None = None,
+    fairness: dict[str, float] | None = None,
 ) -> SolveResult:
     """Place gangs serially against (a copy of) the snapshot's free capacity.
 
     Passing `free` lets callers thread committed state across calls; it is
-    mutated in place as gangs commit.
+    mutated in place as gangs commit. `fairness` ({gang name: weight},
+    see gang_sort_key) refines the commit order within equal priority.
     """
     t0 = time.perf_counter()
+    stamp_fairness(gangs, fairness)
     if free is None:
         free = snapshot.free.copy()
     sched_nodes = np.flatnonzero(snapshot.schedulable)

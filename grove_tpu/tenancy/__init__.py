@@ -1,0 +1,15 @@
+"""Multi-tenant scheduling: hierarchical queues, quota, DRF fairness.
+
+See queues.py for the model; api.config.TenancyConfig for the knobs;
+docs/scheduling.md "Multi-tenancy" for the user story.
+"""
+
+from .queues import ADMIT, QUEUE, SHED, TenancyManager, TenantQueue
+
+__all__ = [
+    "ADMIT",
+    "QUEUE",
+    "SHED",
+    "TenancyManager",
+    "TenantQueue",
+]

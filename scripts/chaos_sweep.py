@@ -90,15 +90,37 @@ def sweep_workload():
     )
 
 
+#: tenancy config for --tenant-skew sweeps: two tenants with tight burst
+#: ceilings so the injected skew bursts actually cross the admission
+#: bands (some of the load sheds with QuotaExceeded and must recover to
+#: the fault-free fixpoint once the skew leaves at disarm)
+TENANT_SKEW_CONFIG = {
+    "tenancy": {
+        "enabled": True,
+        "tenants": [
+            {"name": "skew-a", "guaranteed": {"cpu": 2.0},
+             "burst": {"cpu": 6.0}},
+            {"name": "skew-b", "guaranteed": {"cpu": 2.0},
+             "burst": {"cpu": 6.0}},
+        ],
+    }
+}
+
+
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
-             explain_dir: Path | None = None) -> dict:
-    plan = FaultPlan.from_seed(seed)
+             explain_dir: Path | None = None,
+             tenant_skew: bool = False) -> dict:
+    overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    plan = FaultPlan.from_seed(seed, **overrides)
     trace_path = (
         str(trace_dir / f"seed-{seed}-flight.json")
         if trace_dir is not None else None
     )
-    ch = ChaosHarness(plan, nodes=make_nodes(nodes), trace_path=trace_path)
+    ch = ChaosHarness(
+        plan, nodes=make_nodes(nodes), trace_path=trace_path,
+        config=TENANT_SKEW_CONFIG if tenant_skew else None,
+    )
     # silence the expected fault-storm error logs (with_name children
     # copy the stream at creation, so the manager's logger needs its own
     # reassignment; restarted managers inherit the cluster logger's)
@@ -173,6 +195,15 @@ def main(argv=None) -> int:
                          "every seed that settles with unscheduled "
                          "gangs; render with python -m "
                          "grove_tpu.observability.explain")
+    ap.add_argument("--tenant-skew", dest="tenant_skew",
+                    action="store_true",
+                    help="enable tenant-skew load faults: tenancy "
+                         "(quota admission + DRF fairness) is configured "
+                         "with two tight-burst tenants, and seeded skew "
+                         "bursts land in one tenant's namespace per "
+                         "fault (some shed with QuotaExceeded); the "
+                         "skew leaves at disarm, so convergence is "
+                         "checked against the same fault-free fixpoint")
     args = ap.parse_args(argv)
     trace_dir = None
     if args.trace_dir:
@@ -183,7 +214,12 @@ def main(argv=None) -> int:
         explain_dir = Path(args.explain_dir)
         explain_dir.mkdir(parents=True, exist_ok=True)
 
-    baseline_h = Harness(nodes=make_nodes(args.nodes))
+    # the baseline fixpoint must be computed under the SAME config the
+    # chaos runs use (tenancy changes PodGang defaulting)
+    baseline_h = Harness(
+        nodes=make_nodes(args.nodes),
+        config=TENANT_SKEW_CONFIG if args.tenant_skew else None,
+    )
     baseline_h.apply(sweep_workload())
     baseline_h.settle()
     baseline = settled_fingerprint(baseline_h.store)
@@ -192,7 +228,8 @@ def main(argv=None) -> int:
     failed = []
     for seed in range(args.start, args.start + args.seeds):
         result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir,
-                          explain_dir=explain_dir)
+                          explain_dir=explain_dir,
+                          tenant_skew=args.tenant_skew)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:

@@ -1,0 +1,646 @@
+"""Multi-tenant scheduling (grove_tpu/tenancy): TenancyConfig
+validation, admission bands (admit/queue/shed) over the queue hierarchy,
+DRF shares + fairness ordering in every solve path, QuotaExceeded
+surfaces (conditions, metrics, decision log, render), PodGang tier
+validation/defaulting, per-tenant metric-series hygiene, preemption
+under priority tiers with disruption budgets, and tenant-skew chaos."""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api import constants
+from grove_tpu.api.config import load_operator_config
+from grove_tpu.api.meta import ObjectMeta, get_condition
+from grove_tpu.api.podgang import PodGang, PodGangConditionType, PodGangSpec
+from grove_tpu.api.validation import ValidationError
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability.explain import (
+    UnsatCode,
+    render_verdict,
+    unsat_code,
+    unsat_preemptible,
+)
+from grove_tpu.solver import PlacementEngine, solve_serial
+from grove_tpu.tenancy import ADMIT, QUEUE, SHED, TenancyManager
+
+from test_e2e_basic import clique, simple_pcs
+from test_solver import cluster, gang
+
+RETRY = constants.COMPONENT_SYNC_RETRY_INTERVAL_SECONDS + 0.1
+
+
+def tenancy_cfg(tenants, **kw):
+    base = {"enabled": True, "tenants": tenants}
+    base.update(kw)
+    return load_operator_config({"tenancy": base}).tenancy
+
+
+def labeled_pcs(name, tenant, cliques=None, **kw):
+    pcs = simple_pcs(name=name, cliques=cliques, **kw)
+    pcs.metadata.labels[constants.LABEL_TENANT] = tenant
+    return pcs
+
+
+# -- config validation --------------------------------------------------------
+
+class TestTenancyConfig:
+    def test_valid_config_loads(self):
+        cfg = tenancy_cfg([
+            {"name": "a", "guaranteed": {"cpu": 8.0},
+             "burst": {"cpu": 16.0}, "weight": 2.0, "tier": "high"},
+            {"name": "b", "parent": "a", "disruption_budget": 1},
+        ])
+        assert cfg.enabled
+        assert [t["name"] for t in cfg.tenants] == ["a", "b"]
+
+    @pytest.mark.parametrize("tenants,needle", [
+        ([{"name": "a", "guaranteed": {"cpu": 8.0},
+           "burst": {"cpu": 4.0}}], "burst"),
+        ([{"name": "a", "parent": "ghost"}], "unknown tenant"),
+        ([{"name": "a", "parent": "b"}, {"name": "b", "parent": "a"}],
+         "cycle"),
+        ([{"name": "a", "tier": "platinum"}], "unknown tier"),
+        ([{"name": "a"}, {"name": "a"}], "duplicate tenant"),
+        ([{"name": "a", "weight": 0}], "weight"),
+        ([{"name": "a", "surprise": 1}], "unknown field"),
+        ([{"name": "a", "disruption_budget": -1}], "disruption_budget"),
+    ])
+    def test_invalid_configs_rejected(self, tenants, needle):
+        with pytest.raises(ValidationError) as err:
+            tenancy_cfg(tenants)
+        assert needle in str(err.value)
+
+    def test_default_tier_must_exist(self):
+        with pytest.raises(ValidationError) as err:
+            tenancy_cfg([], default_tier="ghost")
+        assert "default_tier" in str(err.value)
+
+    def test_empty_tiers_rejected_when_enabled(self):
+        # review regression: enabled + tiers [] would wedge every PodGang
+        # create (defaulting stamps default_tier, admission rejects it)
+        with pytest.raises(ValidationError) as err:
+            tenancy_cfg([{"name": "a"}], tiers=[])
+        assert "tiers" in str(err.value)
+        # disabled configs may leave tiers empty
+        load_operator_config({"tenancy": {"enabled": False, "tiers": []}})
+
+    def test_disabled_default_validates(self):
+        cfg = load_operator_config(None)
+        assert cfg.tenancy.enabled is False
+
+
+# -- admission bands ----------------------------------------------------------
+
+class TestAdmission:
+    def mgr(self, tenants, **kw):
+        return TenancyManager(tenancy_cfg(tenants, **kw))
+
+    def test_bands(self):
+        m = self.mgr([{"name": "a", "guaranteed": {"cpu": 8.0},
+                       "burst": {"cpu": 16.0}}])
+        q = m.queues["a"]
+        q.usage = np.zeros(1)
+        res = ["cpu"]
+        assert m.classify("a", np.array([8.0]), res)[0] == ADMIT
+        assert m.classify("a", np.array([12.0]), res)[0] == QUEUE
+        decision, detail = m.classify("a", np.array([20.0]), res)
+        assert decision == SHED
+        assert detail["resource"] == "cpu" and detail["limit"] == 16.0
+
+    def test_absent_burst_is_unlimited_absent_guarantee_is_zero(self):
+        m = self.mgr([{"name": "a"}])
+        m.queues["a"].usage = np.zeros(1)
+        # no guarantee -> anything is burst band; no ceiling -> never shed
+        assert m.classify("a", np.array([1e9]), ["cpu"])[0] == QUEUE
+
+    def test_ancestor_ceiling_binds_child(self):
+        m = self.mgr([
+            {"name": "root", "burst": {"cpu": 10.0}},
+            {"name": "leaf", "parent": "root", "burst": {"cpu": 100.0}},
+        ])
+        for q in m.queues.values():
+            q.usage = np.zeros(1)
+        m.queues["root"].usage[0] = 8.0  # subtree total
+        decision, detail = m.classify("leaf", np.array([4.0]), ["cpu"])
+        assert decision == SHED and detail["queue"] == "root"
+
+    def test_exempt_tenant_admits(self):
+        m = self.mgr([{"name": "a"}])
+        assert m.tenant_of("elsewhere", {}) is None
+        assert m.classify(None, np.array([1e9]), ["cpu"])[0] == ADMIT
+
+    def test_attribution_label_beats_namespace(self):
+        m = self.mgr([{"name": "a"}, {"name": "b"}])
+        assert m.tenant_of("b", {constants.LABEL_TENANT: "a"}) == "a"
+        assert m.tenant_of("b", {}) == "b"
+        assert m.tenant_of("nope", {}) is None
+
+    def test_default_tenant_catches_unmatched(self):
+        m = self.mgr([{"name": "shared"}], default_tenant="shared")
+        assert m.tenant_of("anything", {}) == "shared"
+
+
+# -- fairness in the solve paths ---------------------------------------------
+
+class TestFairnessOrdering:
+    def one_slot_snap(self):
+        # a single node with room for exactly one 2-pod gang
+        return cluster(blocks=1, racks=1, hosts=1, cpu=2.0)
+
+    def gangs(self):
+        return [gang("a", pods=2, cpu=1.0), gang("b", pods=2, cpu=1.0)]
+
+    def test_serial_fairness_breaks_the_tie(self):
+        snap = self.one_slot_snap()
+        res = solve_serial(snap, self.gangs(),
+                           fairness={"a": 0.0, "b": 1.0})
+        assert "b" in res.placed and "a" in res.unplaced
+        res = solve_serial(snap, self.gangs(),
+                           fairness={"a": 1.0, "b": 0.0})
+        assert "a" in res.placed and "b" in res.unplaced
+
+    def test_priority_still_dominates_fairness(self):
+        snap = self.one_slot_snap()
+        gs = self.gangs()
+        gs[0].priority = 10.0
+        res = solve_serial(snap, gs, fairness={"a": 0.0, "b": 100.0})
+        assert "a" in res.placed
+
+    def test_engine_fairness_matches_serial(self):
+        snap = self.one_slot_snap()
+        engine = PlacementEngine(snap)
+        res = engine.solve(self.gangs(), fairness={"a": 0.0, "b": 1.0})
+        assert "b" in res.placed and "a" in res.unplaced
+
+    def test_native_solve_takes_fairness(self):
+        from grove_tpu.native import solve_serial_native
+
+        snap = self.one_slot_snap()
+        res = solve_serial_native(snap, self.gangs(),
+                                  fairness={"a": 0.0, "b": 1.0})
+        if res is None:
+            pytest.skip("native library unavailable")
+        assert "b" in res.placed and "a" in res.unplaced
+
+    def test_codec_ships_fairness(self):
+        from grove_tpu.service import codec
+
+        snap = self.one_slot_snap()
+        gs = self.gangs()
+        gs[1].fairness = 0.75
+        data = codec.encode_solve_request("e", gs, snap.free.copy())
+        _, back, _ = codec.decode_solve_request(data)
+        assert back[1].fairness == 0.75
+        assert back[0].fairness == 0.0
+
+
+# -- the QuotaExceeded surfaces ----------------------------------------------
+
+def quota_harness(tenants, nodes=8, **cfg_kw):
+    return Harness(
+        nodes=make_nodes(nodes, racks_per_block=2, hosts_per_rack=2),
+        config={"tenancy": dict(
+            {"enabled": True, "tenants": tenants}, **cfg_kw)},
+    )
+
+
+class TestQuotaShedding:
+    def test_shed_carries_quota_exceeded_everywhere(self):
+        # guarantee 1 gang (2 pods x 1 cpu), burst-cap at 2 gangs
+        h = quota_harness([{"name": "t1", "guaranteed": {"cpu": 2.0},
+                            "burst": {"cpu": 4.0}}])
+        for i in range(3):
+            h.apply(labeled_pcs(f"w{i}", "t1",
+                                cliques=[clique("w", replicas=2)]))
+        h.settle()
+        gangs = {g.metadata.name: g for g in h.store.scan(PodGang.KIND)}
+        sched = {
+            name: get_condition(
+                g.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            for name, g in gangs.items()
+        }
+        shed = [n for n, c in sched.items()
+                if c is not None and c.status == "False"]
+        assert len(shed) == 1
+        cond = sched[shed[0]]
+        assert cond.reason == "QuotaExceeded"
+        assert "over quota" in cond.message
+        # metric attribution
+        m = h.cluster.metrics
+        assert m.counter("grove_scheduler_unplaced_total").value(
+            reason="QuotaExceeded") >= 1
+        assert m.counter("grove_tenant_gangs_shed_total").value(
+            tenant="t1") >= 1
+        # decision log carries the quota funnel; the verdict renders it
+        ex = h.cluster.decisions.explain("default", shed[0])
+        rec = ex["records"][-1]
+        assert rec["detail"]["code"] == "QuotaExceeded"
+        quota = rec["detail"]["funnel"]["quota"]
+        assert quota["tenant"] == "t1" and quota["resource"] == "cpu"
+        text = render_verdict(ex)
+        assert "QuotaExceeded" in text and "quota:" in text
+
+    def test_shed_gang_readmits_when_usage_drops(self):
+        h = quota_harness([{"name": "t1", "burst": {"cpu": 4.0}}])
+        for i in range(3):
+            h.apply(labeled_pcs(f"w{i}", "t1",
+                                cliques=[clique("w", replicas=2)]))
+        h.settle()
+
+        def shed_names():
+            out = []
+            for g in h.store.scan(PodGang.KIND):
+                c = get_condition(
+                    g.status.conditions,
+                    PodGangConditionType.SCHEDULED.value,
+                )
+                if c is not None and c.status == "False":
+                    out.append(g.metadata.name)
+            return out
+
+        shed = shed_names()
+        assert len(shed) == 1
+        # a bound workload leaves -> usage drops below the ceiling ->
+        # the shed gang re-admits on its retry tick, no extra events
+        victim = next(
+            n for n in ("w0", "w1", "w2") if f"{n}-0" not in shed
+        )
+        h.store.delete("PodCliqueSet", "default", victim)
+        h.settle()
+        h.advance(RETRY)
+        assert shed_names() == []
+
+    def test_quota_exceeded_never_preempts(self):
+        assert unsat_preemptible("no feasible domain") is True
+        from grove_tpu.observability.explain import UnsatDiagnosis
+
+        diag = UnsatDiagnosis("over quota", code=UnsatCode.QUOTA)
+        assert unsat_code(diag) is UnsatCode.QUOTA
+        assert unsat_preemptible(diag) is False
+
+    def test_queue_band_is_work_conserving(self):
+        # zero guarantee, no ceiling: everything is burst band and still
+        # binds while the cluster has room
+        h = quota_harness([{"name": "t1"}])
+        h.apply(labeled_pcs("w0", "t1", cliques=[clique("w", replicas=2)]))
+        h.settle()
+        g = next(iter(h.store.scan(PodGang.KIND)))
+        c = get_condition(
+            g.status.conditions, PodGangConditionType.SCHEDULED.value
+        )
+        assert c is not None and c.status == "True"
+        assert h.cluster.metrics.counter(
+            "grove_tenant_admissions_total"
+        ).value(tenant="t1", decision="queue") >= 1
+
+
+# -- PodGang tier validation + defaulting (satellite) -------------------------
+
+class TestPodGangTierAdmission:
+    def test_empty_priority_class_defaults_to_tenant_tier(self):
+        h = quota_harness([{"name": "t1", "tier": "high"}])
+        h.apply(labeled_pcs("w0", "t1", cliques=[clique("w", replicas=2)]))
+        h.settle()
+        g = next(iter(h.store.scan(PodGang.KIND)))
+        assert g.spec.priority_class_name == "high"
+
+    def test_unknown_tier_rejected_under_tenancy(self):
+        h = quota_harness([{"name": "t1"}])
+        bad = PodGang(
+            metadata=ObjectMeta(name="g", namespace="t1"),
+            spec=PodGangSpec(priority_class_name="platinum"),
+        )
+        with pytest.raises(ValidationError) as err:
+            h.store.create(bad)
+        assert "priority_class_name" in str(err.value)
+
+    def test_known_priorityclass_still_legal_under_tenancy(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+
+        h = quota_harness([{"name": "t1"}])
+        h.store.create(PriorityClass(
+            metadata=ObjectMeta(name="gold", namespace=""), value=500.0))
+        ok = PodGang(
+            metadata=ObjectMeta(name="g", namespace="t1"),
+            spec=PodGangSpec(priority_class_name="gold"),
+        )
+        h.store.create(ok)  # must not raise
+
+    def test_any_string_roundtrips_when_tenancy_disabled(self):
+        h = Harness(nodes=make_nodes(4))
+        g = PodGang(
+            metadata=ObjectMeta(name="g", namespace="default"),
+            spec=PodGangSpec(priority_class_name="anything-goes"),
+        )
+        h.store.create(g)
+        back = h.store.get(PodGang.KIND, "default", "g")
+        assert back.spec.priority_class_name == "anything-goes"
+
+    def test_tiers_seeded_as_priority_classes(self):
+        from grove_tpu.api.auxiliary import PriorityClass
+
+        h = quota_harness([{"name": "t1"}])
+        classes = {
+            pc.metadata.name: pc
+            for pc in h.store.scan(PriorityClass.KIND)
+        }
+        assert {"system", "high", "standard", "low"} <= set(classes)
+        assert classes["standard"].global_default is True
+        assert classes["high"].value > classes["standard"].value
+
+
+# -- per-tenant metric-series hygiene (satellite) -----------------------------
+
+class TestTenantSeriesHygiene:
+    def test_removed_tenant_series_are_reconciled_away(self):
+        from grove_tpu.observability import MetricsRegistry
+
+        registry = MetricsRegistry()
+        m = TenancyManager(
+            tenancy_cfg([
+                {"name": "keep", "guaranteed": {"cpu": 4.0}},
+                {"name": "drop", "guaranteed": {"cpu": 4.0}},
+            ]),
+            metrics=registry,
+        )
+        snap = cluster()
+        h = Harness(nodes=make_nodes(4))  # any store works for refresh
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        share = registry.gauge("grove_tenant_dominant_share")
+        assert {ls["tenant"] for ls in share.label_sets()} == {
+            "keep", "drop"
+        }
+        # the tenant set shrinks (config update): the next export must
+        # remove the dead series — the Gauge.label_sets/remove pattern
+        # the per-node lifecycle gauges pinned in PR 5
+        m.configure(tenancy_cfg([
+            {"name": "keep", "guaranteed": {"cpu": 4.0}},
+        ]))
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        for name in ("grove_tenant_dominant_share",
+                     "grove_tenant_fairness_deficit",
+                     "grove_tenant_usage"):
+            tenants = {
+                ls["tenant"] for ls in registry.gauge(name).label_sets()
+            }
+            assert "drop" not in tenants, name
+            assert "keep" in tenants, name
+
+
+# -- preemption under tiers + disruption budgets (satellite) ------------------
+
+def preemption_harness(budget):
+    """4 one-cpu nodes fully held by a low-tier tenant's scaled gangs; a
+    high-tier tenant then demands capacity. Mirrors
+    test_explain.test_preemption_audit_attached with tenancy on top."""
+    from grove_tpu.api.types import PodCliqueScalingGroupConfig
+
+    bronze = {"name": "bronze", "tier": "low"}
+    if budget is not None:
+        bronze["disruption_budget"] = budget
+    h = Harness(
+        nodes=make_nodes(
+            4, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0},
+        ),
+        config={"tenancy": {
+            "enabled": True,
+            "tenants": [bronze, {"name": "gold-team", "tier": "high"}],
+        }},
+    )
+    low = labeled_pcs(
+        "low", "bronze",
+        cliques=[clique("w", replicas=2, cpu=1.0)],
+        sgs=[PodCliqueScalingGroupConfig(
+            name="grp", clique_names=["w"], replicas=2, min_available=1)],
+    )
+    h.apply(low)
+    h.settle()
+    hi = labeled_pcs("hi", "gold-team",
+                     cliques=[clique("w", replicas=2, cpu=1.0)])
+    h.apply(hi)
+    h.settle()
+    h.advance(RETRY)
+    return h
+
+
+def latest_preemption(h, ns, name):
+    ex = h.cluster.decisions.explain(ns, name)
+    assert ex is not None
+    return next(
+        (r["preemption"] for r in reversed(ex["records"])
+         if r.get("preemption")),
+        None,
+    )
+
+
+class TestPreemptionTenancy:
+    def test_lower_tier_victim_named_with_tenant(self):
+        h = preemption_harness(budget=None)
+        pre = latest_preemption(h, "default", "hi-0")
+        assert pre is not None and pre["satisfied"] is True
+        assert pre["preemptor_tenant"] == "gold-team"
+        chosen = [v for v in pre["considered"]
+                  if v["outcome"] == "chosen"]
+        assert chosen and all(v["tenant"] == "bronze" for v in chosen)
+        assert pre["evicted"]
+        assert h.cluster.metrics.counter(
+            "grove_tenant_preemption_evictions_total"
+        ).value(tenant="bronze") >= 1
+
+    def test_exhausted_budget_blocks_with_distinct_note(self):
+        h = preemption_harness(budget=0)
+        pre = latest_preemption(h, "default", "hi-0")
+        assert pre is not None and pre["satisfied"] is False
+        assert pre["evicted"] == []
+        rejected = [v for v in pre["considered"]
+                    if v["outcome"] == "disruption-budget-exhausted"]
+        assert rejected and all(v["tenant"] == "bronze" for v in rejected)
+        assert "disruption budget" in pre["note"]
+        # nothing was disturbed: the victim gangs keep running
+        victims = [
+            g for g in h.store.scan(PodGang.KIND)
+            if g.metadata.labels.get(constants.LABEL_BASE_PODGANG)
+        ]
+        assert victims
+        for v in victims:
+            c = get_condition(
+                v.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            assert c is not None and c.status == "True"
+
+
+# -- DRF arithmetic -----------------------------------------------------------
+
+class TestDRF:
+    def test_shares_entitlements_and_error(self):
+        h = quota_harness([
+            {"name": "a", "weight": 3.0},
+            {"name": "b", "weight": 1.0},
+        ])
+        h.apply(labeled_pcs("wa", "a", cliques=[clique("w", replicas=2)]))
+        h.apply(labeled_pcs("wb", "b", cliques=[clique("w", replicas=2)]))
+        h.settle()
+        m = h.cluster.tenancy
+        # accounting refresh against the settled (committed) state — the
+        # same read pattern bench --tenants samples between batches
+        snap = h.cluster.topology_snapshot()
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        qa, qb = m.queues["a"], m.queues["b"]
+        assert qa.dominant_share > 0 and qb.dominant_share > 0
+        # entitlements split the consumed dominant share 3:1
+        assert qa.entitlement == pytest.approx(3 * qb.entitlement)
+        total = qa.dominant_share + qb.dominant_share
+        assert qa.entitlement + qb.entitlement == pytest.approx(total)
+        assert m.fairness_error() >= 0.0
+        dump = m.debug_state()
+        assert dump["tenants"]["a"]["weight"] == 3.0
+
+    def test_hierarchy_aggregates_usage_upward(self):
+        h = quota_harness([
+            {"name": "org"},
+            {"name": "team", "parent": "org"},
+        ])
+        h.apply(labeled_pcs("w", "team",
+                            cliques=[clique("w", replicas=2)]))
+        h.settle()
+        m = h.cluster.tenancy
+        snap = h.cluster.topology_snapshot()
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        assert m.queues["team"].usage.sum() > 0
+        assert m.queues["org"].usage.sum() == pytest.approx(
+            m.queues["team"].usage.sum()
+        )
+
+    def test_three_level_chain_counts_leaves_once(self):
+        # regression: propagating LIVE totals (instead of snapshotted own
+        # usage) double-counted a grandchild at the root once its parent's
+        # iteration turn came
+        h = quota_harness([
+            {"name": "root"},
+            {"name": "mid", "parent": "root"},
+            {"name": "leaf", "parent": "mid"},
+        ])
+        h.apply(labeled_pcs("w", "leaf",
+                            cliques=[clique("w", replicas=2)]))
+        h.settle()
+        m = h.cluster.tenancy
+        snap = h.cluster.topology_snapshot()
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        leaf = m.queues["leaf"].usage.sum()
+        assert leaf > 0
+        assert m.queues["mid"].usage.sum() == pytest.approx(leaf)
+        assert m.queues["root"].usage.sum() == pytest.approx(leaf)
+
+
+class TestReviewRegressions:
+    def test_same_named_gangs_across_namespaces_keep_own_tenants(self):
+        # review regression: annotate keyed PodGangs by bare name, so two
+        # tenants' same-named gangs collided onto one tenant's quota
+        h = quota_harness([
+            {"name": "a", "burst": {"cpu": 1.0}},  # below one gang's 2 cpu
+            {"name": "b", "burst": {"cpu": 100.0}},
+        ])
+        for ns in ("a", "b"):
+            pcs = simple_pcs(name="train",
+                             cliques=[clique("w", replicas=2)])
+            pcs.metadata.namespace = ns  # namespace == tenant
+            h.apply(pcs)
+        h.settle()
+        by_ns = {}
+        for g in h.store.scan(PodGang.KIND):
+            c = get_condition(
+                g.status.conditions, PodGangConditionType.SCHEDULED.value
+            )
+            by_ns[g.metadata.namespace] = (c.status, c.reason)
+        # tenant a's 2-cpu ceiling sheds ITS gang; tenant b's identically
+        # named gang rides its own (roomy) quota and binds
+        assert by_ns["a"] == ("False", "QuotaExceeded")
+        assert by_ns["b"][0] == "True"
+
+    def test_admission_counters_count_once_per_consumed_solve(self):
+        # review regression: pre_round + fallback annotate double-counted
+        h = quota_harness([{"name": "t1"}])
+        h.apply(labeled_pcs("w0", "t1", cliques=[clique("w", replicas=2)]))
+        h.settle()
+        c = h.cluster.metrics.counter("grove_tenant_admissions_total")
+        assert c.value(tenant="t1", decision="queue") == 1.0
+
+    def test_usage_gauge_reports_committed_not_projected(self):
+        # review regression: gauges exported after in-round charging
+        # overstated usage by the round's not-yet-placed demand
+        h = quota_harness([{"name": "t1"}])
+        h.apply(labeled_pcs("w0", "t1", cliques=[clique("w", replicas=2)]))
+        h.settle()
+        snap = h.cluster.topology_snapshot()
+        m = h.cluster.tenancy
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        committed = h.cluster.metrics.gauge("grove_tenant_usage").value(
+            tenant="t1", resource="cpu"
+        ) if m.queues["t1"].guaranteed or m.queues["t1"].burst else None
+        # quota names no resources here; assert via the share gauge
+        share = h.cluster.metrics.gauge(
+            "grove_tenant_dominant_share"
+        ).value(tenant="t1")
+        assert share == pytest.approx(m.queues["t1"].dominant_share)
+        assert committed is None  # no quota'd resources -> no usage series
+
+
+# -- tenant-skew chaos --------------------------------------------------------
+
+class TestTenantSkewChaos:
+    def test_skew_seed_converges_to_fault_free_fixpoint(self):
+        from grove_tpu.chaos import (
+            ChaosHarness,
+            FaultPlan,
+            check_invariants,
+            settled_fingerprint,
+        )
+
+        config = {"tenancy": {
+            "enabled": True,
+            "tenants": [
+                {"name": "skew-a", "guaranteed": {"cpu": 2.0},
+                 "burst": {"cpu": 6.0}},
+                {"name": "skew-b", "guaranteed": {"cpu": 2.0},
+                 "burst": {"cpu": 6.0}},
+            ],
+        }}
+        workload = simple_pcs(name="chaos",
+                              cliques=[clique("w", replicas=2)])
+        base = Harness(nodes=make_nodes(12), config=config)
+        base.apply(workload)
+        base.settle()
+        baseline = settled_fingerprint(base.store)
+
+        plan = FaultPlan.from_seed(3, tenant_skew_rate=0.5)
+        ch = ChaosHarness(plan, nodes=make_nodes(12), config=config)
+        ch.apply(simple_pcs(name="chaos",
+                            cliques=[clique("w", replicas=2)]))
+        ch.run_chaos()
+        assert plan.counts.get("tenant_skew", 0) > 0, (
+            "seed injected no skew faults; pick another seed"
+        )
+        assert settled_fingerprint(ch.raw_store) == baseline
+        assert check_invariants(ch.raw_store) == []
+
+    def test_default_plans_draw_no_skew(self):
+        from grove_tpu.chaos import FaultPlan
+
+        # rate stays 0 through the seeded mix: pre-existing seeds keep
+        # their exact draw sequences (and verified convergence)
+        assert FaultPlan.from_seed(7).tenant_skew_rate == 0.0
