@@ -122,20 +122,31 @@ def main() -> int:
                     "over a mesh of ALL visible devices (1-device mesh on a "
                     "single chip; virtual CPU mesh under "
                     "xla_force_host_platform_device_count)")
-    ap.add_argument("--engine", choices=("delta", "full"), default="delta",
-                    help="device free-state regime of the measured engine: "
-                    "'delta' keeps the free matrix device-resident across "
-                    "solves behind the epoch counter (the default, the "
-                    "deployed configuration); 'full' disables the state "
-                    "cache so every solve re-ships the full [N, R] matrix "
-                    "— the pre-delta behavior, kept for A/B runs and the "
-                    "CI equivalence smoke")
+    ap.add_argument("--engine", choices=("fused", "delta", "full"),
+                    default="fused",
+                    help="solve-path regime of the measured engine: "
+                    "'fused' (the default, the deployed configuration) "
+                    "runs the single-dispatch fused program — staged "
+                    "free-state delta + gang inputs in one buffer, one "
+                    "launch, one D2H — on top of the device-resident "
+                    "state; 'delta' is the split (pre-fused) dispatch "
+                    "discipline with the state cache on; 'full' "
+                    "additionally disables the cache so every solve "
+                    "re-ships the full [N, R] matrix. The measured "
+                    "engines run with the incremental re-solve OFF (a "
+                    "repeated identical backlog would degenerate into "
+                    "the zero-dispatch reuse tier); the incremental "
+                    "dirty-tick probes below measure it explicitly")
     ap.add_argument("--equivalence", action="store_true",
                     help="instead of benchmarking, solve every scenario "
-                    "(plain, grouped, and a seeded bind/unbind churn "
-                    "sweep) with BOTH free-state regimes and exit nonzero "
-                    "on any placement divergence — the delta path must be "
-                    "bit-identical to the full-encode path")
+                    "(plain, grouped, dispatch/adopt + staled dispatch, "
+                    "a seeded bind/unbind churn sweep, fairness, and the "
+                    "incremental suite: seeded churn dirtying 1/3/all "
+                    "gangs, dispatch-adoption under a dirty tick, rebind "
+                    "mid-stream) with the delta, fused and "
+                    "fused+incremental engines AGAINST the full-re-encode "
+                    "reference and exit nonzero on any placement "
+                    "divergence — every path must be bit-identical")
     ap.add_argument("--churn-rate", type=float, default=300.0,
                     help="sustained-churn bench: offered gang arrival "
                     "rate (gangs/sec) against the warm control plane; "
@@ -224,6 +235,7 @@ def main() -> int:
     from grove_tpu.observability import MetricsRegistry
 
     state_cache = args.engine != "full"
+    fused = args.engine == "fused"
     if args.sharded:
         from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
 
@@ -231,10 +243,14 @@ def main() -> int:
 
         def mk_engine(**kw):
             kw.setdefault("state_cache", state_cache)
+            kw.setdefault("fused", fused)
+            kw.setdefault("incremental", False)
             return ShardedPlacementEngine(snapshot, mesh, **kw)
     else:
         def mk_engine(**kw):
             kw.setdefault("state_cache", state_cache)
+            kw.setdefault("fused", fused)
+            kw.setdefault("incremental", False)
             return PlacementEngine(snapshot, **kw)
 
     if args.equivalence:
@@ -330,18 +346,20 @@ def main() -> int:
     pipe_iters = max(5, args.iters)
     handle = warm.dispatch(gangs, free=snapshot.free.copy())
     pipe_adopted = 0
-    t0 = time.perf_counter()
+    pipe_walls = []
     for _ in range(pipe_iters):
         # each call gets its own pristine copy (solve's repair phase
         # mutates the matrix it is handed); with the state cache on, the
         # sync recognizes the content as unchanged and the adoption guard
         # is the O(1) epoch compare — free0 no longer rides the handle
+        t0 = time.perf_counter()
         nxt = warm.dispatch(gangs, free=snapshot.free.copy())
         pr = warm.solve(gangs, free=snapshot.free.copy(), dispatch=handle)
+        pipe_walls.append(time.perf_counter() - t0)
         if pr.stats.get("dispatch_overlap"):
             pipe_adopted += 1
         handle = nxt
-    pipe_wall = (time.perf_counter() - t0) / pipe_iters
+    pipe_wall = sorted(pipe_walls)[len(pipe_walls) // 2]
     warm.solve(gangs, free=snapshot.free.copy(), dispatch=handle)  # drain
     # EVERY iteration must have adopted its in-flight dispatch, else the
     # wall mixes synchronous solves and the number is not pipelined;
@@ -397,6 +415,79 @@ def main() -> int:
         round(args.gangs / pipe_wall, 1) if pipe_wall > 0 else 0.0
     )
 
+    # Per-solve dispatch accounting (PR 7): the fused path's whole point
+    # is fewer program launches — report them so the trajectory captures
+    # the collapse (split warm solve: score launch + any delta scatter;
+    # fused: exactly one; incremental reuse: zero).
+    disp = ds.get("dispatches", {})
+    split["dispatches_by_kind"] = dict(disp)
+    split["dispatches_per_solve"] = round(
+        sum(disp.values()) / max(args.iters, 1), 2
+    )
+
+    # Fused-vs-split A/B on identical blocking solves: the same backlog
+    # through the split (separate-dispatch) discipline, so the JSON
+    # carries the fusion win itself, independent of adoption overlap.
+    inc_fields = {}
+    if args.engine == "fused":
+        split_eng = mk_engine(fused=False)
+        split_eng.solve(gangs)  # warm-up: split program compile
+        s_walls = []
+        for _ in range(max(3, args.iters // 3)):
+            t0 = time.perf_counter()
+            split_eng.solve(gangs)
+            s_walls.append(time.perf_counter() - t0)
+        split_p50 = sorted(s_walls)[len(s_walls) // 2]
+        inc_fields["split_blocking_p50_seconds"] = round(split_p50, 4)
+        inc_fields["fused_vs_split_speedup"] = round(
+            split_p50 / engine_wall, 3
+        )
+
+    # Incremental dirty-tick probes (single-device only; the sharded
+    # engine always runs the full fused program): a churn tick that
+    # dirties K gangs against an unchanged free state must re-score
+    # O(K) rows, and an identical retry tick must skip the device
+    # entirely (the zero-dispatch reuse tier).
+    if args.engine == "fused" and not args.sharded:
+        inc_eng = mk_engine(incremental=True)
+        base = list(gangs)
+        inc_eng.solve(base, free=snapshot.free.copy())  # arm the cache
+
+        def fresh_gang(tag):
+            g = make_gangs(1)[0]
+            g.name = f"inc-{tag}"
+            return g
+
+        r_walls = []
+        rr = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            rr = inc_eng.solve(base, free=snapshot.free.copy())
+            r_walls.append(time.perf_counter() - t0)
+        inc_fields["incremental_reuse_hit"] = bool(rr.stats.get("reused"))
+        inc_fields["incremental_reuse_tick_seconds"] = round(
+            sorted(r_walls)[1], 4
+        )
+        DIRTY, TICKS = 3, 5
+        walls, rows = [], 0
+        inc_eng.solve(base, free=snapshot.free.copy())
+        for t in range(TICKS):
+            for j in range(DIRTY):
+                base[(t * DIRTY + j) % len(base)] = fresh_gang(
+                    f"{t}-{j}"
+                )
+            t0 = time.perf_counter()
+            rr = inc_eng.solve(base, free=snapshot.free.copy())
+            walls.append(time.perf_counter() - t0)
+            rows += int(rr.stats.get("incremental_rows", 0))
+        tick = sorted(walls)[len(walls) // 2]
+        inc_fields.update({
+            "incremental_tick_dirty_gangs": DIRTY,
+            "incremental_tick_seconds": round(tick, 4),
+            "incremental_rows_per_tick": round(rows / TICKS, 1),
+            "incremental_vs_full_speedup": round(engine_wall / tick, 2),
+        })
+
     # Scale-ceiling probes (VERDICT r3 #8 + r4 #9): datapoints at 2x and
     # 4x the north star proving the bucketing/padding strategy and memory
     # hold past the stress config (and mapping where the curve bends).
@@ -405,7 +496,9 @@ def main() -> int:
         for factor in (2, 4):
             p_snapshot = make_cluster(args.nodes * factor)
             p_gangs = make_gangs(args.gangs * factor)
-            p_engine = PlacementEngine(p_snapshot)  # single-device probe
+            # single-device probe; incremental off — repeated identical
+            # solves would degenerate into the zero-dispatch reuse tier
+            p_engine = PlacementEngine(p_snapshot, incremental=False)
             p_engine.solve(p_gangs)  # warm-up: new shapes compile
             p_walls = []
             p_placed = 0
@@ -451,17 +544,29 @@ def main() -> int:
             )
         )
 
-    gangs_per_sec = args.gangs / engine_wall
+    # Headline basis (r7, recorded so BENCH files stay self-describing,
+    # like the r3 p99->p50 change): the fused regime's headline is the
+    # dispatch/adopt steady state — the scheduler's DEPLOYED posture
+    # (pre_round dispatches, the round's host work overlaps device
+    # compute + D2H, _reconcile adopts) — because a blocking roundtrip
+    # through the dev tunnel is transport-latency-bound no matter how
+    # little is shipped. Blocking p50/p99 remain as the latency fields.
+    headline_wall = engine_wall
+    basis = "p50_of_iters"
+    if args.engine == "fused" and pipe_wall > 0:
+        headline_wall = pipe_wall
+        basis = "p50_pipelined_adopted"
+    gangs_per_sec = args.gangs / headline_wall
     out = {
         "metric": f"gang placements/sec ({args.gangs} x 8-pod gangs, "
         f"{args.nodes} nodes, 3-tier topology)",
         "value": round(gangs_per_sec, 1),
         "unit": "gangs/sec",
-        "vs_baseline": round(serial_wall / engine_wall, 2),
-        # r3 basis change, recorded so BENCH files are self-describing:
+        "vs_baseline": round(serial_wall / headline_wall, 2),
         # r1/r2 computed value+vs_baseline from p99 (=max of iters); a
         # single tunnel hiccup misreported steady throughput 3x low
-        "throughput_basis": "p50_of_iters",
+        "throughput_basis": basis,
+        "engine_regime": args.engine,
         "p50_backlog_bind_seconds": round(engine_wall, 4),
         "p99_backlog_bind_seconds": round(engine_p99, 4),
         "serial_baseline_seconds": round(serial_wall, 2),
@@ -483,6 +588,7 @@ def main() -> int:
         "grouped_placed": g_placed,
         "grouped_repair_fallbacks": g_fallbacks,
         **split,
+        **inc_fields,
         **probe,
         "backend": __import__("jax").default_backend(),
         "engine": "sharded" if args.sharded else "single",
@@ -503,166 +609,285 @@ def main() -> int:
 
 def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
     """Placement-equivalence gate (`--equivalence`, run by CI): solve
-    every scenario with BOTH free-state regimes — the device-resident
-    delta engine (state cache on, superset-contract verify on) and the
-    full-re-encode engine (cache off, the pre-delta behavior) — and exit
-    nonzero on any divergence. The resident state changes WHERE the free
-    matrix lives, never what is computed: placements, unplaced reasons,
-    and the post-solve free matrix must all be bit-identical.
+    every scenario with the delta (split dispatch, state cache +
+    superset-contract verify), fused (single-dispatch program) and
+    fused+incremental (dirty-row re-solve) engines AGAINST the
+    full-re-encode reference (cache off, the pre-delta behavior) and
+    exit nonzero on any divergence. The resident state, the fused
+    launch, and the incremental value-row cache change WHERE and HOW
+    OFTEN things are computed and shipped, never what is computed:
+    placements, unplaced reasons, and the post-solve free matrix must
+    all be bit-identical on every path.
 
-    Scenarios: the plain backlog solved repeatedly (the warm hit path),
-    the grouped-constraint backlog, a dispatch/adopt round plus a
-    dispatch deliberately staled by a free mutation (the epoch guard must
-    refuse it and the fallback solve must still match), and a seeded
-    bind/unbind churn sweep that carries committed capacity forward
-    between rounds through the delta path."""
-    eng_d = mk_engine(state_cache=True, state_verify=True)
-    eng_f = mk_engine(state_cache=False)
+    Scenarios: the plain backlog solved repeatedly (warm hit / reuse
+    tier), the grouped-constraint backlog, a dispatch/adopt round plus a
+    dispatch deliberately staled by a free mutation (the epoch guard
+    must refuse it), a seeded bind/unbind churn sweep carrying committed
+    capacity forward, tenant-fairness weights, and the INCREMENTAL
+    suite: seeded churn dirtying 1/3/all gangs against an unchanged free
+    state, dispatch-adoption under a dirty tick, and a rebind
+    (cordon-shaped schedulable flip) mid-stream that must force the
+    full-solve fallback. The gate also fails if the incremental engine
+    never actually exercised its dirty-row / reuse tiers — a vacuous
+    pass must not read as coverage."""
+    import dataclasses
+
+    eng_f = mk_engine(state_cache=False, fused=False, incremental=False)
+    candidates = {
+        "delta": mk_engine(state_cache=True, state_verify=True,
+                           fused=False, incremental=False),
+        "fused": mk_engine(state_cache=True, state_verify=True,
+                           fused=True, incremental=False),
+        "inc": mk_engine(state_cache=True, state_verify=True,
+                         fused=True, incremental=True),
+    }
     rng = np.random.default_rng(7)
     n = snapshot.num_nodes
     failures: list[str] = []
     solves = 0
 
-    def compare(label: str, res_d, res_f, free_d, free_f) -> None:
-        nonlocal solves
-        solves += 1
-        if sorted(res_d.placed) != sorted(res_f.placed):
-            only_d = sorted(set(res_d.placed) - set(res_f.placed))[:4]
-            only_f = sorted(set(res_f.placed) - set(res_d.placed))[:4]
+    def diff(label, name, res_c, res_f, free_c, free_f) -> None:
+        if sorted(res_c.placed) != sorted(res_f.placed):
+            only_c = sorted(set(res_c.placed) - set(res_f.placed))[:4]
+            only_f = sorted(set(res_f.placed) - set(res_c.placed))[:4]
             failures.append(
-                f"{label}: placed sets differ (delta-only {only_d}, "
-                f"full-only {only_f})"
+                f"{label}[{name}]: placed sets differ ({name}-only "
+                f"{only_c}, full-only {only_f})"
             )
             return
-        for gname, p_d in res_d.placed.items():
+        for gname, p_c in res_c.placed.items():
             p_f = res_f.placed[gname]
-            if p_d.pod_to_node != p_f.pod_to_node or not np.array_equal(
-                p_d.node_indices, p_f.node_indices
+            if p_c.pod_to_node != p_f.pod_to_node or not np.array_equal(
+                p_c.node_indices, p_f.node_indices
             ):
-                failures.append(f"{label}: {gname} placed differently")
-        if res_d.unplaced != res_f.unplaced:
-            failures.append(f"{label}: unplaced reasons differ")
-        if not np.array_equal(free_d, free_f):
-            bad = np.flatnonzero((free_d != free_f).any(axis=1))[:8]
+                failures.append(
+                    f"{label}[{name}]: {gname} placed differently"
+                )
+        if res_c.unplaced != res_f.unplaced:
+            failures.append(f"{label}[{name}]: unplaced reasons differ")
+        if not np.array_equal(free_c, free_f):
+            bad = np.flatnonzero((free_c != free_f).any(axis=1))[:8]
             failures.append(
-                f"{label}: post-solve free matrices differ on rows "
-                f"{bad.tolist()}"
+                f"{label}[{name}]: post-solve free matrices differ on "
+                f"rows {bad.tolist()}"
             )
 
-    # 1) plain backlog, twice: the second delta solve rides a pure state
-    #    hit (nothing re-shipped) and must still match the full engine
-    for i in range(2):
-        free_d, free_f = snapshot.free.copy(), snapshot.free.copy()
-        compare(
-            f"plain[{i}]",
-            eng_d.solve(gangs, free=free_d),
-            eng_f.solve(gangs, free=free_f),
-            free_d, free_f,
-        )
+    #: the sharded engine forces incremental off by design (the value
+    #: cache permutation would be a cross-shard collective), so the
+    #: path EXPECTATIONS and coverage asserts are single-device-only;
+    #: the bitwise comparisons — the actual gate — run everywhere
+    check_paths = candidates["inc"].incremental
 
-    # 2) grouped-constraint backlog (fresh engines: different snapshot
-    #    shapes are not the point — same snapshot, richer constraints)
+    def solve_all(label, gang_list, free, fairness=None,
+                  declare=None, unknown=False, expect_inc=None):
+        """Solve `gang_list` against `free` content on the reference and
+        every candidate (each on its own copy; `declare`/`unknown` feed
+        note_free_rows per the superset contract), compare bitwise, and
+        return the reference's post-solve free (the carried canonical
+        state). `expect_inc` pins the inc engine's path: "inc" (dirty-row
+        re-score), "reused", or "full" (neither stat present)."""
+        nonlocal solves
+        solves += 1
+        free_f = free.copy()
+        res_f = eng_f.solve(gang_list, free=free_f, fairness=fairness)
+        for name, eng in candidates.items():
+            if unknown:
+                eng.note_free_rows(None)
+            elif declare is not None:
+                eng.note_free_rows(declare)
+            free_c = free.copy()
+            res_c = eng.solve(gang_list, free=free_c, fairness=fairness)
+            diff(label, name, res_c, res_f, free_c, free_f)
+            if name == "inc" and expect_inc is not None and check_paths:
+                got = (
+                    "inc" if res_c.stats.get("incremental")
+                    else "reused" if res_c.stats.get("reused")
+                    else "full"
+                )
+                if got != expect_inc:
+                    failures.append(
+                        f"{label}[inc]: expected the {expect_inc} path, "
+                        f"engine took {got}"
+                    )
+        return free_f
+
+    # 1) plain backlog, twice: the second solve rides a pure state hit —
+    #    and the incremental engine's zero-dispatch REUSE tier
+    free = solve_all("plain[0]", gangs, snapshot.free.copy())
+    solve_all("plain[1]", gangs, snapshot.free.copy(),
+              expect_inc="reused")
+
+    # 2) grouped-constraint backlog (same snapshot, richer constraints).
+    #    The grouped variant reuses the plain backlog's names and
+    #    per-gang DEVICE rows (constraint groups and group preferences
+    #    never enter the device phase — they are repair-side exact
+    #    constraints), so the incremental engine legitimately serves it
+    #    from the cache: the reused scores are bitwise what a full
+    #    re-score would compute, and the exact repair applies the richer
+    #    constraints fresh. The diff against the reference proves it.
     grouped = make_gangs(len(gangs), grouped=True)
-    free_d, free_f = snapshot.free.copy(), snapshot.free.copy()
-    compare(
-        "grouped",
-        eng_d.solve(grouped, free=free_d),
-        eng_f.solve(grouped, free=free_f),
-        free_d, free_f,
-    )
+    solve_all("grouped", grouped, snapshot.free.copy(),
+              expect_inc="reused")
 
-    # 3) dispatch/adopt: an unchanged dispatch must be adopted via the
-    #    O(1) epoch guard; one staled by a declared free mutation must be
-    #    refused, and the fallback fresh solve must still match
-    handle = eng_d.dispatch(gangs, free=snapshot.free.copy())
-    free_d, free_f = snapshot.free.copy(), snapshot.free.copy()
-    res_d = eng_d.solve(gangs, free=free_d, dispatch=handle)
-    if not res_d.stats.get("dispatch_overlap"):
-        failures.append("dispatch/adopt: unchanged dispatch not adopted")
-    compare(
-        "dispatch-adopt", res_d, eng_f.solve(gangs, free=free_f),
-        free_d, free_f,
-    )
-    handle = eng_d.dispatch(gangs, free=snapshot.free.copy())
-    stale_free = snapshot.free.copy()
-    row = int(rng.integers(n))
-    stale_free[row] *= 0.5
-    eng_d.note_free_rows((row,))
-    free_d, free_f = stale_free.copy(), stale_free.copy()
-    res_d = eng_d.solve(gangs, free=free_d, dispatch=handle)
-    if res_d.stats.get("dispatch_overlap"):
-        failures.append("dispatch-stale: epoch guard adopted stale scores")
-    compare(
-        "dispatch-stale", res_d, eng_f.solve(gangs, free=free_f),
-        free_d, free_f,
-    )
+    # 3) dispatch/adopt per candidate: an unchanged dispatch must be
+    #    adopted via the O(1) epoch guard; one staled by a declared free
+    #    mutation must be refused, and the fallback solve must match
+    for name, eng in candidates.items():
+        handle = eng.dispatch(gangs, free=snapshot.free.copy())
+        free_c, free_f = snapshot.free.copy(), snapshot.free.copy()
+        res_c = eng.solve(gangs, free=free_c, dispatch=handle)
+        if not res_c.stats.get("dispatch_overlap"):
+            failures.append(
+                f"dispatch-adopt[{name}]: unchanged dispatch not adopted"
+            )
+        solves += 1
+        diff("dispatch-adopt", name, res_c,
+             eng_f.solve(gangs, free=free_f), free_c, free_f)
+        handle = eng.dispatch(gangs, free=snapshot.free.copy())
+        stale_free = snapshot.free.copy()
+        row = int(rng.integers(n))
+        stale_free[row] *= 0.5
+        eng.note_free_rows((row,))
+        free_c, free_f = stale_free.copy(), stale_free.copy()
+        res_c = eng.solve(gangs, free=free_c, dispatch=handle)
+        if res_c.stats.get("dispatch_overlap"):
+            failures.append(
+                f"dispatch-stale[{name}]: epoch guard adopted stale "
+                "scores"
+            )
+        solves += 1
+        diff("dispatch-stale", name, res_c,
+             eng_f.solve(gangs, free=free_f), free_c, free_f)
+        # re-align every engine's resident content before the next
+        # candidate: the stale solve reverts UNDECLARED (each candidate
+        # staled a different row), so this must ride the unknown-scope
+        # full-diff path per the note_free_rows contract
+        solve_all(f"dispatch-realign[{name}]", gangs,
+                  snapshot.free.copy(), unknown=True)
 
     # 4) seeded bind/unbind churn: capacity committed by round k's repair
     #    carries forward into round k+1 through the delta path, with
     #    extra seeded row churn (release/claw-back) declared per the
-    #    note_free_rows superset contract
+    #    note_free_rows superset contract. The free content moves every
+    #    round, so the inc engine must take the full path (epoch
+    #    divergence fallback).
     rounds, subset_size = (4, max(8, len(gangs) // 8))
-    free = free_d  # continue from the content the delta engine last saw
+    free = snapshot.free.copy()
     for rnd in range(rounds):
         rows = rng.choice(n, size=min(24, n), replace=False)
         scale = rng.uniform(0.4, 1.1, size=(rows.size, 1)).astype(np.float32)
         free[rows] = np.minimum(
             snapshot.capacity[rows], free[rows] * scale
         ).astype(np.float32)
-        # one round declares UNKNOWN scope (None) instead of the rows:
-        # the engine must fall back to the full content diff and stay
-        # correct — the other rounds ride the row-scoped delta path
-        eng_d.note_free_rows(None if rnd == 2 else rows.tolist())
         subset = [
             gangs[i]
             for i in sorted(rng.choice(
                 len(gangs), size=min(subset_size, len(gangs)), replace=False
             ))
         ]
-        free_d, free_f = free.copy(), free.copy()
-        compare(
-            f"churn[{rnd}]",
-            eng_d.solve(subset, free=free_d),
-            eng_f.solve(subset, free=free_f),
-            free_d, free_f,
+        # one round declares UNKNOWN scope (None) instead of the rows:
+        # the engine must fall back to the full content diff and stay
+        # correct — the other rounds ride the row-scoped delta path
+        free = solve_all(
+            f"churn[{rnd}]", subset, free,
+            declare=rows.tolist(), unknown=(rnd == 2),
+            expect_inc="full",
         )
-        free = free_d  # carry the committed state forward
 
     # 5) tenant fairness terms (grove_tpu/tenancy): seeded per-gang DRF
     #    weights reorder the commit scan and ride the cost tensor as an
-    #    extra column — fairness-weighted solves must stay bit-identical
-    #    across the device-state regimes, including a fairness-stamped
-    #    dispatch adopted through the epoch guard
+    #    extra column; a changed weight also changes the gang's content
+    #    fingerprint, so the first fairness solve is an incremental
+    #    all-dirty -> full fallback and a repeat is a reuse
     fair = {
         g.name: round(float(rng.uniform(-0.5, 1.5)), 6) for g in gangs
     }
-    # continue from the churn-carried content (a rewind to the pristine
-    # matrix would need a note_free_rows(None) unknown-scope declaration;
-    # carrying forward keeps the delta engine on the row-scoped path)
-    free_d, free_f = free.copy(), free.copy()
-    compare(
-        "fairness",
-        eng_d.solve(gangs, free=free_d, fairness=fair),
-        eng_f.solve(gangs, free=free_f, fairness=fair),
-        free_d, free_f,
+    free = solve_all("fairness", gangs, free, fairness=fair)
+    handle = candidates["inc"].dispatch(
+        gangs, free=free.copy(), fairness=fair
     )
-    handle = eng_d.dispatch(gangs, free=free.copy(), fairness=fair)
-    free_d, free_f = free.copy(), free.copy()
-    res_d = eng_d.solve(gangs, free=free_d, dispatch=handle, fairness=fair)
-    if not res_d.stats.get("dispatch_overlap"):
+    free_c, free_f = free.copy(), free.copy()
+    res_c = candidates["inc"].solve(
+        gangs, free=free_c, dispatch=handle, fairness=fair
+    )
+    if not res_c.stats.get("dispatch_overlap"):
         failures.append(
-            "fairness-dispatch: unchanged fairness-stamped dispatch not "
-            "adopted"
+            "fairness-dispatch[inc]: unchanged fairness-stamped dispatch "
+            "not adopted"
         )
-    compare(
-        "fairness-dispatch", res_d,
-        eng_f.solve(gangs, free=free_f, fairness=fair),
-        free_d, free_f,
-    )
+    solves += 1
+    diff("fairness-dispatch", "inc", res_c,
+         eng_f.solve(gangs, free=free_f, fairness=fair), free_c, free_f)
 
-    ds = eng_d.debug_summary()["device_state"]
+    # 6) INCREMENTAL suite: seeded churn ticks against an UNCHANGED free
+    #    state, dirtying 1, 3, then all gangs — the 1/3 ticks must ride
+    #    the dirty-row re-score, the all-dirty tick the full fallback —
+    #    plus dispatch-adoption under a dirty tick and a rebind
+    #    (schedulable flip) mid-stream forcing the full-solve fallback.
+    current = list(gangs)
+    fresh_seq = [0]
+
+    def freshen(k):
+        start = fresh_seq[0]
+        for j in range(k):
+            g = make_gangs(1)[0]
+            g.name = f"inc-fresh-{start + j}"
+            current[(start + j) % len(current)] = g
+        fresh_seq[0] += k
+
+    solve_all("inc-warm", current, free)  # arm the caches on this content
+    for k, label in ((1, "inc-dirty-1"), (3, "inc-dirty-3")):
+        freshen(k)
+        solve_all(label, current, free, expect_inc="inc")
+    freshen(len(current))
+    solve_all("inc-dirty-all", current, free, expect_inc="full")
+
+    # dispatch-adoption under a dirty tick: the dispatched incremental
+    # scores must be adopted and match the reference
+    freshen(2)
+    inc_eng = candidates["inc"]
+    handle = inc_eng.dispatch(current, free=free.copy())
+    if check_paths and handle is not None and handle.path != "incremental":
+        failures.append(
+            f"inc-adopt-dirty: dispatch took {handle.path}, expected the "
+            "incremental path"
+        )
+    free_c, free_f = free.copy(), free.copy()
+    res_c = inc_eng.solve(current, free=free_c, dispatch=handle)
+    if not res_c.stats.get("dispatch_overlap"):
+        failures.append("inc-adopt-dirty: incremental dispatch not adopted")
+    solves += 1
+    diff("inc-adopt-dirty", "inc", res_c,
+         eng_f.solve(current, free=free_f), free_c, free_f)
+
+    # rebind mid-stream: a cordon-shaped schedulable flip must clear the
+    # value cache and force the full-solve fallback (a stale re-score
+    # against the old mask would place onto the cordoned node)
+    flip = int(rng.integers(n))
+    sched = snapshot.schedulable.copy()
+    sched[flip] = ~sched[flip]
+    snap2 = dataclasses.replace(snapshot, schedulable=sched)
+    for eng in (eng_f, *candidates.values()):
+        if not eng.rebind(snap2):
+            failures.append("inc-rebind: rebind rejected a pure "
+                            "schedulable flip")
+    solve_all("inc-rebind", current, free, expect_inc="full")
+    # and the tier must RESUME once re-armed on the new mask
+    freshen(1)
+    solve_all("inc-rebind-resume", current, free, expect_inc="inc")
+
+    # the gate is only meaningful if the incremental tiers actually ran
+    inc_ds = candidates["inc"].debug_summary()["device_state"]
+    if check_paths and inc_ds["dispatches"]["incremental"] == 0:
+        failures.append("coverage: the incremental dirty-row path never "
+                        "ran — the gate is vacuous")
+    if check_paths and inc_ds["reuse_hits"] == 0:
+        failures.append("coverage: the zero-dispatch reuse tier never "
+                        "ran — the gate is vacuous")
+
+    ds = candidates["delta"].debug_summary()["device_state"]
     out = {
-        "metric": "delta vs full free-state placement equivalence "
+        "metric": "delta/fused/incremental vs full placement equivalence "
         f"({args.gangs} x 8-pod gangs, {args.nodes} nodes)",
         "value": len(failures),
         "unit": "divergences",
@@ -671,6 +896,9 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         "full_uploads": ds["full_uploads"],
         "delta_uploads": ds["delta_uploads"],
         "state_sync_hits": ds["hits"],
+        "incremental_dispatches": inc_ds["dispatches"]["incremental"],
+        "incremental_rows": inc_ds["incremental_rows"],
+        "reuse_hits": inc_ds["reuse_hits"],
         "engine": "sharded" if args.sharded else "single",
         "backend": __import__("jax").default_backend(),
     }

@@ -107,6 +107,24 @@ class SolverConfig:
     # contract breach). Costs exactly the compare the cache exists to
     # avoid — tests and chaos sweeps only.
     device_state_verify: bool = False
+    # Fused single-dispatch solve (solver/engine.py): the staged
+    # free-state delta, gang inputs and signature tables ride ONE io
+    # buffer into ONE device program launch (delta apply -> score ->
+    # commit scan, free buffer donated off-CPU), so a warm solve is one
+    # small H2D + one launch + one D2H. Off = the split (pre-PR7)
+    # dispatch discipline, kept for A/B benches (`bench.py --engine`).
+    fused_solve: bool = True
+    # Incremental dirty-row re-solve: the fused program's value matrix
+    # and per-gang demand stay device-resident; while the free-state
+    # epoch is unchanged, a re-solve re-scores only DIRTY gangs
+    # (new/changed content fingerprints) against the resident state and
+    # a fully-unchanged backlog reuses the previous packed results with
+    # zero dispatches. Falls back to the full fused solve on epoch
+    # divergence, rebind, engine rebuild, or unknown-scope free
+    # declarations (e.g. journal compaction-horizon rebuilds). Requires
+    # fused_solve AND device_state_cache — the engine degrades to the
+    # full fused path when either is off.
+    incremental_resolve: bool = True
 
 
 #: built-in priority-tier ladder seeded as PriorityClass objects when
@@ -414,6 +432,10 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             "device_state_cache (the verify tripwire checks the cache's "
             "epoch guard; with the cache off it never runs)"
         )
+    if not isinstance(sv.fused_solve, bool):
+        errs.append("config.solver.fused_solve: must be a bool")
+    if not isinstance(sv.incremental_resolve, bool):
+        errs.append("config.solver.incremental_resolve: must be a bool")
 
     errs += _validate_tenancy(cfg.tenancy)
 

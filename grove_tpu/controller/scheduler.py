@@ -95,6 +95,17 @@ class GangScheduler:
             self._engine_kwargs["state_verify"] = (
                 cfg.solver.device_state_verify
             )
+        # fused single-dispatch + incremental dirty-row re-solve (PR 7),
+        # gated like the other capability knobs. The engine itself
+        # normalizes the combination (incremental requires fused + the
+        # state cache), so a partial configuration degrades to the full
+        # solve path rather than failing.
+        if accepts_kwarg(engine_cls, "fused"):
+            self._engine_kwargs["fused"] = cfg.solver.fused_solve
+        if accepts_kwarg(engine_cls, "incremental"):
+            self._engine_kwargs["incremental"] = (
+                cfg.solver.incremental_resolve
+            )
         if accepts_kwarg(engine_cls, "decision_log"):
             # the CLUSTER-owned decision ring (observability/explain.py):
             # injected so placement explanations survive engine rebuilds
@@ -687,6 +698,15 @@ class GangScheduler:
             overlapped=bool(result.stats.get("dispatch_overlap")),
             wall_seconds=round(result.wall_seconds, 6),
         )
+        # incremental visibility: how much of the backlog the engine
+        # actually re-scored this round (the gang-dirty set the fused
+        # engine derived from content fingerprints + the free journal)
+        if result.stats.get("incremental"):
+            solve_sp.set(
+                incremental_rows=int(result.stats["incremental_rows"])
+            )
+        elif result.stats.get("reused"):
+            solve_sp.set(reused=True)
         self.log.debug(
             "backlog solved", gangs=len(backlog),
             placed=result.num_placed, unplaced=len(result.unplaced),

@@ -7,8 +7,10 @@ spent its time. This module is the missing decomposition layer:
 
   Span / Tracer     — parent/child spans threaded through the hot paths
                       (manager reconciles, scheduler pre_round/solve/bind,
-                      engine encode/device/repair, kubelet pod lifecycle,
-                      node-monitor evict/drain). Every span carries BOTH
+                      the engine's collapsed `engine.fused` span — or
+                      encode/device/repair children on the split path —
+                      kubelet pod lifecycle, node-monitor evict/drain).
+                      Every span carries BOTH
                       virtual-clock timestamps (v0/v1 — causality and the
                       GangTimeline sum contract run on the simulated
                       clock) and wall perf_counter times (t0/t1 — a whole

@@ -51,8 +51,9 @@ class PlacementService:
         self.engine_kwargs = engine_kwargs
         self.max_epochs = max_epochs
         #: observability.tracing span tracer, shared with every engine
-        #: this service builds (engine.encode/device/repair spans land in
-        #: it; the Debug RPC reports its summary). Default disabled —
+        #: this service builds (engine.fused — or encode/device/repair
+        #: on the split path — spans land in it; the Debug RPC reports
+        #: its summary). Default disabled —
         #: and the recording Tracer is single-threaded, so enable it only
         #: with max_workers=1 or for in-process/debug use.
         from ..observability.explain import DecisionLog

@@ -229,6 +229,8 @@ class TestConfigChangesBehavior:
             "native_repair": False,
             "state_cache": True,
             "state_verify": True,
+            "fused": True,
+            "incremental": True,
         }
         assert all(p.node_name for p in h.store.list(Pod.KIND))
 

@@ -434,6 +434,331 @@ class TestKwargGating:
         assert all(p.node_name for p in h.store.scan(Pod.KIND))
 
 
+def _full_reference(snap, gangs, free=None, fairness=None):
+    """Fresh pre-PR7 reference solve: cache off, split dispatches, no
+    incremental — the semantics every fast path must reproduce bitwise."""
+    eng = PlacementEngine(snap, state_cache=False, fused=False,
+                          incremental=False)
+    return eng.solve(gangs, free=free, fairness=fairness)
+
+
+def assert_same_placements(a, b):
+    assert sorted(a.placed) == sorted(b.placed)
+    for name in a.placed:
+        np.testing.assert_array_equal(
+            a.placed[name].node_indices, b.placed[name].node_indices
+        )
+    assert a.unplaced == b.unplaced
+
+
+class TestFusedStaging:
+    """The fused path stages _sync_free deltas into the next device
+    launch instead of dispatching a standalone scatter — one program
+    launch per warm solve, with the mirror/epoch committing at sync time
+    and the device buffer catching up at the launch."""
+
+    def test_warm_solve_is_one_fused_dispatch(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True, incremental=False)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
+        eng.solve(gangs, free=snap.free.copy())
+        assert eng._dispatches == {"fused": 1, "split": 0,
+                                   "incremental": 0}
+
+    def test_staged_delta_rides_the_fused_launch(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True, incremental=False)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
+        eng.solve(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[2] *= 0.5
+        eng.note_free_rows([2])
+        res = eng.solve(gangs, free=free.copy())
+        assert res.num_placed == 4
+        # the delta was counted as an upload but rode the fused launch:
+        # no standalone scatter (= no split dispatch), nothing staged
+        # left behind, and the resident buffer caught up exactly
+        assert eng._state.delta_uploads == 1
+        assert eng._dispatches == {"fused": 2, "split": 0,
+                                   "incremental": 0}
+        assert eng._staged is None
+        np.testing.assert_array_equal(
+            decoded_state(eng), eng._masked_free(free)
+        )
+
+    def test_staged_rows_merge_latest_and_full_upload_supersedes(self):
+        snap = cluster(blocks=4, racks=4, hosts=8, cpu=16.0)  # 128 nodes
+        eng = PlacementEngine(snap)
+        assert snap.num_nodes > eng._delta_rows_max
+        free = snap.free.copy()
+        eng._sync_free(free)
+        free[3] *= 0.5
+        eng.note_free_rows([3])
+        eng._sync_free(free, defer=True)
+        assert eng._staged is not None and 3 in eng._staged
+        free[3] *= 0.5  # re-stage the same row: latest values win
+        eng.note_free_rows([3])
+        eng._sync_free(free, defer=True)
+        np.testing.assert_array_equal(
+            eng._staged[3], eng._masked_free(free)[3]
+        )
+        # bulk divergence forces a full upload, which supersedes the
+        # staged rows (re-scattering them would write stale values)
+        free *= 0.25
+        eng.note_free_rows(range(snap.num_nodes))
+        eng._sync_free(free, defer=True)
+        assert eng._staged is None
+        np.testing.assert_array_equal(
+            decoded_state(eng), eng._masked_free(free)
+        )
+
+    def test_verify_accounts_for_staged_rows(self):
+        """With rows staged (device buffer lagging), the verify tripwire
+        must not false-alarm — and must still fire on a genuine breach."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True)
+        free = snap.free.copy()
+        eng._sync_free(free)
+        free[1] *= 0.5
+        eng.note_free_rows([1])
+        eng._sync_free(free, defer=True)  # staged; verify ran clean
+        # a row-scoped declaration that EXCLUDES a mutated row is the
+        # breach (with no declaration the full diff stays correct)
+        free[4] *= 0.5
+        eng.note_free_rows([2])
+        with pytest.raises(RuntimeError, match="not declared"):
+            eng._sync_free(free, defer=True)
+
+    def test_split_engine_keeps_standalone_scatter(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, fused=False)
+        gangs = [gang("a", pods=2, cpu=2.0)]
+        eng.solve(gangs, free=snap.free.copy())
+        free = snap.free.copy()
+        free[2] *= 0.5
+        eng.note_free_rows([2])
+        eng.solve(gangs, free=free.copy())
+        # split regime: score launches + the standalone delta scatter
+        assert eng._dispatches["fused"] == 0
+        assert eng._dispatches["split"] == 3  # 2 scores + 1 scatter
+
+
+class TestIncremental:
+    """Dirty-row re-solve tiers: zero-dispatch reuse for an unchanged
+    backlog, O(dirty) re-score for a churn tick, full-solve fallback on
+    any invalidation — all bit-equal to the full reference."""
+
+    def _armed(self, n_gangs=6):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(n_gangs)]
+        first = eng.solve(gangs, free=snap.free.copy())
+        assert first.num_placed == n_gangs
+        return snap, eng, gangs
+
+    def test_identical_retry_tick_reuses_without_dispatch(self):
+        snap, eng, gangs = self._armed()
+        before = dict(eng._dispatches)
+        res = eng.solve(gangs, free=snap.free.copy())
+        assert res.stats.get("reused") == 1.0
+        assert eng._dispatches == before  # zero device launches
+        assert eng._inc_reuse_hits == 1
+        assert_same_placements(res, _full_reference(snap, gangs))
+
+    def test_dirty_tick_rescores_only_dirty_rows_bit_equal(self):
+        snap, eng, gangs = self._armed()
+        gangs[1] = gang("h1", pods=2, cpu=3.0)
+        gangs[4] = gang("h4", pods=2, cpu=1.0)
+        free = snap.free.copy()
+        res = eng.solve(gangs, free=free)
+        assert res.stats.get("incremental") == 1.0
+        assert res.stats.get("incremental_rows") == 2.0
+        assert eng._dispatches["incremental"] == 1
+        ref_free = snap.free.copy()
+        ref = _full_reference(snap, gangs, free=ref_free)
+        assert_same_placements(res, ref)
+        np.testing.assert_array_equal(free, ref_free)
+
+    def test_removed_gangs_ride_the_permutation(self):
+        snap, eng, gangs = self._armed()
+        subset = gangs[:3] + gangs[4:]  # one gang left the backlog
+        res = eng.solve(subset, free=snap.free.copy())
+        assert res.stats.get("incremental") == 1.0
+        assert res.stats.get("incremental_rows") == 0.0
+        assert_same_placements(res, _full_reference(snap, subset))
+
+    def test_fairness_change_dirties_the_gang(self):
+        snap, eng, gangs = self._armed()
+        fair = {"g2": 0.75}
+        res = eng.solve(gangs, free=snap.free.copy(), fairness=fair)
+        assert res.stats.get("incremental") == 1.0
+        assert res.stats.get("incremental_rows") == 1.0
+        assert_same_placements(
+            res, _full_reference(snap, gangs, fairness=fair)
+        )
+
+    def test_epoch_divergence_falls_back_then_resumes(self):
+        snap, eng, gangs = self._armed()
+        free = snap.free.copy()
+        free[2] *= 0.5
+        eng.note_free_rows([2])
+        res = eng.solve(gangs, free=free.copy())
+        assert "incremental" not in res.stats
+        assert "reused" not in res.stats
+        assert_same_placements(
+            res, _full_reference(snap, gangs, free=free.copy())
+        )
+        # the full solve re-armed the cache on the NEW content: a dirty
+        # tick against it rides the incremental path again
+        gangs[0] = gang("h0", pods=2, cpu=2.0)
+        res2 = eng.solve(gangs, free=free.copy())
+        assert res2.stats.get("incremental") == 1.0
+        assert_same_placements(
+            res2, _full_reference(snap, gangs, free=free.copy())
+        )
+
+    def test_mostly_dirty_backlog_takes_the_full_path(self):
+        snap, eng, gangs = self._armed()
+        fresh = [gang(f"x{i}", pods=2, cpu=2.0) for i in range(6)]
+        res = eng.solve(fresh, free=snap.free.copy())
+        assert "incremental" not in res.stats
+        assert_same_placements(res, _full_reference(snap, fresh))
+
+    def test_dispatch_adoption_of_incremental_scores(self):
+        snap, eng, gangs = self._armed()
+        gangs[2] = gang("h2", pods=2, cpu=2.5)
+        handle = eng.dispatch(gangs, free=snap.free.copy())
+        assert handle.path == "incremental" and handle.rows == 1
+        res = eng.solve(gangs, free=snap.free.copy(), dispatch=handle)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        assert res.stats.get("incremental") == 1.0
+        assert_same_placements(res, _full_reference(snap, gangs))
+
+    def test_invalidate_clears_the_value_cache(self):
+        snap, eng, gangs = self._armed()
+        eng.invalidate_device_state()
+        assert eng._inc is None
+        res = eng.solve(gangs, free=snap.free.copy())
+        assert "incremental" not in res.stats and "reused" not in res.stats
+        assert_same_placements(res, _full_reference(snap, gangs))
+
+    def test_metrics_and_debug_summary(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        registry = MetricsRegistry()
+        eng = PlacementEngine(snap, metrics=registry)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(5)]
+        eng.solve(gangs, free=snap.free.copy())
+        eng.solve(gangs, free=snap.free.copy())  # reuse tier
+        gangs[0] = gang("h0", pods=2, cpu=2.0)
+        eng.solve(gangs, free=snap.free.copy())  # incremental tier
+        disp = registry.counter("grove_solver_dispatches_total")
+        assert disp.value(kind="fused") == 1.0
+        assert disp.value(kind="incremental") == 1.0
+        rows = registry.counter("grove_solver_incremental_rows_total")
+        assert rows.total() == 1.0
+        ds = eng.debug_summary()["device_state"]
+        assert ds["fused"] and ds["incremental"]
+        assert ds["dispatches"] == {"fused": 1, "split": 0,
+                                    "incremental": 1}
+        assert ds["incremental_rows"] == 1
+        assert ds["reuse_hits"] == 1
+        assert ds["value_cache_resident"]
+
+
+class TestIncrementalChaosFallback:
+    """Node faults between dirty ticks — fail_node/recover_node/cordon
+    all land as rebind()s or full rebuilds on the engine — must force
+    the FULL-solve fallback, never a stale re-score against the old
+    schedulable mask."""
+
+    def test_cordon_shaped_rebind_between_dirty_ticks(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(6)]
+        eng.solve(gangs, free=snap.free.copy())
+        gangs[0] = gang("h0", pods=2, cpu=2.0)
+        res = eng.solve(gangs, free=snap.free.copy())
+        assert res.stats.get("incremental") == 1.0
+        # node 0 cordons between ticks: the rebind must clear the value
+        # cache (cached rows embed the old mask)
+        snap2 = flip_schedulable(eng.snapshot, [0])
+        assert eng.rebind(snap2)
+        assert eng._inc is None
+        gangs[1] = gang("h1", pods=2, cpu=2.0)
+        res2 = eng.solve(gangs, free=snap2.free.copy())
+        assert "incremental" not in res2.stats
+        assert "reused" not in res2.stats
+        used = np.concatenate(
+            [p.node_indices for p in res2.placed.values()]
+        )
+        assert 0 not in used  # a stale re-score could land here
+        assert_same_placements(
+            res2,
+            _full_reference(snap2, gangs, free=snap2.free.copy()),
+        )
+        # uncordon rides rebind the same way, and the tier resumes
+        # after one full solve re-arms the cache on the new mask
+        snap3 = flip_schedulable(eng.snapshot, [0])
+        assert eng.rebind(snap3)
+        eng.solve(gangs, free=snap3.free.copy())
+        gangs[2] = gang("h2", pods=2, cpu=2.0)
+        res3 = eng.solve(gangs, free=snap3.free.copy())
+        assert res3.stats.get("incremental") == 1.0
+        assert_same_placements(
+            res3,
+            _full_reference(snap3, gangs, free=snap3.free.copy()),
+        )
+
+    def test_fail_recover_cordon_between_ticks_under_verify(self):
+        """Full control-plane version: dirty ticks (new workloads) are
+        interleaved with fail_node -> recover_node -> cordon/uncordon;
+        with the incremental engine + verify tripwire armed (the
+        deployed default config), every gang must still repair onto live
+        capacity and no stale-state RuntimeError may fire."""
+        from test_e2e_basic import clique, simple_pcs
+
+        h = Harness(
+            nodes=make_nodes(16),
+            config={"solver": {"device_state_verify": True}},
+        )
+        h.apply(simple_pcs(cliques=[clique("w", replicas=4)], replicas=2))
+        h.settle()
+        from grove_tpu.api.types import Pod
+
+        bound = [p for p in h.store.scan(Pod.KIND) if p.node_name]
+        assert len(bound) == 8
+        victim = bound[0].node_name
+        h.cluster.fail_node(victim)
+        h.clock.advance(120.0)
+        h.settle()
+        # dirty tick while the node is down
+        h.apply(simple_pcs(name="tick-a",
+                           cliques=[clique("w", replicas=2)], replicas=1))
+        h.settle()
+        h.cluster.recover_node(victim)
+        h.settle()
+        h.cluster.cordon(victim)
+        h.settle()
+        # dirty tick under the cordon: nothing may land on the victim
+        h.apply(simple_pcs(name="tick-b",
+                           cliques=[clique("w", replicas=2)], replicas=1))
+        h.settle()
+        pods = list(h.store.scan(Pod.KIND))
+        assert all(p.node_name for p in pods)
+        assert all(
+            p.node_name != victim
+            for p in pods
+            if p.metadata.labels.get("app.kubernetes.io/part-of")
+            == "tick-b"
+        )
+        h.cluster.uncordon(victim)
+        h.settle()
+        # the deployed default engine is fused (+ incremental)
+        summary = h.scheduler.debug_state()["engine"]
+        assert summary["device_state"]["fused"]
+        assert summary["device_state"]["incremental"]
+
+
 def _placements(store) -> dict:
     from grove_tpu.api.types import Pod
 
@@ -446,10 +771,12 @@ def _placements(store) -> dict:
 @pytest.mark.chaos
 class TestChaosEquivalence:
     """Seeded node-fault storms (node_flap, domain_outage) solved by the
-    delta engine (with the verify tripwire armed) and the full-re-encode
-    engine must land every pod on the SAME node: chaos draws are
-    bit-reproducible per seed, so any divergence is the state cache
-    changing placements."""
+    fused+incremental engine (the deployed default, verify tripwire
+    armed), the split delta engine, and the full-re-encode engine must
+    land every pod on the SAME node: chaos draws are bit-reproducible
+    per seed, so any divergence is a fast path changing placements —
+    and node faults between solves exercise exactly the rebind/rebuild
+    invalidations the incremental bookkeeping must honor."""
 
     @pytest.mark.parametrize("seed", (3, 9))
     def test_node_fault_seed_places_identically(self, seed):
@@ -461,7 +788,13 @@ class TestChaosEquivalence:
         for cfg in (
             {"solver": {"device_state_cache": True,
                         "device_state_verify": True}},
-            {"solver": {"device_state_cache": False}},
+            {"solver": {"device_state_cache": True,
+                        "device_state_verify": True,
+                        "fused_solve": False,
+                        "incremental_resolve": False}},
+            {"solver": {"device_state_cache": False,
+                        "fused_solve": False,
+                        "incremental_resolve": False}},
         ):
             plan = FaultPlan.from_seed(
                 seed,
@@ -475,4 +808,4 @@ class TestChaosEquivalence:
                 "domain_outage", 0
             ) > 0, "a storm that injects no node faults proves nothing"
             runs.append(_placements(ch.raw_store))
-        assert runs[0] == runs[1]
+        assert runs[0] == runs[1] == runs[2]
