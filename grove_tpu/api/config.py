@@ -57,6 +57,25 @@ class ControllerConfig:
     # sweeps, gang-aware drain). Disable for pure placement benchmarks
     # that want zero per-node control-plane overhead.
     node_monitor_enabled: bool = True
+    # Horizontally sharded control plane (controller/sharding.py): 1 = the
+    # classic single ControllerManager; N > 1 runs N worker replicas, each
+    # a full manager + reconciler set over the same store, with reconcile
+    # keys partitioned across them by consistent hashing and ownership
+    # published through a leader-owned ShardMap + per-worker Leases. A
+    # crashed worker's shards hand off to survivors after its lease
+    # expires (orphaned-lease detection), bounding failover by one lease
+    # duration.
+    shards: int = 1
+    # Worker heartbeat-lease lifetime: the leader declares a worker dead —
+    # and force-reassigns its shards — once its lease lags the virtual
+    # clock by more than this. The failover-recovery bound.
+    shard_lease_duration_seconds: float = 10.0
+    # Per-round write batching (controller/concurrency.WriteBatch): defer
+    # coalescable status/event writes to one end-of-round flush through
+    # the slow-start batcher, cutting per-object write overhead on the
+    # settle hot path. Off = every write lands inline (pre-sharding
+    # behavior), kept for A/B benches.
+    round_write_batching: bool = True
 
 
 @dataclass
@@ -378,6 +397,16 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         )
     if not isinstance(cc.node_monitor_enabled, bool):
         errs.append("config.controllers.node_monitor_enabled: must be a bool")
+    if not _int(cc.shards) or cc.shards < 1:
+        errs.append("config.controllers.shards: must be an int >= 1")
+    if not _num(cc.shard_lease_duration_seconds) or (
+        cc.shard_lease_duration_seconds <= 0
+    ):
+        errs.append(
+            "config.controllers.shard_lease_duration_seconds: must be > 0"
+        )
+    if not isinstance(cc.round_write_batching, bool):
+        errs.append("config.controllers.round_write_batching: must be a bool")
 
     cl = cfg.cluster
     if not _num(cl.node_lease_duration_seconds) or cl.node_lease_duration_seconds <= 0:
@@ -445,6 +474,15 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     if not _num(le.lease_duration_seconds) or le.lease_duration_seconds <= 0:
         errs.append(
             "config.leader_election.lease_duration_seconds: must be > 0"
+        )
+    if le.enabled is True and _int(cc.shards) and cc.shards > 1:
+        # the sharded control plane elects its own coordinator among the
+        # worker replicas; gating every worker behind one whole-manager
+        # lease would serialize them back to a single active replica
+        errs.append(
+            "config.leader_election.enabled: incompatible with "
+            "config.controllers.shards > 1 (the sharded control plane "
+            "runs its own coordinator election; see docs/operations.md)"
         )
 
     if not _num(cfg.autoscaler.tolerance) or not (0 <= cfg.autoscaler.tolerance < 1):

@@ -68,7 +68,10 @@ def settled_fingerprint(store) -> dict[str, Any]:
     counts = {
         kind: n
         for kind, n in store.object_counts().items()
-        if kind not in ("Event", "Lease")
+        # coordination objects are bookkeeping, not workload state: a
+        # sharded run carries a ShardMap (and worker Leases) a
+        # single-replica fault-free baseline never has
+        if kind not in ("Event", "Lease", "ShardMap")
     }
     return {"pods": pods, "cliques": cliques, "sets": sets, "counts": counts}
 
@@ -153,6 +156,16 @@ class ChaosHarness:
         #: PCS keys; all deleted at disarm so the recovered fixpoint
         #: matches the fault-free run)
         self._skew_workloads: list[tuple[str, str]] = []
+        #: shard-fault bookkeeping: crashed worker indices (revived at
+        #: disarm; shards fail over meanwhile via orphaned-lease
+        #: detection)
+        self._crashed_workers: set[int] = set()
+        sharded = self._sharded
+        if sharded is not None:
+            # the ownership audit rides every chaos round: a key
+            # reconciled by two live workers in one round fails the seed
+            # loudly instead of converging by luck
+            sharded.audit = True
 
     #: drain storms are capped per run: an unbounded storm could cordon
     #: the whole inventory out from under the workload, and a drained
@@ -200,12 +213,21 @@ class ChaosHarness:
             "chaos faults injected by type",
         ).inc(type=fault_type)
 
+    @property
+    def _sharded(self):
+        """The ShardedManager when the config runs shards > 1, else
+        None (shard faults are skipped on a single-replica manager)."""
+        manager = self.harness.manager
+        return manager if hasattr(manager, "workers") else None
+
     def restart_manager(self) -> None:
         """Operator process crash-restart: a brand-new manager (event
         cursor 0 — it replays the log, or relists past a compaction
         horizon) and brand-new reconcilers (every in-memory cache —
         scheduler reservations, expectation marks — rebuilt from the
-        store), over the same chaos-wrapped store."""
+        store), over the same chaos-wrapped store. Under a sharded
+        control plane this models the whole fleet process restarting:
+        fresh workers adopt the persisted ShardMap and replay."""
         self.manager_restarts += 1
         if self.harness.cluster.metrics is not None:
             self.harness.cluster.metrics.counter(
@@ -213,6 +235,10 @@ class ChaosHarness:
                 "chaos-injected manager crash-restarts",
             ).inc()
         self.harness._build_manager()
+        sharded = self._sharded
+        if sharded is not None:
+            sharded.audit = True
+            self._crashed_workers.clear()  # the rebuild revived everyone
 
     # -- node-lifecycle faults ---------------------------------------------
     def _live_node_names(self) -> list[str]:
@@ -354,6 +380,57 @@ class ChaosHarness:
             self.raw_store.create(pcs)
             self._skew_workloads.append((ns, name))
 
+    def _inject_shard_faults(self) -> None:
+        """Per-step sharded-control-plane fault draws (see FaultPlan):
+        worker crash, frozen shard-map view, handoff storm. Guarded on
+        rate > 0 BEFORE any draw — pre-existing seeds keep their exact
+        sequences — and skipped entirely on a single-replica manager."""
+        plan = self.plan
+        sharded = self._sharded
+        if sharded is None:
+            return
+        if plan.shard_crash_rate > 0 and plan.flip(plan.shard_crash_rate):
+            live = [w.index for w in sharded.workers if w.alive]
+            if len(live) > 1:
+                idx = live[plan.pick(len(live))]
+                if sharded.kill_worker(idx):
+                    self._record("shard_crash")
+                    self._crashed_workers.add(idx)
+        if plan.shard_map_stale_rate > 0 and plan.flip(
+            plan.shard_map_stale_rate
+        ):
+            live = [w for w in sharded.workers if w.alive]
+            if live:
+                w = live[plan.pick(len(live))]
+                self._record("shard_map_stale")
+                # a few steps of frozen map view: within one lease
+                # duration the worker keeps serving its cached shards
+                # (safe: pending moves wait for ITS release), past it
+                # the worker defers until the hold expires
+                w.stale_map_hold += 2 + plan.pick(4)
+        if plan.handoff_storm_rate > 0 and plan.flip(
+            plan.handoff_storm_rate
+        ):
+            live = [w.index for w in sharded.workers if w.alive]
+            if len(live) > 1:
+                idx = live[plan.pick(len(live))]
+                if sharded.chaos_revoke_worker(idx):
+                    self._record("handoff_storm")
+
+    def _repair_shards(self) -> None:
+        """Disarm-time repair: crashed workers revive (fresh process,
+        replay + relist) and frozen map views thaw — the recovered
+        fixpoint is measured against a whole fleet, like every other
+        fault class."""
+        sharded = self._sharded
+        if sharded is None:
+            return
+        for idx in sorted(self._crashed_workers):
+            sharded.revive_worker(idx)
+        self._crashed_workers.clear()
+        for w in sharded.workers:
+            w.stale_map_hold = 0
+
     def _tick_node_faults(self) -> None:
         """End-of-step flap timers: expired flaps resume heartbeating
         (the node then rides the monitor's stable-ready window back in)."""
@@ -422,6 +499,7 @@ class ChaosHarness:
                 ):
                     self._record("tenant_skew")
                     self._inject_tenant_skew()
+                self._inject_shard_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")
@@ -437,6 +515,7 @@ class ChaosHarness:
         finally:
             self.chaos_store.armed = False
             self._repair_infrastructure()
+            self._repair_shards()
         self.settle_recovered()
 
     def settle_recovered(self, max_iters: int = 64) -> None:
@@ -482,13 +561,14 @@ class ChaosHarness:
         from ..api.podgang import PodGang, PodGangConditionType
 
         decisions = self.harness.cluster.decisions
+        sharded = self._sharded
         unscheduled = []
         for g in self.raw_store.scan(PodGang.KIND):
             cond = get_condition(
                 g.status.conditions, PodGangConditionType.SCHEDULED.value
             )
             if cond is None or cond.status != "True":
-                unscheduled.append({
+                entry = {
                     "kind": g.KIND,
                     "name": f"{g.metadata.namespace}/{g.metadata.name}",
                     "phase": g.status.phase.value,
@@ -501,7 +581,17 @@ class ChaosHarness:
                     "explain": decisions.explain(
                         g.metadata.namespace, g.metadata.name
                     ),
-                })
+                }
+                if sharded is not None:
+                    # the postmortem names the SHARD, not just the gang:
+                    # its own key's owner plus the scheduler singleton's
+                    # (the gang binds wherever "schedule" is owned)
+                    s, owner = sharded.shard_owner(
+                        g.metadata.namespace, g.metadata.name
+                    )
+                    entry["shard"] = s
+                    entry["shard_owner"] = owner
+                unscheduled.append(entry)
         stuck_pods = []
         for p in self.raw_store.scan(Pod.KIND):
             if p.metadata.deletion_timestamp is not None:
@@ -527,9 +617,16 @@ class ChaosHarness:
                     "errors": list(c.status.last_errors),
                 })
         manager = self.harness.manager
+        sharding = None
+        if sharded is not None:
+            sharding = sharded.debug_state()
+            sharding["scheduler_owner"] = sharded.shard_owner(
+                "", "schedule"
+            )[1]
         return {
             "seed": self.plan.seed,
             "virtual_clock": self.clock.now(),
+            **({"sharding": sharding} if sharding is not None else {}),
             "unscheduled_gangs": unscheduled,
             "stuck_pods": stuck_pods,
             "lagging_cliques": lagging_cliques,

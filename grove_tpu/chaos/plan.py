@@ -87,6 +87,29 @@ class FaultPlan:
     #: gangs per injected skew burst
     tenant_skew_burst: int = 3
 
+    # sharded-control-plane faults (per chaos step; meaningful only when
+    # the harness runs controllers.shards > 1 — the driver skips them on
+    # a single-replica manager). DEFAULT 0 with the runtime draws guarded
+    # on rate > 0 (same contract as tenant_skew), so every pre-existing
+    # seed's draw sequence — and its verified convergence — is
+    # bit-identical.
+    #   shard_crash     — one worker replica dies (stops stepping, stops
+    #                     renewing); its shards must fail over to the
+    #                     survivors within one shard-lease duration, and
+    #                     the worker revives at disarm
+    #   shard_map_stale — one worker's shard-map refresh freezes for a
+    #                     few steps (the lagging-informer model): it may
+    #                     keep serving its cached shards but must DEFER
+    #                     once the view ages past one lease duration,
+    #                     never fighting a handed-off successor
+    #   handoff_storm   — every shard of one live worker is revoked via
+    #                     two-phase pending moves, driving a wave of
+    #                     release handoffs + relists through the normal
+    #                     protocol mid-fault-storm
+    shard_crash_rate: float = 0.0
+    shard_map_stale_rate: float = 0.0
+    handoff_storm_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

@@ -55,6 +55,92 @@ class RunResult:
         )
 
 
+class WriteBatch:
+    """Round-scoped write coalescing, flushed through the slow-start
+    batcher.
+
+    The settle hot path was dominated by per-object status/event store
+    writes (BENCH_r05: ~95% of control-plane settle is host-side Python,
+    and the tracer names the write machinery inside the reconcile spans).
+    Controllers that can tolerate end-of-round visibility enqueue their
+    writes here instead of landing them inline; the manager flushes ONCE
+    per reconcile round via `run_with_slow_start`, so a failing store
+    (admission hook, chaos write fault) sees one probe write, not the
+    whole round's worth — and repeated writes to the same key within a
+    round collapse to one store op.
+
+    Two enqueue shapes:
+
+      put(key, name, fn)          last-wins: a later put for the same key
+                                  REPLACES the earlier one. fn must be a
+                                  full idempotent write that re-derives
+                                  its content from live store state at
+                                  flush time (deferral legally shifts the
+                                  read later).
+      append(key, name, fn, item) accumulate: items for one key collect
+                                  into a list; at flush fn(items) runs
+                                  once (event-count compaction rides
+                                  this).
+
+    Ordering: first-enqueue order per key (a replaced put keeps its
+    original slot), so flush-time write order is deterministic.
+    """
+
+    __slots__ = ("_tasks",)
+
+    def __init__(self) -> None:
+        #: key -> [name, fn, items-or-None]; dict insertion order is the
+        #: flush order
+        self._tasks: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    def put(self, key, name: str, fn: Callable[[], None]) -> bool:
+        """Enqueue a last-wins write task. Returns True when it coalesced
+        over (replaced) an earlier task for the same key."""
+        existed = key in self._tasks
+        if existed:
+            self._tasks[key][1] = fn
+        else:
+            self._tasks[key] = [name, fn, None]
+        return existed
+
+    def append(self, key, name: str, fn, item) -> bool:
+        """Enqueue an accumulating task: at flush, `fn(items)` runs once
+        with every item appended for this key. Returns True when the item
+        joined an existing task (coalesced)."""
+        entry = self._tasks.get(key)
+        if entry is not None:
+            entry[2].append(item)
+            return True
+        self._tasks[key] = [name, fn, [item]]
+        return False
+
+    def flush(self) -> RunResult:
+        """Run every pending task through the slow-start batcher and
+        clear. Tasks enqueued DURING the flush (a write handler recording
+        a follow-on event) land in the next round's batch. Failed and
+        slow-start-skipped tasks are RE-QUEUED for the next flush (their
+        fns re-derive from live state, so a late retry stays correct) —
+        a transient store fault costs one probe write and a round of
+        latency, never a lost status."""
+        tasks, self._tasks = self._tasks, {}
+        if not tasks:
+            return RunResult()
+        result = run_with_slow_start([
+            (name, fn if items is None else (lambda f=fn, it=items: f(it)))
+            for name, fn, items in tasks.values()
+        ])
+        if result.errors or result.skipped:
+            retry = {n for n, _ in result.errors}
+            retry.update(result.skipped)
+            for key, entry in tasks.items():
+                if entry[0] in retry and key not in self._tasks:
+                    self._tasks[key] = entry
+        return result
+
+
 def run_with_slow_start(
     tasks: list[tuple[str, Callable[[], None]]],
     initial_batch_size: int = INITIAL_BATCH_SIZE,

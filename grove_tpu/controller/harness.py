@@ -59,54 +59,119 @@ class Harness:
         self._engine_cls = engine_cls
         self._build_manager()
 
+    def _make_manager(self, name: str, elector=None):
+        """Build ONE fully registered ControllerManager + reconciler set
+        over the shared store — the single-replica manager and every
+        sharded worker replica are each one of these. Returns
+        (manager, components) with the named reconciler instances."""
+        cc = self.config.controllers
+        manager = ControllerManager(
+            self.store,
+            identity=self.config.authorization.operator_identity,
+            error_backoff_base_seconds=cc.error_backoff_base_seconds,
+            error_backoff_max_seconds=cc.error_backoff_max_seconds,
+            error_retry_budget=cc.error_retry_budget,
+            logger=self.cluster.logger.with_name(name),
+            metrics=self.cluster.metrics,
+            elector=elector,
+            # re-read on every (re)build: the chaos harness enables
+            # tracing after Cluster construction, and a crash-restarted
+            # manager must keep feeding the same flight recorder
+            tracer=self.cluster.tracer,
+            round_write_batching=cc.round_write_batching,
+        )
+        manager.register(
+            PodCliqueSetReconciler(self.store, config=self.config)
+        )
+        manager.register(PCSGReconciler(self.store))
+        manager.register(
+            PodCliqueReconciler(
+                self.store, retry_seconds=cc.sync_retry_interval_seconds
+            )
+        )
+        kwargs = {"engine_cls": self._engine_cls} if self._engine_cls else {}
+        scheduler = GangScheduler(self.cluster, **kwargs)
+        manager.register(scheduler)
+        from .autoscaler import Autoscaler
+
+        autoscaler = Autoscaler(self.cluster)
+        manager.register(autoscaler)
+        # node lifecycle last: its writes (Ready flips, eviction sweeps,
+        # drain evictions) land as events for the next round's workload
+        # controllers, and a crash-restart rebuilds its stabilization
+        # state conservatively like every other in-memory cache
+        node_monitor = None
+        if self.config.controllers.node_monitor_enabled:
+            from .nodemonitor import NodeMonitor
+
+            node_monitor = NodeMonitor(self.cluster)
+            manager.register(node_monitor)
+        return manager, {
+            "scheduler": scheduler,
+            "autoscaler": autoscaler,
+            "node_monitor": node_monitor,
+        }
+
     def _build_manager(self) -> None:
         """(Re)build the manager + a fresh set of reconcilers over the
         SAME store. Called once from __init__ — and again by the chaos
         harness to model an operator process crash-restart: a new manager
         starts with event cursor 0 (replaying, or relisting past a
         compaction horizon) and reconcilers rebuild every in-memory cache
-        from the store, exactly like a restarted operator binary."""
+        from the store, exactly like a restarted operator binary.
+
+        With controllers.shards > 1 this builds the horizontally sharded
+        control plane instead (controller/sharding.py): N full worker
+        replicas behind a leader-owned shard map; the harness-facing
+        `manager` surface stays the same. The named component attributes
+        (`scheduler`/`autoscaler`/`node_monitor`) then point at the
+        worker that owns each singleton's shard at bootstrap — good for
+        dumps and drivers; per-worker instances live on
+        `manager.workers[i].components`."""
         cc = self.config.controllers
-        self.manager = ControllerManager(
+        if cc.shards <= 1:
+            self.manager, comps = self._make_manager(
+                "manager", elector=self.elector
+            )
+            self.scheduler = comps["scheduler"]
+            self.autoscaler = comps["autoscaler"]
+            self.node_monitor = comps["node_monitor"]
+            return
+        from .sharding import ShardedManager
+
+        def build_worker(worker):
+            return self._make_manager(f"manager.{worker.identity}")
+
+        self.manager = ShardedManager(
             self.store,
+            num_workers=cc.shards,
+            lease_duration_seconds=cc.shard_lease_duration_seconds,
+            build_worker=build_worker,
             identity=self.config.authorization.operator_identity,
+            metrics=self.cluster.metrics,
+            logger=self.cluster.logger.with_name("sharded-manager"),
+            tracer=self.cluster.tracer,
             error_backoff_base_seconds=cc.error_backoff_base_seconds,
             error_backoff_max_seconds=cc.error_backoff_max_seconds,
             error_retry_budget=cc.error_retry_budget,
-            logger=self.cluster.logger.with_name("manager"),
-            metrics=self.cluster.metrics,
-            elector=self.elector,
-            # re-read on every (re)build: the chaos harness enables
-            # tracing after Cluster construction, and a crash-restarted
-            # manager must keep feeding the same flight recorder
-            tracer=self.cluster.tracer,
         )
-        self.manager.register(
-            PodCliqueSetReconciler(self.store, config=self.config)
+        # shared-cache prefetch (see ShardedManager.prefetch): the
+        # cluster's incremental usage accounting + topology snapshot are
+        # informer-style watch state; warming them between the workload
+        # passes and the scheduler's step keeps the shared-cache rebuild
+        # off the solve's critical path without changing what the
+        # scheduler reads (the cache is keyed on store state)
+        self.manager.prefetch = self.cluster.topology_snapshot
+        # the scheduler singleton's bootstrap owner (ownership can move
+        # on failover; the sharding debug section tracks the live map)
+        _shard, owner_id = self.manager.shard_owner("", "schedule")
+        owner = next(
+            (w for w in self.manager.workers if w.identity == owner_id),
+            self.manager.workers[0],
         )
-        self.manager.register(PCSGReconciler(self.store))
-        self.manager.register(
-            PodCliqueReconciler(
-                self.store, retry_seconds=cc.sync_retry_interval_seconds
-            )
-        )
-        kwargs = {"engine_cls": self._engine_cls} if self._engine_cls else {}
-        self.scheduler = GangScheduler(self.cluster, **kwargs)
-        self.manager.register(self.scheduler)
-        from .autoscaler import Autoscaler
-
-        self.autoscaler = Autoscaler(self.cluster)
-        self.manager.register(self.autoscaler)
-        # node lifecycle last: its writes (Ready flips, eviction sweeps,
-        # drain evictions) land as events for the next round's workload
-        # controllers, and a crash-restart rebuilds its stabilization
-        # state conservatively like every other in-memory cache
-        self.node_monitor = None
-        if self.config.controllers.node_monitor_enabled:
-            from .nodemonitor import NodeMonitor
-
-            self.node_monitor = NodeMonitor(self.cluster)
-            self.manager.register(self.node_monitor)
+        self.scheduler = owner.components["scheduler"]
+        self.autoscaler = owner.components["autoscaler"]
+        self.node_monitor = owner.components["node_monitor"]
 
     def autoscale(self) -> None:
         """One periodic HPA sweep + settle (the HPA sync interval). The

@@ -246,7 +246,7 @@ class PodCliqueReconciler:
             )
             if pods_dirty:
                 self._sync_pods(pclq)
-            gated = self._reconcile_status(pclq)
+            gated, under = self._reconcile_status(pclq)
         except BaseException:
             # The retry (backoff requeue, or a relist after a manager
             # crash) must re-run the pod component. Guarding only
@@ -256,7 +256,7 @@ class PodCliqueReconciler:
             if pods_dirty:
                 self._pods_dirty.add(key)
             raise
-        if gated:
+        if gated or under:
             # A pod still gated means _remove_gates deferred on state that
             # may have been a stale read (gang not visible yet, base gang
             # not Scheduled yet). Waiting ONLY for the next watch event
@@ -265,6 +265,15 @@ class PodCliqueReconciler:
             # timer, and the retry re-runs the pod component. (The count
             # rides along from _reconcile_status's single pod pass — no
             # second owned-pods scan on this per-pod-event hot path.)
+            #
+            # UNDER-replication arms the same timer: the status flow saw
+            # fewer active pods than spec. Either pods are genuinely
+            # missing (the retry re-runs _sync_pods and creates them) or
+            # a stale read hid pods this reconcile itself created — whose
+            # echoed events are suppressed as our own writes, so no event
+            # will ever re-wake us and the rollup would wedge below spec
+            # forever (node-fault chaos seed; same shape as the
+            # not-visible-with-pending-work starvation from PR 2).
             self._pods_dirty.add(key)
             return Result(requeue_after=self.retry_seconds)
         return Result()
@@ -662,18 +671,22 @@ class PodCliqueReconciler:
                 self._mark_own()
 
     # -- status flow (reconcilestatus.go) ----------------------------------
-    def _reconcile_status(self, pclq: PodClique) -> int:
+    def _reconcile_status(self, pclq: PodClique) -> tuple[int, bool]:
         """Reads live state (peeks); the write goes through patch_status —
         the status flow runs on every reconcile for every clique, so the
         full-object get() clone here dominated settle at 10^3-clique
-        scale. Returns the ACTIVE gated-pod count (computed in the same
-        single pod pass) so reconcile's gated-pod retry timer needs no
-        second owned-pods scan."""
+        scale. Returns (active gated-pod count, under-replicated) from
+        the same single pod pass, so reconcile's retry-timer decisions
+        need no second owned-pods scan. Under-replicated (< spec.replicas
+        active pods VISIBLE) must arm the retry: the pods this very
+        reconcile created can be hidden by a stale read, and their echoed
+        events are suppressed as our own — without the timer the rollup
+        wedges below spec forever (found by the node-fault chaos sweep)."""
         fresh = self.store.peek(
             KIND, pclq.metadata.namespace, pclq.metadata.name
         )
         if fresh is None:
-            return 0
+            return 0, False
         # single pass over the (small) pod list: this flow runs for every
         # clique on every enqueued round at 10^3-clique scale
         pods = []
@@ -741,7 +754,7 @@ class PodCliqueReconciler:
             and cur.selector
             == f"{constants.LABEL_PODCLIQUE}={fresh.metadata.name}"
         ):
-            return gated
+            return gated, len(pods) < fresh.spec.replicas
 
         def mutate(status):
             status.replicas = len(pods)
@@ -781,7 +794,7 @@ class PodCliqueReconciler:
         self.store.patch_status(
             KIND, fresh.metadata.namespace, fresh.metadata.name, mutate
         )
-        return gated
+        return gated, len(pods) < fresh.spec.replicas
 
     def _track_rollout(self, pclq: PodClique, status, pods: list[Pod]) -> None:
         """Per-clique rolling-update status parity (podclique.go:104-137):

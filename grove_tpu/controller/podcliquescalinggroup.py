@@ -65,6 +65,27 @@ class PCSGReconciler:
             self.store, KIND, request.namespace, request.name, err
         )
 
+    def map_events(self, events, enqueue) -> None:
+        """Batched watch predicate (one call per drain round; the
+        runtime hands over only watch_kinds events). Pod events are the
+        bulk of a settle drain and almost always irrelevant here (they
+        only matter mid-rollout), so the batched path's cheap label +
+        rollout-set test replaces a per-event Python call + list return
+        that was measurable at 10^4-event scale. map_event remains the
+        single-event view for direct callers/tests."""
+        name_ = self.name
+        rollout_active = self._rollout_active
+        for event in events:
+            if event.kind == "Pod":
+                if not rollout_active:
+                    continue
+                pcsg = event.obj.metadata.labels.get(constants.LABEL_PCSG)
+                if pcsg and (event.namespace, pcsg) in rollout_active:
+                    enqueue(name_, Request(event.namespace, pcsg))
+                continue
+            for req in self.map_event(event):
+                enqueue(name_, req)
+
     def map_event(self, event: Event) -> list[Request]:
         if event.kind == KIND:
             # own status writes / metadata-only bumps feed nothing here

@@ -74,7 +74,8 @@ class ControllerManager:
                  error_backoff_base_seconds: float = 1.0,
                  error_backoff_max_seconds: float = 60.0,
                  error_retry_budget: int = 8, logger=None,
-                 metrics=None, elector=None, tracer=None):
+                 metrics=None, elector=None, tracer=None,
+                 round_write_batching: bool = True):
         self.store = store
         #: observability.tracing span tracer; the no-op singleton unless
         #: tracing is enabled (one span per reconcile, tagged
@@ -122,6 +123,45 @@ class ControllerManager:
         self._queued: set[tuple[str, Request]] = set()
         self._requeues: list[tuple[float, int, str, Request]] = []
         self._tiebreak = itertools.count()
+        #: optional (controller_name, Request) -> bool ownership predicate
+        #: (controller/sharding.py): when set, requests failing it are
+        #: DROPPED — at enqueue AND again at execution (the shard map can
+        #: move between the two) — because another worker replica owns
+        #: them; its relist-on-gain regenerates the work. None = this
+        #: manager owns everything (the classic single-replica shape).
+        self.request_filter = None
+        #: optional frozenset of controller names whose watch mappings
+        #: _drain_events runs; None = all (the classic shape). A sharded
+        #: worker scopes this to the controllers that can actually
+        #: produce requests it owns (a dedicated scheduler replica skips
+        #: the workload mappers entirely) — safe because any ownership
+        #: GAIN relists through the FULL mapping set (inject_events
+        #: ignores the scope), rebuilding the skipped mappers' state.
+        self.map_scope: frozenset[str] | None = None
+        #: the request batch the last run_once executed (a list alias,
+        #: O(1) to publish): the sharded manager's ownership audit reads
+        #: it to assert no key ran on two workers in one round
+        self.last_batch: list[tuple[str, Request]] = []
+        #: round-scoped write coalescing (concurrency.WriteBatch), wired
+        #: into each registered controller's EventRecorder (and offered
+        #: via bind_round_batch) and flushed once per run_once
+        if round_write_batching:
+            from .concurrency import WriteBatch
+
+            self.round_batch = WriteBatch()
+        else:
+            self.round_batch = None
+        #: True when the elector reported standby on the last run_once —
+        #: surfaced via resilience_snapshot()["standing_by"] and the
+        #: grove_manager_is_leader gauge so a healthy standby is
+        #: distinguishable from a wedged manager from outside
+        self._standing_by = False
+        #: extra labels stamped on this manager's MANAGER-SCOPED gauges
+        #: (workqueue depth, is_leader). Empty for the classic single
+        #: manager; a sharded worker sets {"worker": identity} so N
+        #: replicas sharing one registry export N series instead of
+        #: last-writer-wins on one unlabeled gauge.
+        self.gauge_labels: dict[str, str] = {}
         #: bounded per (controller, request): a permanently failing
         #: reconciler retries forever on the error interval, and unbounded
         #: growth here would leak across a long simulation
@@ -133,6 +173,17 @@ class ControllerManager:
         self.controllers.append(controller)
         self._dispatch: dict[str, list[Reconciler]] = {}
         self._batched: list[Reconciler] | None = None
+        if self.round_batch is not None:
+            # round write batching: the controller's EventRecorder (if it
+            # has one) defers its store writes to the end-of-round flush,
+            # and controllers with coalescable status sweeps opt in via
+            # bind_round_batch (the GangScheduler's phase sweep rides it)
+            recorder = getattr(controller, "recorder", None)
+            if recorder is not None and hasattr(recorder, "batch"):
+                recorder.batch = self.round_batch
+            bind = getattr(controller, "bind_round_batch", None)
+            if bind is not None:
+                bind(self.round_batch)
 
     def _record_error_entry(self, cname: str, req: Request, msg: str) -> None:
         """Append to self.errors, keeping at most max_errors_per_key entries
@@ -154,6 +205,10 @@ class ControllerManager:
 
     # -- queue plumbing ----------------------------------------------------
     def _enqueue(self, controller_name: str, request: Request) -> None:
+        if self.request_filter is not None and not self.request_filter(
+            controller_name, request
+        ):
+            return  # another shard worker owns this key
         key = (controller_name, request)
         if key not in self._queued:
             self._queued.add(key)
@@ -173,6 +228,13 @@ class ControllerManager:
                 self._cursor = events[-1].seq
         if not events:
             return
+        self._map_events(events, self._enqueue, scope=self.map_scope)
+
+    def _map_events(self, events, enqueue, scope=None) -> None:
+        """Route events through every controller's watch mapping into
+        `enqueue` (the _drain_events body, shared with inject_events).
+        `scope` (a frozenset of controller names) narrows which mappers
+        run — see map_scope."""
         # Controllers implementing the BATCHED watch predicate map_events
         # (one call per drain round) are excluded from the per-event
         # dispatch — at 10^4-event settle scale the per-event Python call
@@ -184,22 +246,71 @@ class ControllerManager:
                 if getattr(c, "map_events", None) is not None
             ]
         dispatch = self._dispatch
+        # bucket the batch by kind ONCE: batched mappers receive only
+        # the kinds they watch (in-kind order preserved; the mappers'
+        # store reads see final state, so cross-kind interleaving is not
+        # load-bearing) — without this every batched controller iterated
+        # every event in Python, and at 10^4-event drains × N shard
+        # workers that WAS the drain cost
+        by_kind: dict[str, list] = {}
         for event in events:
-            ctrls = dispatch.get(event.kind)
+            bucket = by_kind.get(event.kind)
+            if bucket is None:
+                bucket = by_kind[event.kind] = []
+            bucket.append(event)
+        for kind, kind_events in by_kind.items():
+            ctrls = dispatch.get(kind)
             if ctrls is None:
-                ctrls = dispatch[event.kind] = [
+                ctrls = dispatch[kind] = [
                     c for c in self.controllers
                     if c not in batched
                     and (
                         getattr(c, "watch_kinds", None) is None
-                        or event.kind in c.watch_kinds
+                        or kind in c.watch_kinds
                     )
                 ]
             for controller in ctrls:
-                for req in controller.map_event(event):
-                    self._enqueue(controller.name, req)
+                if scope is not None and controller.name not in scope:
+                    continue
+                for event in kind_events:
+                    for req in controller.map_event(event):
+                        enqueue(controller.name, req)
         for controller in batched:
-            controller.map_events(events, self._enqueue)
+            if scope is not None and controller.name not in scope:
+                continue
+            kinds = getattr(controller, "watch_kinds", None)
+            if kinds is None:
+                controller.map_events(events, enqueue)
+                continue
+            # watched buckets concatenated in sorted-kind order (stable
+            # under hash randomization); within a kind the event order
+            # is the log order
+            relevant: list = []
+            for k in sorted(kinds):
+                bucket = by_kind.get(k)
+                if bucket:
+                    relevant.extend(bucket)
+            if relevant:
+                controller.map_events(relevant, enqueue)
+
+    def inject_events(self, events, accept=None) -> int:
+        """Feed externally synthesized events (a shard-gain relist)
+        through the watch mappings WITHOUT touching the event cursor.
+        `accept(cname, request) -> bool` narrows what actually enqueues
+        (on top of request_filter); returns the number of requests
+        enqueued."""
+        injected = 0
+
+        def enqueue(cname: str, req: Request) -> None:
+            nonlocal injected
+            if accept is not None and not accept(cname, req):
+                return
+            before = len(self._queue)
+            self._enqueue(cname, req)
+            injected += len(self._queue) - before
+
+        self._map_events(events, enqueue)
+        return injected
 
     def _pop_due_requeues(self) -> None:
         now = self.store.clock.now()
@@ -269,7 +380,10 @@ class ControllerManager:
     def resilience_snapshot(self) -> dict:
         """Retry/breaker introspection for observability.debug: per
         controller the breaker state plus how many requests are in a
-        retry chain and the deepest chain's attempt count."""
+        retry chain and the deepest chain's attempt count — plus the
+        reserved "standing_by" key (True when the last run_once yielded
+        to the leader lease), so operators can tell a healthy standby
+        from a wedged manager without reading the lease object."""
         per: dict[str, dict] = {}
         for (cname, _req), attempts in self._attempts.items():
             entry = per.setdefault(
@@ -283,6 +397,11 @@ class ControllerManager:
             )
         for cname, entry in per.items():
             entry["breaker"] = self.breaker_state(cname)
+        if self.elector is not None:
+            # only standby-CAPABLE managers carry the flag (a manager
+            # without election can never stand by, and its empty snapshot
+            # stays the documented "nothing retrying" shape)
+            per["standing_by"] = self._standing_by
         return per
 
     # -- public introspection (consumed by observability.debug; the
@@ -338,17 +457,40 @@ class ControllerManager:
             else:
                 held = acquire()
             if not held:
+                self._standing_by = True
                 if self.metrics is not None:
-                    # a standby has no queue of its own to report
+                    # a standby has no queue of its own to report — and
+                    # must be tellable from a wedged manager from outside:
+                    # the is_leader gauge + the standing_by resilience
+                    # flag are the operator's "healthy standby" signal
                     self.metrics.gauge(
                         "grove_manager_workqueue_depth",
                         "requests drained into the current reconcile round",
-                    ).set(0.0)
+                    ).set(0.0, **self.gauge_labels)
+                    self.metrics.gauge(
+                        "grove_manager_is_leader",
+                        "1 when this manager holds the leader lease (or "
+                        "runs without election), 0 standing by",
+                    ).set(0.0, **self.gauge_labels)
                 return 0  # standing by
+        self._standing_by = False
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "grove_manager_is_leader",
+                "1 when this manager holds the leader lease (or runs "
+                "without election), 0 standing by",
+            ).set(1.0, **self.gauge_labels)
         self._drain_events()
         self._pop_due_requeues()
         batch, self._queue = self._queue, []
         self._queued -= set(batch)
+        if self.request_filter is not None:
+            # re-check ownership at execution time: the shard map may have
+            # moved a key between enqueue and this round — dropped keys
+            # belong to their new owner, whose relist regenerates them
+            flt = self.request_filter
+            batch = [cr for cr in batch if flt(cr[0], cr[1])]
+        self.last_batch = batch
         by_name = {c.name: c for c in self.controllers}
         # Run the round grouped by controller REGISTRATION order (stable
         # within a controller). Controllers register parents before
@@ -395,7 +537,7 @@ class ControllerManager:
             m.gauge(
                 "grove_manager_workqueue_depth",
                 "requests drained into the current reconcile round",
-            ).set(float(len(batch)))
+            ).set(float(len(batch)), **self.gauge_labels)
         for cname, req in batch:
             controller = by_name[cname]
             # Circuit breaker: an OPEN controller runs nothing — its work
@@ -565,7 +707,47 @@ class ControllerManager:
                         req,
                     ),
                 )
+        self._flush_round_writes()
         return len(batch)
+
+    def _flush_round_writes(self) -> None:
+        """End-of-round flush of the coalesced status/event writes
+        (concurrency.WriteBatch) through the slow-start batcher. Flush
+        errors degrade like reconcile soft-errors: recorded, surfaced to
+        the log, never fatal — the deferred writes are idempotent
+        re-derivations, and the next round's enqueue retries them."""
+        batch = self.round_batch
+        if batch is None or not len(batch):
+            return
+        try:
+            if self.identity is not None:
+                with self.store.impersonate(self.identity):
+                    result = batch.flush()
+            else:
+                result = batch.flush()
+        except Exception as exc:  # defensive: flush itself must not kill
+            self._record_error_entry(
+                "round-writes", Request("", "flush"), str(exc)
+            )
+            return
+        if self.metrics is not None:
+            m = self.metrics.counter(
+                "grove_manager_round_writes_total",
+                "end-of-round batched write flushes by outcome",
+            )
+            m.inc(len(result.succeeded), outcome="flushed")
+            if result.errors:
+                m.inc(len(result.errors), outcome="failed")
+            if result.skipped:
+                m.inc(len(result.skipped), outcome="skipped")
+        for name, err in result.errors:
+            self._record_error_entry(
+                "round-writes", Request("", name), str(err)
+            )
+            if self.logger is not None:
+                self.logger.error(
+                    "round write flush failed", task=name, error=str(err),
+                )
 
     def settle(self, max_rounds: int = 256) -> None:
         """Run until no events are pending and the queue is empty (due

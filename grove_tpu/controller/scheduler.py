@@ -139,6 +139,14 @@ class GangScheduler:
         #: re-checking every pod reference of every scheduled gang on every
         #: event (O(pods) deep copies per readiness flip; VERDICT r1 Weak#4)
         self._dirty: set[tuple[str, str]] = set()
+        #: safety valve for the dirty set: a scheduler instance whose
+        #: reconcile never runs (a sharded worker NOT owning the
+        #: scheduler's singleton shard maps events forever without
+        #: consuming them) must not grow it without bound across gang
+        #: churn. When the cap trips the set clears and the next consumed
+        #: reconcile examines EVERY scheduled gang instead (conservative:
+        #: more work once, never a lost re-examination).
+        self._examine_all = False
         #: scheduled gangs left with unbound (ungated, live) pods after the
         #: last best-effort pass — re-examined on EVERY reconcile and kept
         #: on a retry timer, so freed capacity (node add, other workload
@@ -193,6 +201,12 @@ class GangScheduler:
         #: _feed_free_journal skip the drain entirely (-1 = never drained;
         #: the first drain must run, it returns the unknown-scope None)
         self._free_epoch_seen = -1
+        #: round-scoped WriteBatch installed by the owning manager
+        #: (ControllerManager.register -> bind_round_batch): the per-gang
+        #: phase/Ready sweep defers its patch_status writes to the
+        #: end-of-round flush, coalescing repeat examinations of one gang
+        #: into a single store op derived from flush-time pod state
+        self._round_batch = None
 
     def _mark_own(self) -> None:
         """Record the seq of a PodGang status write this scheduler just
@@ -279,6 +293,9 @@ class GangScheduler:
                 queued = True
             elif kind == ClusterTopology.KIND:
                 queued = True
+        if len(dirty) > 100_000:  # see _examine_all: undrained growth
+            dirty.clear()
+            self._examine_all = True
         if queued:
             enqueue(self.name, _SINGLETON_REQ)
 
@@ -479,15 +496,17 @@ class GangScheduler:
     def reconcile(self, request: Request) -> Result:
         dirty, self._dirty = self._dirty, set()
         starved_prev = self._starved
+        examine_all_prev = self._examine_all
         try:
             return self._reconcile(dirty)
         except Exception:
             # the manager retries on its error interval; the dirty AND
-            # starved sets must survive the failed attempt (_reconcile may
-            # have cleared _starved before raising) or those gangs are
-            # skipped forever
+            # starved sets (and the examine-all valve) must survive the
+            # failed attempt (_reconcile may have cleared them before
+            # raising) or those gangs are skipped forever
             self._dirty |= dirty
             self._starved |= starved_prev
+            self._examine_all = self._examine_all or examine_all_prev
             raise
 
     def debug_state(self) -> dict:
@@ -534,6 +553,8 @@ class GangScheduler:
         # gangs only happens for gangs marked dirty by pod events — plus the
         # starved set, which waits on capacity rather than its own events.
         examine = dirty | self._starved
+        examine_all = self._examine_all
+        self._examine_all = False
         backlog_keys: list[tuple[str, str]] = []
         dirty_scheduled: list[PodGang] = []
         blocked_pending = False
@@ -543,8 +564,10 @@ class GangScheduler:
                 continue
             key = (gang.metadata.namespace, gang.metadata.name)
             if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
-                if key in examine:
+                if examine_all or key in examine:
                     dirty_scheduled.append(gang)
+                    if examine_all:
+                        examine.add(key)
             elif self._gang_ready_to_schedule(gang, pod_bucket=pod_bucket):
                 backlog_keys.append(key)
             elif self._any_referenced_pod_bound(gang, pod_bucket):
@@ -763,16 +786,43 @@ class GangScheduler:
                 ))
         return bool(result.unplaced)
 
+    def bind_round_batch(self, batch) -> None:
+        """Manager wiring hook (ControllerManager.register): install the
+        round-scoped WriteBatch the phase sweep defers into."""
+        self._round_batch = batch
+
     def _update_phases(self, keys: set[tuple[str, str]]) -> None:
         # live kind buckets (read-only): the sweep peeks 8 pods per gang
         # per examined key, and per-peek call overhead was measurable at
         # 10^3-gang scale
         gangs = self.store.kind_bucket(PodGang.KIND)
         pods = self.store.kind_bucket(Pod.KIND)
+        batch = self._round_batch
+        if batch is not None:
+            # defer to the end-of-round flush: the task re-derives phase/
+            # Ready from flush-time pod state (strictly fresher than now),
+            # and a gang examined twice in one round writes once (sorted:
+            # batch insertion order is the flush write order, which must
+            # not depend on set iteration under hash randomization)
+            for key in sorted(keys):
+                batch.put(
+                    (PodGang.KIND, "phase", key),
+                    f"gang-phase/{key[0]}/{key[1]}",
+                    lambda key=key: self._flush_phase(key),
+                )
+            return
         for key in sorted(keys):
             gang = gangs.get(key)
             if gang is not None:  # _update_phase writes via patch_status
                 self._update_phase(gang, pods)
+
+    def _flush_phase(self, key: tuple[str, str]) -> None:
+        """Round-flush body of one deferred phase update: peek the live
+        gang (it may have been deleted since the sweep enqueued) and run
+        the normal change-detected phase write."""
+        gang = self.store.kind_bucket(PodGang.KIND).get(key)
+        if gang is not None and gang.metadata.deletion_timestamp is None:
+            self._update_phase(gang, self.store.kind_bucket(Pod.KIND))
 
     def _any_referenced_pod_bound(self, gang: PodGang,
                                   pod_bucket: dict) -> bool:

@@ -102,6 +102,13 @@ def harness_dump(harness) -> dict[str, Any]:
         "scheduler": scheduler_dump(harness.scheduler),
         "virtual_clock": harness.clock.now(),
     }
+    sharded = getattr(harness.manager, "debug_state", None)
+    if sharded is not None:
+        # the horizontally sharded control plane
+        # (controller/sharding.py): shard map epoch, pending moves,
+        # per-worker liveness/ownership/wall clocks — the runbook's
+        # first stop for "which shard is wedged"
+        out["sharding"] = sharded()
     monitor = getattr(harness, "node_monitor", None)
     if monitor is not None:
         out["node_lifecycle"] = monitor.debug_state()

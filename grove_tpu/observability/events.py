@@ -74,6 +74,14 @@ class EventRecorder:
     def __init__(self, store, controller: str = ""):
         self.store = store
         self.controller = controller
+        #: optional round-scoped controller.concurrency.WriteBatch: when
+        #: the owning manager installs one (ControllerManager.register),
+        #: the STORE write of each record defers to the end-of-round
+        #: flush — identical (object, reason) records within a round
+        #: compact into ONE store op (count += n) instead of n
+        #: read-modify-writes. The flight-recorder copy stays at record
+        #: time (chronology is the point of the flight ring).
+        self.batch = None
 
     @staticmethod
     def dedup_name(kind: str, name: str, reason: str) -> str:
@@ -102,9 +110,31 @@ class EventRecorder:
                 type_, reason, involved.KIND, involved.metadata.name,
                 ns, message, virtual_time=now,
             )
+        record = (
+            type_, reason, message, involved.KIND,
+            involved.metadata.name, now,
+        )
+        if self.batch is not None:
+            self.batch.append(
+                ("event", ns, name), f"event/{name}",
+                lambda records, ns=ns, name=name: self._commit(
+                    ns, name, records
+                ),
+                record,
+            )
+            return
+        self._commit(ns, name, [record])
+
+    def _commit(self, ns: str, name: str, records: list[tuple]) -> None:
+        """Land `records` (all sharing one dedup key) as ONE store write:
+        an existing event bumps count by len(records); a fresh one is
+        created with that count. Runs inline when unbatched, or at the
+        round flush when a WriteBatch is installed."""
+        type_, reason, message, kind, involved_name, first = records[0]
+        type_, reason, message, _k, _n, now = records[-1]
         existing = self.store.get(ClusterEvent.KIND, ns, name)
         if existing is not None:
-            existing.count += 1
+            existing.count += len(records)
             existing.message = message
             existing.last_timestamp = now
             self.store.update(existing)
@@ -116,11 +146,12 @@ class EventRecorder:
                 type=type_,
                 reason=reason,
                 message=message,
-                involved_kind=involved.KIND,
-                involved_name=involved.metadata.name,
+                involved_kind=kind,
+                involved_name=involved_name,
                 reporting_controller=self.controller,
-                first_timestamp=now,
+                first_timestamp=first,
                 last_timestamp=now,
+                count=len(records),
             ),
             owned=True,
         )

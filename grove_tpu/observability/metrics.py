@@ -38,6 +38,11 @@ class Counter:
         to reset a live one. Returns whether the series existed."""
         return self._values.pop(_label_key(labels), None) is not None
 
+    def label_sets(self) -> list[dict[str, str]]:
+        """The label set of every live series (Gauge parity: public
+        enumeration for owners reconciling per-object series)."""
+        return [dict(key) for key in self._values]
+
     def total(self) -> float:
         return sum(self._values.values())
 

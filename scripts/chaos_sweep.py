@@ -110,16 +110,30 @@ TENANT_SKEW_CONFIG = {
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
              explain_dir: Path | None = None,
-             tenant_skew: bool = False) -> dict:
+             tenant_skew: bool = False,
+             shards: int = 1) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    if shards > 1:
+        # the shard-failover axis: worker crashes, frozen map views,
+        # handoff storms — convergence is still checked against the
+        # SINGLE-replica fault-free fixpoint (sharding must be
+        # workload-invisible), with the ownership audit armed
+        overrides.update(
+            shard_crash_rate=0.1,
+            shard_map_stale_rate=0.1,
+            handoff_storm_rate=0.08,
+        )
     plan = FaultPlan.from_seed(seed, **overrides)
     trace_path = (
         str(trace_dir / f"seed-{seed}-flight.json")
         if trace_dir is not None else None
     )
+    config = dict(TENANT_SKEW_CONFIG) if tenant_skew else {}
+    if shards > 1:
+        config = {**config, "controllers": {"shards": shards}}
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
-        config=TENANT_SKEW_CONFIG if tenant_skew else None,
+        config=config or None,
     )
     # silence the expected fault-storm error logs (with_name children
     # copy the stream at creation, so the manager's logger needs its own
@@ -127,6 +141,10 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     quiet = io.StringIO()
     ch.harness.cluster.logger.stream = quiet
     ch.harness.manager.logger.stream = quiet
+    ch.harness.scheduler.log.stream = quiet
+    for w in getattr(ch.harness.manager, "workers", ()):
+        w.manager.logger.stream = quiet
+        w.components["scheduler"].log.stream = quiet
     t0 = time.perf_counter()
     error = None
     try:
@@ -195,6 +213,16 @@ def main(argv=None) -> int:
                          "every seed that settles with unscheduled "
                          "gangs; render with python -m "
                          "grove_tpu.observability.explain")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run the control plane horizontally sharded "
+                         "across N worker replicas (default 1 = classic "
+                         "single manager) and add the shard-failover "
+                         "fault axis: seeded worker crashes (shards must "
+                         "fail over within one lease duration), frozen "
+                         "shard-map views, and handoff storms; "
+                         "convergence is checked against the "
+                         "single-replica fault-free fixpoint with the "
+                         "ownership audit armed")
     ap.add_argument("--tenant-skew", dest="tenant_skew",
                     action="store_true",
                     help="enable tenant-skew load faults: tenancy "
@@ -215,7 +243,10 @@ def main(argv=None) -> int:
         explain_dir.mkdir(parents=True, exist_ok=True)
 
     # the baseline fixpoint must be computed under the SAME config the
-    # chaos runs use (tenancy changes PodGang defaulting)
+    # chaos runs use (tenancy changes PodGang defaulting) — but always
+    # SINGLE-replica: the sharded runs must converge to the same
+    # workload state a lone manager reaches (sharding is
+    # workload-invisible by contract)
     baseline_h = Harness(
         nodes=make_nodes(args.nodes),
         config=TENANT_SKEW_CONFIG if args.tenant_skew else None,
@@ -229,7 +260,8 @@ def main(argv=None) -> int:
     for seed in range(args.start, args.start + args.seeds):
         result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir,
                           explain_dir=explain_dir,
-                          tenant_skew=args.tenant_skew)
+                          tenant_skew=args.tenant_skew,
+                          shards=args.shards)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -238,6 +270,7 @@ def main(argv=None) -> int:
         "swept": args.seeds,
         "start": args.start,
         "nodes": args.nodes,
+        "shards": args.shards,
         "failed_seeds": failed,
         "ok": not failed,
     }

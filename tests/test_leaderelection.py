@@ -140,6 +140,58 @@ def test_failover_with_in_flight_solve_dispatch():
     ).total() >= 1
 
 
+def test_shard_coordinator_role_fails_over_on_lease_expiry():
+    """The sharded control plane's COORDINATOR role rides the same lease
+    machinery: when the worker holding grove-shard-coordinator dies, a
+    survivor acquires it after expiry and keeps reconciling the shard
+    map (orphan reassignment still happens — no frozen map)."""
+    from grove_tpu.controller.sharding import (
+        COORDINATOR_LEASE,
+        SHARD_NAMESPACE,
+        ShardMap,
+        SHARD_MAP_NAME,
+    )
+    from grove_tpu.controller.leaderelection import Lease
+
+    h = Harness(nodes=make_nodes(8),
+                config={"controllers": {"shards": 3}})
+    h.settle()
+    sm = h.manager
+    lease = h.store.get(Lease.KIND, SHARD_NAMESPACE, COORDINATOR_LEASE)
+    assert lease is not None and lease.holder_identity
+    coord = lease.holder_identity
+    idx = next(w.index for w in sm.workers if w.identity == coord)
+    assert sm.kill_worker(idx)
+    h.advance(11.0)  # past the worker lease duration
+    h.settle()
+    lease = h.store.get(Lease.KIND, SHARD_NAMESPACE, COORDINATOR_LEASE)
+    assert lease.holder_identity and lease.holder_identity != coord
+    # and the new coordinator reassigned the dead worker's shards
+    m = h.store.get(ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+    assert coord not in m.assignments.values()
+
+
+def test_shard_worker_lease_renewal_rides_every_round():
+    """Worker heartbeat leases renew at the top of each round; a live
+    fleet's leases are never stale by more than one round's clock."""
+    from grove_tpu.controller.leaderelection import Lease
+    from grove_tpu.controller.sharding import (
+        SHARD_NAMESPACE,
+        WORKER_LEASE_PREFIX,
+    )
+
+    h = Harness(nodes=make_nodes(8),
+                config={"controllers": {"shards": 2}})
+    h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    h.settle()
+    h.advance(5.0)
+    now = h.clock.now()
+    for lease in h.store.scan(Lease.KIND, namespace=SHARD_NAMESPACE):
+        if lease.metadata.name.startswith(WORKER_LEASE_PREFIX):
+            assert lease.holder_identity
+            assert now - lease.renew_time <= lease.lease_duration_seconds
+
+
 def test_randomized_ha_interleavings_never_split_brain():
     """Randomized HA fuzz (CI-sized; a 20x40 sweep ran clean offline):
     two managers over one store, random interleaving of which replica

@@ -1,0 +1,375 @@
+"""Horizontally sharded control plane (controller/sharding.py): shard-map
+handoff edges, the ownership invariant, lease-based failover, dedication,
+metric series hygiene, and the round write batcher."""
+
+import pytest
+
+from grove_tpu.api.types import Pod
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.controller.concurrency import WriteBatch
+from grove_tpu.controller.sharding import (
+    SHARD_MAP_NAME,
+    SHARD_NAMESPACE,
+    ShardMap,
+    shard_of,
+)
+
+from test_e2e_basic import clique, simple_pcs
+
+SHARDED = {"controllers": {"shards": 4, "shard_lease_duration_seconds": 10.0}}
+
+
+def sharded_harness(nodes=16, **cfg):
+    config = {"controllers": {**SHARDED["controllers"], **cfg}}
+    h = Harness(nodes=make_nodes(nodes), config=config)
+    h.manager.audit = True  # every round asserts single ownership
+    return h
+
+
+def shard_map(h) -> ShardMap:
+    return h.store.get(ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+
+
+# -- basics ----------------------------------------------------------------
+def test_sharded_settle_reaches_single_replica_state():
+    h = sharded_harness()
+    h.apply(simple_pcs(cliques=[clique("w", replicas=2),
+                                clique("x", replicas=3)]))
+    h.settle()
+    pods = h.store.list(Pod.KIND)
+    assert len(pods) == 5 and all(p.node_name and p.status.ready
+                                  for p in pods)
+
+
+def test_shard_of_is_stable_and_scheduler_reserved():
+    n = h_num = 64
+    assert shard_of("default", "a", n) == shard_of("default", "a", n)
+    assert 0 <= shard_of("ns", "name", n) < n
+    # the gang scheduler's singleton maps to the RESERVED shard one past
+    # the hash range (its owner stays dedicated)
+    assert shard_of("", "schedule", h_num) == h_num
+
+
+def test_bootstrap_map_covers_every_shard_once():
+    h = sharded_harness()
+    m = shard_map(h)
+    assert m is not None and m.epoch >= 1
+    idents = {w.identity for w in h.manager.workers}
+    assert set(m.assignments) == set(h.manager.all_shards)
+    assert set(m.assignments.values()) <= idents
+    # dedication: the scheduler shard's owner holds ONLY that shard
+    sched_owner = m.assignments[h.manager.scheduler_shard]
+    others = [s for s, w in m.assignments.items()
+              if w == sched_owner and s != h.manager.scheduler_shard]
+    assert others == []
+
+
+def test_ownership_audit_runs_clean_through_settles():
+    h = sharded_harness()
+    for i in range(3):
+        h.apply(simple_pcs(name=f"a{i}", cliques=[clique("w", replicas=2)]))
+        h.settle()
+    pods = h.store.list(Pod.KIND)
+    assert len(pods) == 6 and all(p.status.ready for p in pods)
+
+
+# -- failover --------------------------------------------------------------
+def test_crashed_worker_shards_fail_over_within_lease_duration():
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    _s, owner = sm.shard_owner("", "schedule")
+    idx = next(w.index for w in sm.workers if w.identity == owner)
+    assert sm.kill_worker(idx)
+    t0 = h.clock.now()
+    h.apply(simple_pcs(name="fo", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    # scheduler shard orphaned: nothing binds until the lease expires
+    assert all(not p.node_name for p in h.store.scan(Pod.KIND))
+    lease = h.config.controllers.shard_lease_duration_seconds
+    h.advance(lease + 1.0)
+    h.settle()
+    pods = h.store.scan(Pod.KIND)
+    assert pods and all(p.node_name and p.status.ready for p in pods)
+    assert h.clock.now() - t0 <= lease + 2.0  # bounded by one lease
+    _s, new_owner = sm.shard_owner("", "schedule")
+    assert new_owner and new_owner != owner
+
+
+def test_kill_refuses_last_live_worker():
+    h = sharded_harness()
+    sm = h.manager
+    assert sm.kill_worker(0) and sm.kill_worker(1) and sm.kill_worker(2)
+    assert not sm.kill_worker(3)  # a survivor must remain
+    assert sm.workers[3].alive
+
+
+def test_revived_worker_rejoins_and_rebalances():
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    assert sm.kill_worker(1)
+    h.advance(11.0)
+    h.settle()
+    m = shard_map(h)
+    assert "worker-1" not in m.assignments.values()
+    sm.revive_worker(1)
+    h.advance(1.0)
+    h.settle()
+    h.advance(1.0)
+    h.settle()
+    m = shard_map(h)
+    assert "worker-1" in m.assignments.values()  # rebalanced back in
+    h.apply(simple_pcs(name="post", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+# -- handoff edges ---------------------------------------------------------
+def test_rebalance_is_two_phase_and_never_double_reconciles():
+    """A live->live move waits in `pending` until the CURRENT owner
+    releases; until then the successor does not serve it (audit armed
+    throughout — a double reconcile in one pass raises)."""
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    # revoke every shard of worker-0 (as a handoff storm would)
+    moves = sm.chaos_revoke_worker(0)
+    assert moves > 0
+    m = shard_map(h)
+    assert m.pending  # decided, not yet transferred
+    for s, target in m.pending.items():
+        assert m.assignments[s] == "worker-0" and target != "worker-0"
+    # drive work through the storm: the audit would catch any overlap
+    h.apply(simple_pcs(name="storm", cliques=[clique("w", replicas=3)]))
+    h.settle()
+    m = shard_map(h)
+    assert not any(
+        owner == "worker-0" for s, owner in m.assignments.items()
+        if s != sm.scheduler_shard
+    ) or not m.pending  # releases completed (or still draining cleanly)
+    assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+def test_stale_map_worker_defers_rather_than_fighting():
+    """A worker whose map view is frozen keeps serving its own shards
+    only while the view is younger than one lease duration; past that it
+    serves NOTHING (owned empty) until a fresh read succeeds — and its
+    shards, never released, stay assigned to it (no fight)."""
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    w = sm.workers[0]
+    owned_before = set(w.owned)
+    assert owned_before
+    w.stale_map_hold = 1000  # freeze refreshes
+    # within one lease duration: still serving the cached shards
+    h.apply(simple_pcs(name="st1", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert w.owned == owned_before
+    # age the view past the lease duration: the worker defers
+    h.advance(sm.lease_duration + 1.0)
+    h.settle()
+    assert w.owned == set()
+    assert w.deferred_rounds > 0
+    # its lease kept renewing (steps still run), so the leader did NOT
+    # reassign its shards out from under it
+    m = shard_map(h)
+    assert any(v == w.identity for v in m.assignments.values())
+    # thaw: the worker relists its shards back in and work completes
+    w.stale_map_hold = 0
+    h.apply(simple_pcs(name="st2", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert w.owned == owned_before
+    assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+def test_clean_shutdown_releases_shards_immediately():
+    """release-on-cancel analog: stop_worker hands shards to survivors
+    in one map write — no lease wait — and its metric series leave
+    /metrics."""
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    gauge = h.cluster.metrics.gauge("grove_manager_shard_assignments")
+    assert any(
+        ls.get("shard") == "worker-0" for ls in gauge.label_sets()
+    )
+    sm.stop_worker(0)
+    m = shard_map(h)
+    assert "worker-0" not in m.assignments.values()
+    # immediately serviceable: no clock advance needed
+    h.apply(simple_pcs(name="cs", cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert all(p.status.ready for p in h.store.list(Pod.KIND))
+    # series hygiene (regression): the departed worker's gauge AND
+    # handoff-counter series are gone from the exposition
+    assert not any(
+        ls.get("shard") == "worker-0" for ls in gauge.label_sets()
+    )
+    hand = h.cluster.metrics.counter("grove_manager_shard_handoffs_total")
+    assert not any(
+        dict(k).get("shard") == "worker-0" for k in hand._values
+    )
+    rendered = h.cluster.metrics.render()
+    assert 'shard="worker-0"' not in rendered
+
+
+def test_assignment_gauge_tracks_the_map():
+    h = sharded_harness()
+    h.settle()
+    m = shard_map(h)
+    gauge = h.cluster.metrics.gauge("grove_manager_shard_assignments")
+    counts = {}
+    for owner in m.assignments.values():
+        counts[owner] = counts.get(owner, 0) + 1
+    for ident, n in counts.items():
+        assert gauge.value(shard=ident) == float(n)
+    # manager-scoped gauges export PER-WORKER series under sharding (an
+    # unlabeled shared gauge would be last-writer-wins across replicas)
+    depth = h.cluster.metrics.gauge("grove_manager_workqueue_depth")
+    workers = {
+        ls.get("worker") for ls in depth.label_sets() if "worker" in ls
+    }
+    assert workers == {w.identity for w in h.manager.workers}
+
+
+def test_crashed_worker_retains_series_until_reassigned_then_updates():
+    h = sharded_harness()
+    h.settle()
+    sm = h.manager
+    assert sm.kill_worker(2)
+    h.advance(11.0)
+    h.settle()
+    gauge = h.cluster.metrics.gauge("grove_manager_shard_assignments")
+    # shards moved: the dead worker owns nothing, survivors grew
+    assert not any(
+        ls.get("shard") == "worker-2" for ls in gauge.label_sets()
+    )
+
+
+# -- surfaces --------------------------------------------------------------
+def test_debug_dump_carries_sharding_section():
+    h = sharded_harness()
+    h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    h.settle()
+    d = h.debug_dump()
+    sharding = d["sharding"]
+    assert sharding["num_shards"] == 4 * 16
+    assert sharding["map_epoch"] >= 1
+    assert len(sharding["workers"]) == 4
+    assert sharding["coordinator"] in {
+        w["identity"] for w in sharding["workers"]
+    }
+    owned = [s for w in sharding["workers"] for s in w["owned_shards"]]
+    assert len(owned) == len(set(owned))  # disjoint ownership
+
+
+def test_single_replica_mode_is_unchanged():
+    """shards=1 keeps the classic ControllerManager (no ShardMap, no
+    worker leases)."""
+    h = Harness(nodes=make_nodes(8))
+    h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+    h.settle()
+    assert shard_map(h) is None
+    assert not hasattr(h.manager, "workers")
+
+
+def test_config_validation():
+    from grove_tpu.api.config import load_operator_config
+    from grove_tpu.api.validation import ValidationError
+
+    with pytest.raises(ValidationError, match="shards"):
+        load_operator_config({"controllers": {"shards": 0}})
+    with pytest.raises(ValidationError, match="shard_lease_duration"):
+        load_operator_config(
+            {"controllers": {"shards": 2,
+                             "shard_lease_duration_seconds": 0}}
+        )
+    with pytest.raises(ValidationError, match="round_write_batching"):
+        load_operator_config(
+            {"controllers": {"round_write_batching": "yes"}}
+        )
+    with pytest.raises(ValidationError, match="incompatible"):
+        load_operator_config({
+            "controllers": {"shards": 2},
+            "leader_election": {"enabled": True},
+        })
+
+
+# -- standby observability (satellite fix) ---------------------------------
+def test_standby_is_distinguishable_from_wedged():
+    """A healthy standby surfaces standing_by=True in the resilience
+    dump and grove_manager_is_leader=0; the leader reads 1."""
+    leader = Harness(
+        nodes=make_nodes(8),
+        config={"leader_election": {"enabled": True}},
+    )
+    standby = Harness(cluster=leader.cluster)
+    leader.manager.run_once()  # acquires
+    assert standby.manager.run_once() == 0
+    assert standby.manager.resilience_snapshot()["standing_by"] is True
+    assert leader.manager.resilience_snapshot()["standing_by"] is False
+    dump = standby.debug_dump()
+    assert dump["manager"]["resilience"]["standing_by"] is True
+    assert dump["manager"]["is_leader"] is False
+    gauge = leader.cluster.metrics.gauge("grove_manager_is_leader")
+    assert gauge.value() in (0.0, 1.0)
+
+
+# -- round write batcher ---------------------------------------------------
+def test_write_batch_coalesces_and_flushes():
+    calls = []
+    b = WriteBatch()
+    assert not b.put("k1", "t1", lambda: calls.append("a"))
+    assert b.put("k1", "t1", lambda: calls.append("b"))  # coalesced
+    assert not b.append("k2", "t2", lambda items: calls.append(items), 1)
+    assert b.append("k2", "t2", None, 2)  # merged into k2's item list
+    result = b.flush()
+    assert calls == ["b", [1, 2]]
+    assert len(result.succeeded) == 2 and not result.has_errors
+    assert len(b) == 0
+
+
+def test_write_batch_requeues_failures_for_next_flush():
+    state = {"fail": True}
+
+    def task():
+        if state["fail"]:
+            raise RuntimeError("transient")
+
+    b = WriteBatch()
+    b.put("k", "t", task)
+    result = b.flush()
+    assert result.has_errors and len(b) == 1  # requeued
+    state["fail"] = False
+    result = b.flush()
+    assert not result.has_errors and len(b) == 0
+
+
+def test_event_records_compact_through_round_batch():
+    """N identical records within one round land as ONE store write with
+    count=N (the dedup compaction, amortized)."""
+    from grove_tpu.observability.events import ClusterEvent, EventRecorder
+
+    h = Harness(nodes=make_nodes(4))
+    rec = EventRecorder(h.store, controller="t")
+    batch = WriteBatch()
+    rec.batch = batch
+    pcs = simple_pcs(cliques=[clique("w", replicas=1)])
+    h.apply(pcs)
+    before = h.store.last_seq
+    for _ in range(5):
+        rec.normal(pcs, "TestReason", "msg")
+    assert h.store.last_seq == before  # nothing landed yet
+    batch.flush()
+    events = [
+        e for e in h.store.scan(ClusterEvent.KIND)
+        if e.reason == "TestReason"
+    ]
+    assert len(events) == 1 and events[0].count == 5
+    event_writes = [
+        e for e in h.store.events_since(before) if e.kind == "Event"
+    ]
+    assert len(event_writes) == 1  # one write for five records
