@@ -194,6 +194,13 @@ def main() -> int:
                     help="--tenants: max tolerated fairness error as a "
                     "fraction of cluster dominant capacity (exit 1 "
                     "above it)")
+    ap.add_argument("--recovery", action="store_true",
+                    help="add the cold-restart recovery probe: run the "
+                    "control-plane workload with the durable store "
+                    "(WAL + snapshots in a temp dir), kill the process "
+                    "state at steady state, and report recovery_seconds "
+                    "(disk replay + soft-state rebuild + re-settle to "
+                    "the same fixpoint)")
     ap.add_argument("--service", action="store_true",
                     help="benchmark the solve THROUGH the placement-service "
                     "gRPC boundary (server spawned as a subprocess on this "
@@ -569,6 +576,8 @@ def main() -> int:
                 trace_groups=trace_groups if args.trace else None,
             )
         )
+        if args.recovery:
+            cp.update(bench_recovery(args.nodes, args.cp_replicas))
 
     # Headline basis (r7, recorded so BENCH files stay self-describing,
     # like the r3 p99->p50 change): the fused regime's headline is the
@@ -1116,6 +1125,97 @@ def bench_controlplane(
         "controlplane_solve_seconds": round(solve_wall, 3),
         "controlplane_host_seconds": round(warm - solve_wall, 3),
         "controlplane_settle_basis": "p50_of_3",
+    }
+
+
+def bench_recovery(num_nodes: int, replicas: int) -> dict:
+    """Cold-restart recovery probe (`--recovery`): settle the standard
+    control-plane workload on a DURABLE store (WAL + snapshots in a temp
+    dir, fsync per commit — the honest production posture), then model a
+    whole-process crash at steady state: Harness.cold_restart drops the
+    live store, recovers it from disk (latest valid snapshot + WAL
+    replay), expires coordination leases, rebuilds manager/scheduler/
+    kubelet soft state, and settle() re-derives the fixpoint.
+
+    recovery_seconds is the whole outage window the operator would see:
+    disk replay + soft-state rebuild + re-settle. The split fields say
+    where it went (recovery_replay_seconds is the store-rebuild part
+    alone). Durable write-path overhead is visible by comparing
+    recovery_durable_cold_settle_seconds (this harness's first settle,
+    WAL armed, jit-cold) against controlplane_cold_settle_seconds from
+    the same run."""
+    import tempfile
+
+    from grove_tpu.api.meta import ObjectMeta as Meta
+    from grove_tpu.api.types import (
+        Container,
+        Pod,
+        PodCliqueSet,
+        PodCliqueSetSpec,
+        PodCliqueSetTemplateSpec,
+        PodCliqueSpec,
+        PodCliqueTemplateSpec,
+        PodSpec,
+    )
+    from grove_tpu.chaos.harness import settled_fingerprint
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+
+    workload = PodCliqueSet(
+        metadata=Meta(name="recovery"),
+        spec=PodCliqueSetSpec(
+            replicas=replicas,
+            template=PodCliqueSetTemplateSpec(
+                cliques=[
+                    PodCliqueTemplateSpec(
+                        name="w",
+                        spec=PodCliqueSpec(
+                            replicas=8,
+                            pod_spec=PodSpec(
+                                containers=[
+                                    Container(
+                                        name="m", resources={"cpu": 1.0}
+                                    )
+                                ]
+                            ),
+                        ),
+                    )
+                ]
+            ),
+        ),
+    )
+    with tempfile.TemporaryDirectory(prefix="grove-bench-wal-") as wal_dir:
+        h = Harness(
+            nodes=make_nodes(
+                num_nodes,
+                allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+            ),
+            config={"durability": {"wal_dir": wal_dir}},
+        )
+        t0 = time.perf_counter()
+        h.apply(workload)
+        h.settle()
+        durable_settle = time.perf_counter() - t0
+        fixpoint = settled_fingerprint(h.store)
+        wal = h.cluster.durability.debug_state()
+        t0 = time.perf_counter()
+        stats = h.cold_restart()
+        replay = time.perf_counter() - t0
+        h.settle()
+        wall = time.perf_counter() - t0
+        if settled_fingerprint(h.store) != fixpoint:  # survives python -O
+            raise RuntimeError(
+                "recovery bench invalid: post-recovery fixpoint diverged"
+            )
+    return {
+        "recovery_replicas": replicas,
+        "recovery_seconds": round(wall, 3),
+        "recovery_replay_seconds": round(replay, 3),
+        "recovery_durable_cold_settle_seconds": round(durable_settle, 2),
+        "recovery_wal_records": wal["wal_records_total"],
+        "recovery_wal_bytes": wal["wal_bytes_total"],
+        "recovery_outcome": stats["outcome"],
+        "recovery_records_replayed": stats["wal_records_replayed"],
     }
 
 

@@ -25,6 +25,7 @@ KIND = "OperatorConfiguration"
 
 _LOG_LEVELS = ("debug", "info", "error")
 _LOG_FORMATS = ("text", "json")
+_DURABILITY_FSYNC = ("commit", "snapshot", "never")
 
 
 @dataclass
@@ -270,6 +271,42 @@ class TracingConfig:
 
 
 @dataclass
+class DurabilityConfig:
+    """Durable state store (cluster/durability.py): write-ahead log +
+    periodic snapshots under the ObjectStore, enabling cold-restart
+    recovery (`Harness.cold_restart`, `ObjectStore.recover`). Off by
+    default (`wal_dir: null`) — the in-memory-only store is unchanged and
+    the commit hot path pays one untaken branch.
+
+      wal_dir                    directory for WAL segments + snapshots
+                                 (None = durability off)
+      fsync                      "commit"   — fsync every appended record:
+                                              every acknowledged write is
+                                              crash-durable (default)
+                                 "snapshot" — fsync only at snapshot cuts
+                                 "never"    — leave flushing to the OS
+                                 (records are always flushed to the OS
+                                 per append; the policy governs physical
+                                 durability, i.e. what a REAL host crash
+                                 could tear off the tail)
+      snapshot_interval_seconds  virtual-clock cadence between snapshots
+      wal_max_bytes              cut a snapshot early once the live WAL
+                                 segment exceeds this (bounds replay)
+      keep_snapshots             retained snapshot generations; >= 2 so a
+                                 corrupted newest snapshot can fall back
+                                 (WAL segments are pruned only once every
+                                 record is covered by the OLDEST retained
+                                 snapshot)
+    """
+
+    wal_dir: str | None = None
+    fsync: str = "commit"
+    snapshot_interval_seconds: float = 300.0
+    wal_max_bytes: int = 64 * 1024 * 1024
+    keep_snapshots: int = 2
+
+
+@dataclass
 class OperatorConfig:
     api_version: str = API_VERSION
     kind: str = KIND
@@ -290,6 +327,7 @@ class OperatorConfig:
     )
     log: LogConfig = field(default_factory=LogConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    durability: DurabilityConfig = field(default_factory=DurabilityConfig)
 
 
 def _build(cls, data: Any, path: str, errs: list[str]):
@@ -328,6 +366,7 @@ _TYPES = {
     "TopologyAwareSchedulingConfig": TopologyAwareSchedulingConfig,
     "LogConfig": LogConfig,
     "TracingConfig": TracingConfig,
+    "DurabilityConfig": DurabilityConfig,
     "OperatorConfig": OperatorConfig,
 }
 
@@ -534,6 +573,37 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     if not _int(tr.flight_recorder_capacity) or tr.flight_recorder_capacity < 1:
         errs.append(
             "config.tracing.flight_recorder_capacity: must be an int >= 1"
+        )
+
+    du = cfg.durability
+    if du.wal_dir is not None and (
+        not isinstance(du.wal_dir, str) or not du.wal_dir
+    ):
+        # an empty path is a likely templating bug, not a disable switch:
+        # disabling is wal_dir: null, explicitly
+        errs.append(
+            "config.durability.wal_dir: must be null (durability off) or "
+            "a non-empty directory path"
+        )
+    if du.fsync not in _DURABILITY_FSYNC:
+        errs.append(
+            f"config.durability.fsync: must be one of {_DURABILITY_FSYNC}"
+        )
+    if not _num(du.snapshot_interval_seconds) or du.snapshot_interval_seconds <= 0:
+        errs.append(
+            "config.durability.snapshot_interval_seconds: must be > 0"
+        )
+    if not _int(du.wal_max_bytes) or du.wal_max_bytes < 4096:
+        errs.append(
+            "config.durability.wal_max_bytes: must be an int >= 4096 "
+            "(a segment must hold at least a few records before forcing "
+            "a snapshot per write)"
+        )
+    if not _int(du.keep_snapshots) or du.keep_snapshots < 2:
+        errs.append(
+            "config.durability.keep_snapshots: must be an int >= 2 — "
+            "recovery from a corrupted newest snapshot needs at least "
+            "one older generation to fall back to"
         )
     return errs
 

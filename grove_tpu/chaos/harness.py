@@ -160,6 +160,10 @@ class ChaosHarness:
         #: disarm; shards fail over meanwhile via orphaned-lease
         #: detection)
         self._crashed_workers: set[int] = set()
+        #: whole-process crash-recoveries this run (the durable-store
+        #: fault axis; see process_crash) + their recovery stats
+        self.process_restarts = 0
+        self.recovery_stats: list[dict[str, Any]] = []
         sharded = self._sharded
         if sharded is not None:
             # the ownership audit rides every chaos round: a key
@@ -417,6 +421,69 @@ class ChaosHarness:
                 if sharded.chaos_revoke_worker(idx):
                     self._record("handoff_storm")
 
+    # -- durable-store faults -----------------------------------------------
+    @property
+    def _durable(self):
+        """The cluster's DurableLog when durability is configured, else
+        None (the durable-fault draws are skipped entirely — rate-guarded
+        AND capability-guarded, so seeds replay identically either way)."""
+        return self.harness.cluster.durability
+
+    def process_crash(self, tear_tail: bool = False,
+                      corrupt_snapshot: bool = False) -> dict:
+        """The whole-process crash: optionally tear the WAL tail / corrupt
+        the newest snapshot first (what a dying disk leaves behind), then
+        drop the live store and recover from disk mid-plan —
+        Harness.cold_restart re-derives every piece of soft state. The
+        chaos proxy is disarmed for the recovery sequence itself (a store
+        being REBUILT has no flaky-apiserver view to model; faults resume
+        with the next step) and its stale-read memory is cleared: the
+        informer caches died with the process."""
+        if tear_tail:
+            self._record("wal_torn_write")
+            self._durable.tear_tail()
+        if corrupt_snapshot and self._durable.snapshot_seqs():
+            self._record("snapshot_corruption")
+            self._durable.corrupt_latest_snapshot()
+        armed = self.chaos_store.armed
+        self.chaos_store.armed = False
+        try:
+            stats = self.harness.cold_restart()
+        finally:
+            self.chaos_store.armed = armed
+        self.chaos_store.reset_for_recovery()
+        self.process_restarts += 1
+        self.recovery_stats.append(stats)
+        if self._sharded is not None:
+            self._sharded.audit = True
+            self._crashed_workers.clear()  # the whole fleet restarted
+        return stats
+
+    def _inject_durability_faults(self) -> None:
+        """Per-step durable-store fault draws (see FaultPlan). Every draw
+        is guarded on rate > 0 AND on durability being configured, so
+        pre-existing seeds (and durability-less runs) keep their exact
+        draw sequences. The torn-tail / corrupted-snapshot draws are
+        CONDITIONAL on a process crash firing — they are properties of
+        the crash, not independent events."""
+        plan = self.plan
+        if self._durable is None:
+            return
+        if plan.process_crash_rate > 0 and plan.flip(
+            plan.process_crash_rate
+        ):
+            self._record("process_crash")
+            tear = plan.wal_torn_write_rate > 0 and plan.flip(
+                plan.wal_torn_write_rate
+            )
+            corrupt = plan.snapshot_corruption_rate > 0 and plan.flip(
+                plan.snapshot_corruption_rate
+            )
+            self.process_crash(tear_tail=tear, corrupt_snapshot=corrupt)
+        if plan.disk_stall_rate > 0 and plan.flip(plan.disk_stall_rate):
+            self._record("disk_stall")
+            self._durable.stall(2 + plan.pick(4))
+
     def _repair_shards(self) -> None:
         """Disarm-time repair: crashed workers revive (fresh process,
         replay + relist) and frozen map views thaw — the recovered
@@ -500,6 +567,7 @@ class ChaosHarness:
                     self._record("tenant_skew")
                     self._inject_tenant_skew()
                 self._inject_shard_faults()
+                self._inject_durability_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")
@@ -510,12 +578,18 @@ class ChaosHarness:
                 if not stalled:
                     h.kubelet.tick()
                 self._tick_node_faults()
+                if self._durable is not None:
+                    self._durable.tick_stall()
                 # give backoff requeues a chance to fire mid-chaos
                 h.clock.advance(plan.step_seconds)
         finally:
             self.chaos_store.armed = False
             self._repair_infrastructure()
             self._repair_shards()
+            if self._durable is not None:
+                # disarm-time repair, like every other fault class: the
+                # disk recovers, deferred snapshot work may resume
+                self._durable.stalled_steps = 0
         self.settle_recovered()
 
     def settle_recovered(self, max_iters: int = 64) -> None:
@@ -637,6 +711,13 @@ class ChaosHarness:
                 for c, r, msg in manager.errors[-32:]
             ],
             "manager_restarts": self.manager_restarts,
+            "process_restarts": self.process_restarts,
+            # the durable-recovery audit trail: per crash, the snapshot
+            # it recovered from, the WAL replay position it stopped at
+            # (recovered_last_seq), torn/fallback outcomes — a failed
+            # seed's postmortem names WHERE replay landed, not just that
+            # a recovery happened
+            "recoveries": list(self.recovery_stats),
             "faults_injected": dict(sorted(self.plan.counts.items())),
         }
 

@@ -110,6 +110,31 @@ class FaultPlan:
     shard_map_stale_rate: float = 0.0
     handoff_storm_rate: float = 0.0
 
+    # durable-store faults (per chaos step; meaningful only when the
+    # harness runs with durability configured — skipped entirely
+    # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the
+    # tenant_skew/shard contract), so every pre-existing seed's draw
+    # sequence — and its verified convergence — is bit-identical.
+    #   process_crash       — the WHOLE control-plane process dies: the
+    #                         live store is dropped and recovered from
+    #                         disk (snapshot + WAL replay), coordination
+    #                         leases expire, the manager/scheduler/
+    #                         kubelet caches rebuild (Harness.cold_restart)
+    #   wal_torn_write      — conditional on a process_crash: the crash
+    #                         tears an in-flight WAL append off the tail
+    #                         (recovery must stop cleanly at it)
+    #   snapshot_corruption — conditional on a process_crash: the newest
+    #                         snapshot is corrupted; recovery must fall
+    #                         back to the previous retained one and
+    #                         replay the longer WAL suffix
+    #   disk_stall          — the WAL device stalls for a few steps:
+    #                         snapshot cuts defer (appends buffer), so a
+    #                         crash during the stall replays more WAL
+    process_crash_rate: float = 0.0
+    wal_torn_write_rate: float = 0.0
+    snapshot_corruption_rate: float = 0.0
+    disk_stall_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

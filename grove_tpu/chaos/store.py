@@ -289,6 +289,19 @@ class ChaosStore:
         return events
 
     # -- chaos driver hooks ------------------------------------------------
+    def reset_for_recovery(self) -> None:
+        """Drop every piece of per-run fault state keyed to store seqs —
+        the stale-read memory, a live event-delivery hold, a mid-burst
+        conflict storm. Called by the driver after a process_crash
+        recovery: the informer caches died with the process, and a torn
+        tail REWINDS (then reuses) seqs, so stale bookkeeping could
+        collide with post-recovery objects. Owned here, next to the
+        state, so new per-run fields can't be missed at the call site
+        (the SimKubelet.reset_for_recovery pattern)."""
+        self._created_at.clear()
+        self._event_hold = None
+        self._conflict_burst_left = 0
+
     def force_compaction(self) -> int:
         """Compact the inner event log up to the head — deliberately past
         every consumer cursor, forcing the manager/kubelet/usage informers

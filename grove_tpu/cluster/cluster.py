@@ -33,17 +33,67 @@ from .store import Admission, ObjectStore
 
 
 class Cluster:
+    @classmethod
+    def from_durable(cls, config: OperatorConfig) -> "Cluster":
+        """Boot a GENUINELY NEW process from the durable state on disk
+        (the crashed predecessor's Python objects are gone — unlike
+        cold_restart, which recovers in place): recover the store from
+        `config.durability.wal_dir`, adopt the journal in resume mode,
+        and skip the bootstrap creates (topology, priority classes,
+        nodes) — they are IN the recovered history. The usual entry
+        point is Harness.recover(config), which also expires the dead
+        process's coordination leases."""
+        if not config.durability.wal_dir:
+            raise ValueError(
+                "Cluster.from_durable requires config.durability.wal_dir"
+            )
+        store = ObjectStore.recover(config.durability.wal_dir)
+        return cls(config=config, recovered_store=store)
+
     def __init__(self, nodes: list[Node] | None = None,
                  topology: ClusterTopology | None = None,
-                 config: OperatorConfig | None = None):
+                 config: OperatorConfig | None = None,
+                 recovered_store: ObjectStore | None = None):
+        if recovered_store is not None and (nodes or topology):
+            raise ValueError(
+                "a recovered store already carries its nodes and "
+                "topology; pass neither (see Cluster.from_durable)"
+            )
         self.config = config or OperatorConfig()
-        self.clock = SimClock()
-        self.store = ObjectStore(self.clock)
-        self.kubelet = SimKubelet(self.store)
+        self.clock = (
+            recovered_store.clock if recovered_store is not None
+            else SimClock()
+        )
         # One registry per cluster: scheduler + engine feed it, bench.py and
         # the /metrics text exposition read it (SURVEY §5: the reference has
         # no custom scheduler metrics; the north-star numbers live here).
+        # Built before the store so the durability layer can count into it.
         self.metrics = MetricsRegistry()
+        self.store = recovered_store or ObjectStore(self.clock)
+        # Durable state store (cluster/durability.py): attach the WAL
+        # BEFORE the first write so the journal covers the whole history —
+        # the bootstrap objects below (topology, priority classes, nodes)
+        # replay on recovery like everything else. A recovered store
+        # RESUMES the populated dir instead (no wipe, no refuse), and the
+        # boot checkpoint seals the pre-crash tail behind a fresh
+        # generation before any append.
+        self.durability = None
+        if self.config.durability.wal_dir:
+            from .durability import DurableLog
+
+            self.durability = DurableLog(
+                self.config.durability, clock=self.clock,
+                metrics=self.metrics,
+                resume=recovered_store is not None,
+            )
+            self.store.attach_durability(self.durability)
+            if recovered_store is not None:
+                self.durability.checkpoint(self.store)
+                self.metrics.counter(
+                    "grove_store_recoveries_total",
+                    "store recoveries from durable state by outcome",
+                ).inc(outcome=self.store.recovery_stats["outcome"])
+        self.kubelet = SimKubelet(self.store)
         # Placement-decision audit ring (observability/explain.py):
         # cluster-owned — like the metrics registry — so explanations
         # survive scheduler engine rebuilds and manager crash-restarts.
@@ -106,6 +156,18 @@ class Cluster:
             self.store.authorizer = make_authorizer(
                 self.config.authorization, store=self.store
             )
+        if recovered_store is not None:
+            # every bootstrap object is IN the recovered history — adopt
+            # the stored singleton instead of re-creating (AlreadyExists)
+            from .store import clone
+
+            stored = self.store.scan(ClusterTopology.KIND)
+            self.topology = (
+                clone(stored[0]) if stored
+                else default_cluster_topology([])
+            )
+            self._init_caches()
+            return
         # Topology sync at startup (clustertopology.go:41): ensure the
         # singleton ClusterTopology exists before any controller runs.
         # Precedence: explicit topology arg > config levels > inventory
@@ -151,6 +213,10 @@ class Cluster:
                 )
         for node in nodes or []:
             self.store.create(node)
+        self._init_caches()
+
+    def _init_caches(self) -> None:
+        """Derived-state caches, all rebuilt lazily from the store."""
         #: topology_snapshot static-encoding cache (see topology_snapshot)
         self._snapshot_key: tuple | None = None
         self._snapshot_cache: TopologySnapshot | None = None
@@ -206,6 +272,47 @@ class Cluster:
         # rides as a store attribute rather than N constructor params
         self.store.flight_recorder = self.flight
         return self.tracer
+
+    # -- durability / cold restart ------------------------------------------
+    def invalidate_soft_state(self) -> None:
+        """Drop every derived in-memory cache so the next read rebuilds
+        from the (recovered) store: the topology-snapshot static encoding,
+        the incremental usage accounting and its event cursor, the
+        request-shape memo, and the free-delta journal (set to unknown —
+        consumers fall back to a full content diff, the same contract as
+        crossing a compaction horizon; the solver side is
+        engine.invalidate_device_state, which the rebuilt scheduler's
+        fresh engine implies)."""
+        self._snapshot_key = None
+        self._snapshot_cache = None
+        self._usage = None
+        self._usage_cursor = 0
+        self._req_cache.clear()
+        self._free_dirty = None
+        self._free_epoch += 1
+
+    def cold_restart(self) -> dict:
+        """Whole-process crash-restart of the STORE layer: replace the
+        live store state with what the durable log can prove (newest
+        valid snapshot + WAL replay, torn-tail tolerant), cut a recovery
+        checkpoint so the old — possibly torn — segment tail is never
+        appended over, and invalidate all derived soft state. Control
+        plane re-derivation (manager rebuild, lease expiry, kubelet
+        relist) is the harness's job: use Harness.cold_restart, which
+        calls this. Returns the recovery stats."""
+        if self.durability is None:
+            raise RuntimeError(
+                "cold_restart requires durability "
+                "(config.durability.wal_dir)"
+            )
+        stats = self.store.recover_in_place(self.durability.dir)
+        self.durability.checkpoint(self.store)
+        self.invalidate_soft_state()
+        self.metrics.counter(
+            "grove_store_recoveries_total",
+            "store recoveries from durable state by outcome",
+        ).inc(outcome=stats["outcome"])
+        return stats
 
     # -- node ops ----------------------------------------------------------
     #: read-modify-write attempts for node mutations before giving up (a

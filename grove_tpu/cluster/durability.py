@@ -1,0 +1,600 @@
+"""Durable state store: write-ahead log, snapshots, cold-restart recovery.
+
+The reference operator is stateless because etcd gives it durable,
+linearizable state for free — a crashed controller-runtime manager relists
+from the apiserver and resumes (SURVEY §2b). grove_tpu owns its apiserver
+(`cluster/store.py`), so it owns the durability story too: without this
+module a whole-process crash loses the cluster, and every resilience
+result (chaos crash-restarts, shard failover) only covers partial
+failures where the store itself survives.
+
+Design — the classic WAL + checkpoint pair, one fsync policy knob:
+
+  WAL        Every committed store mutation ends in exactly one emitted
+             watch event (`ObjectStore._emit`), so the event IS the
+             mutation record: `DurableLog.commit` appends it as one
+             checksummed, length-prefixed record carrying the event seq,
+             the post-write object (resourceVersion included) and the
+             prior version. In-memory event-log compaction is journaled
+             as its own record type so replay reproduces the retained
+             watch window exactly, not just the object table.
+
+  Snapshots  A full pickled store image (objects, retained events,
+             counters, compaction horizon, virtual-clock time), written
+             via tmp+rename with its own checksum, cut on a virtual-time
+             interval or when the live WAL segment exceeds
+             `wal_max_bytes`. Each snapshot rotates the WAL to a fresh
+             segment named by the snapshot seq.
+
+  Truncation Segments are pruned only once every record they hold is ≤
+             the OLDEST retained snapshot's seq (`keep_snapshots` ≥ 2 by
+             default) — the invariant tests/test_durability.py pins:
+             WAL truncation may never outrun the snapshots that still
+             need those records for corruption fallback, and the
+             in-memory compaction horizon never constrains recovery
+             because compaction is itself a WAL record.
+
+  Recovery   `ObjectStore.recover(dir)` / `recover_in_place`: newest
+             snapshot that checksums clean (falling back to older ones —
+             a corrupted snapshot costs replay length, never data), then
+             WAL replay in seq order. A torn tail — a crash mid-append —
+             stops replay at the first short/corrupt record; with
+             `fsync: commit` nothing acknowledged is ever behind the
+             torn record, so recovery is exact.
+
+File layout under `wal_dir`:
+
+    snapshot-<seq:020d>.bin    checksummed store image at seq
+    wal-<seq:020d>.log         records with seq > <seq>, append-only
+
+Fault-injection hooks (`tear_tail`, `corrupt_latest_snapshot`, `stall`)
+are driven by the chaos harness (`chaos/harness.py`: `process_crash`,
+`wal_torn_write`, `snapshot_corruption`, `disk_stall` faults) — the sim
+never actually kills the interpreter, so crash-consistency failure modes
+are injected deterministically instead of left to the OS.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import re
+import struct
+import zlib
+from typing import TYPE_CHECKING, Any, BinaryIO
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (store imports us)
+    from .store import ObjectStore
+
+#: per-file magic headers: a WAL segment opened as a snapshot (or any
+#: foreign file dropped into the dir) is rejected up front, not half-read
+WAL_MAGIC = b"GRVWAL1\n"
+SNAP_MAGIC = b"GRVSNP1\n"
+
+#: record header: <u32 payload length><u32 crc32(payload)>
+_HDR = struct.Struct("<II")
+
+#: record payload types (pickled tuples)
+_REC_EVENT = "event"      # ("event", seq, clock_now, Event)
+_REC_COMPACT = "compact"  # ("compact", lsn, before_seq)
+
+_SNAP_RE = re.compile(r"^snapshot-(\d{20})\.bin$")
+_SEG_RE = re.compile(r"^wal-(\d{20})\.log$")
+_UID_RE = re.compile(r"^uid-(\d+)$")
+
+
+class DurabilityError(Exception):
+    pass
+
+
+def _crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def _write_record(fh: BinaryIO, payload: bytes) -> int:
+    fh.write(_HDR.pack(len(payload), _crc(payload)))
+    fh.write(payload)
+    return _HDR.size + len(payload)
+
+
+def _read_records(path: str):
+    """Yield unpickled records until EOF or the first torn/corrupt record
+    (short header, short payload, or checksum mismatch — all the shapes a
+    crash mid-append leaves). Yields ("__torn__",) as a final sentinel
+    when the tail was torn, so callers can report it."""
+    with open(path, "rb") as fh:
+        if fh.read(len(WAL_MAGIC)) != WAL_MAGIC:
+            yield ("__torn__",)
+            return
+        while True:
+            hdr = fh.read(_HDR.size)
+            if not hdr:
+                return  # clean EOF
+            if len(hdr) < _HDR.size:
+                yield ("__torn__",)
+                return
+            length, crc = _HDR.unpack(hdr)
+            payload = fh.read(length)
+            if len(payload) < length or _crc(payload) != crc:
+                yield ("__torn__",)
+                return
+            try:
+                yield pickle.loads(payload)
+            except Exception:
+                yield ("__torn__",)
+                return
+
+
+class DurableLog:
+    """The write-ahead log + snapshot engine attached to one ObjectStore
+    (`store.attach_durability`). Single-threaded like the store itself;
+    every public method is driven either by the store's commit path or by
+    the recovery/chaos drivers."""
+
+    def __init__(self, config, clock, metrics=None, resume=False):
+        """config: api.config.DurabilityConfig (validated); clock: the
+        SimClock snapshots are paced by; metrics: optional
+        MetricsRegistry for the grove_store_wal_* families.
+
+        resume=False (a fresh store's log) refuses a wal_dir that
+        already holds durable state — journaling a new history over an
+        old one would interleave colliding seqs. resume=True adopts the
+        populated dir WITHOUT touching it: the caller has already
+        recovered the store from it and MUST cut `checkpoint(store)`
+        before any append (no live segment is opened until then) — the
+        Cluster.from_durable / Harness.recover boot path."""
+        if not config.wal_dir:
+            raise DurabilityError("DurableLog requires config.wal_dir")
+        self.dir = config.wal_dir
+        self.config = config
+        self.clock = clock
+        self.metrics = metrics
+        os.makedirs(self.dir, exist_ok=True)
+        #: disk-stall fault state: while > 0, snapshot cuts are deferred
+        #: (the disk is busy; appends still buffer) — chaos ticks it down
+        self.stalled_steps = 0
+        self.snapshots_deferred_total = 0
+        self._stall_deferred = False
+        #: lifetime counters (debug_dump()["store"]["durability"])
+        self.wal_records_total = 0
+        self.wal_bytes_total = 0
+        self.snapshots_total = 0
+        self.last_snapshot_seq = 0
+        self._last_snapshot_time = clock.now()
+        self._segment: BinaryIO | None = None
+        self._segment_bytes = 0
+        if resume:
+            return  # no live segment until the caller's checkpoint()
+        if any(
+            _SNAP_RE.match(n) or _SEG_RE.match(n)
+            for n in os.listdir(self.dir)
+        ):
+            # a fresh store journaling over a previous run's state would
+            # interleave two histories with colliding seqs — refuse.
+            # Boot from the old state with Harness.recover(config) /
+            # Cluster.from_durable, inspect it with
+            # ObjectStore.recover(dir), or point wal_dir at an empty
+            # directory.
+            raise DurabilityError(
+                f"{self.dir!r} already holds durable state; boot from it "
+                "with Harness.recover(config) (or inspect with "
+                "ObjectStore.recover(dir)), or use an empty directory"
+            )
+        self._open_segment(base_seq=0)
+
+    # -- segment plumbing ---------------------------------------------------
+    def _segment_path(self, base_seq: int) -> str:
+        return os.path.join(self.dir, f"wal-{base_seq:020d}.log")
+
+    def _snapshot_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"snapshot-{seq:020d}.bin")
+
+    def _open_segment(self, base_seq: int) -> None:
+        """Truncate-create the segment for records with seq > base_seq.
+        Truncation over an existing file is deliberate: segments open only
+        at init (guarded: the dir must be empty of durable state) and at
+        snapshot/checkpoint cuts, where any same-named leftover — e.g. the
+        torn tail of the very segment a crash-after-snapshot recovery
+        rewound to — holds nothing recovery could reach (a readable record
+        would have advanced the recovered seq past base_seq)."""
+        if self._segment is not None:
+            self._segment.close()
+        self._segment = open(self._segment_path(base_seq), "wb")
+        self._segment.write(WAL_MAGIC)
+        self._segment.flush()
+        self._segment_bytes = self._segment.tell()
+
+    def _fsync(self, fh: BinaryIO, at_snapshot: bool = False) -> None:
+        policy = self.config.fsync
+        if policy == "commit" or (policy == "snapshot" and at_snapshot):
+            os.fsync(fh.fileno())
+
+    def close(self) -> None:
+        if self._segment is not None:
+            self._segment.flush()
+            self._segment.close()
+            self._segment = None
+
+    # -- the commit path ----------------------------------------------------
+    def commit(self, store: "ObjectStore", event) -> None:
+        """Called by ObjectStore._emit for every committed mutation: append
+        the event record, then cut a snapshot when the cadence says so.
+        Records are flushed to the OS per append (in-process recovery must
+        see them); fsync is governed by the policy — `commit` makes every
+        acknowledged write crash-durable, `snapshot`/`never` trade the
+        tail since the last fsync for throughput."""
+        # the clock stamp lets a new-process boot resume virtual time at
+        # the last committed write, not the (older) last snapshot
+        self._append((_REC_EVENT, event.seq, self.clock.now(), event))
+        self._maybe_snapshot(store)
+
+    def log_compaction(self, store: "ObjectStore", before_seq: int) -> None:
+        """Journal an in-memory event-log compaction (compact_events) so
+        replay reproduces the retained watch window bit-identically. The
+        WAL itself is NOT truncated here — WAL truncation is tied to
+        snapshots alone (see prune in _snapshot), which is the invariant
+        that keeps the compaction horizon from ever outrunning what
+        recovery needs."""
+        self._append((_REC_COMPACT, store.last_seq, before_seq))
+
+    def _append(self, rec: tuple) -> None:
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        n = _write_record(self._segment, payload)
+        self._segment.flush()
+        self._fsync(self._segment)
+        self._segment_bytes += n
+        self.wal_records_total += 1
+        self.wal_bytes_total += n
+        if self.metrics is not None:
+            self.metrics.counter(
+                "grove_store_wal_records_total",
+                "WAL records appended",
+            ).inc()
+            self.metrics.counter(
+                "grove_store_wal_bytes_total",
+                "WAL bytes appended",
+            ).inc(n)
+
+    # -- snapshots ----------------------------------------------------------
+    def _maybe_snapshot(self, store: "ObjectStore") -> None:
+        cfg = self.config
+        due = (
+            self.clock.now() - self._last_snapshot_time
+            >= cfg.snapshot_interval_seconds
+            or self._segment_bytes >= cfg.wal_max_bytes
+        )
+        if not due:
+            return
+        if self.stalled_steps > 0:
+            # disk_stall fault: the device is busy — appends buffer, but
+            # checkpoint work defers (recovery replay just gets longer).
+            # Counted once per DEFERRED CUT (reset when one lands), not
+            # once per commit while the stall holds the cut back.
+            if not self._stall_deferred:
+                self._stall_deferred = True
+                self.snapshots_deferred_total += 1
+            return
+        self.snapshot(store)
+
+    def checkpoint(self, store: "ObjectStore") -> int:
+        """Post-recovery checkpoint: clear any armed disk stall and force
+        a snapshot + segment rotation at the recovered seq, so the old —
+        possibly torn — tail is sealed behind a fresh generation and is
+        never appended over. os.replace also heals a corrupted snapshot
+        file at the same seq."""
+        self.stalled_steps = 0
+        return self.snapshot(store, force=True)
+
+    def snapshot(self, store: "ObjectStore", force: bool = False) -> int | None:
+        """Cut a checksummed snapshot of the full store state at
+        store.last_seq, rotate the WAL to a fresh segment, and prune
+        snapshots/segments past the retention window. Returns the
+        snapshot seq, or None when nothing changed since the last cut."""
+        seq = store.last_seq
+        if seq == self.last_snapshot_seq and self.snapshots_total and not force:
+            self._last_snapshot_time = self.clock.now()
+            return None
+        state = {
+            "format": 1,
+            "last_seq": seq,
+            "uid": store._uid,
+            "compacted_seq": store._compacted_seq,
+            "kind_serial": dict(store._kind_serial),
+            "objs": {k: dict(b) for k, b in store._objs.items() if b},
+            "events": list(store._events),
+            "clock": store.clock.now(),
+        }
+        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        path = self._snapshot_path(seq)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(SNAP_MAGIC)
+            fh.write(_HDR.pack(len(payload), _crc(payload)))
+            fh.write(payload)
+            fh.flush()
+            self._fsync(fh, at_snapshot=True)
+        os.replace(tmp, path)
+        self.snapshots_total += 1
+        self._stall_deferred = False
+        self.last_snapshot_seq = seq
+        self._last_snapshot_time = self.clock.now()
+        if self.metrics is not None:
+            self.metrics.counter(
+                "grove_store_snapshots_total", "durable snapshots cut"
+            ).inc()
+        self._open_segment(base_seq=seq)
+        self._prune()
+        return seq
+
+    def _prune(self) -> None:
+        """Retention: keep the newest `keep_snapshots` snapshots; drop WAL
+        segments whose every record is ≤ the oldest retained snapshot seq
+        (a segment covers (base, next_base]; it is disposable only when
+        the NEXT segment's base is within the retained horizon)."""
+        snaps = self.snapshot_seqs()
+        keep = max(1, self.config.keep_snapshots)
+        for seq in snaps[:-keep]:
+            os.unlink(self._snapshot_path(seq))
+        retained = snaps[-keep:] if snaps else []
+        # the pruning horizon is the oldest retained snapshot — but only
+        # once a FULL retention window exists: with fewer generations the
+        # deepest corruption fallback is the empty store + full replay,
+        # which needs every segment (the invariant a one-snapshot prune
+        # would break: corrupt that snapshot and the history is gone)
+        horizon = retained[0] if len(retained) == keep else 0
+        bases = self.segment_bases()
+        for base, next_base in zip(bases, bases[1:]):
+            if next_base <= horizon:
+                os.unlink(self._segment_path(base))
+
+    # -- directory introspection -------------------------------------------
+    def snapshot_seqs(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def segment_bases(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            m = _SEG_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def wal_floor(self) -> int:
+        """Oldest seq the retained WAL can replay from (the first
+        segment's base). The pinned truncation invariant:
+        wal_floor() <= oldest retained snapshot seq, always."""
+        bases = self.segment_bases()
+        return bases[0] if bases else 0
+
+    def debug_state(self) -> dict[str, Any]:
+        snaps = self.snapshot_seqs()
+        return {
+            "wal_dir": self.dir,
+            "fsync": self.config.fsync,
+            "wal_records_total": self.wal_records_total,
+            "wal_bytes_total": self.wal_bytes_total,
+            "segment_bytes": self._segment_bytes,
+            "segments": len(self.segment_bases()),
+            "snapshots_total": self.snapshots_total,
+            "snapshots_retained": len(snaps),
+            "last_snapshot_seq": self.last_snapshot_seq,
+            "snapshots_deferred_total": self.snapshots_deferred_total,
+            "stalled_steps": self.stalled_steps,
+        }
+
+    # -- chaos fault hooks --------------------------------------------------
+    def tear_tail(self) -> None:
+        """Simulate a crash mid-append: a record header claiming more
+        bytes than follow lands at the segment tail — exactly what a torn
+        write leaves. The record was never acknowledged, so recovery
+        stopping at it loses nothing committed."""
+        self._segment.write(_HDR.pack(1 << 20, 0))
+        self._segment.write(b"torn-in-flight-append")
+        self._segment.flush()
+
+    def corrupt_latest_snapshot(self) -> str | None:
+        """Flip bytes in the middle of the newest snapshot (bit-rot /
+        partial page write): recovery must detect the checksum mismatch
+        and fall back to the previous retained snapshot."""
+        snaps = self.snapshot_seqs()
+        if not snaps:
+            return None
+        path = self._snapshot_path(snaps[-1])
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.seek(max(len(SNAP_MAGIC) + _HDR.size, size // 2))
+            fh.write(b"\xde\xad\xbe\xef")
+        return path
+
+    def stall(self, steps: int) -> None:
+        """Arm a disk stall for `steps` chaos steps: snapshot cuts defer
+        until the stall clears (tick_stall)."""
+        self.stalled_steps = max(self.stalled_steps, int(steps))
+
+    def tick_stall(self) -> None:
+        if self.stalled_steps > 0:
+            self.stalled_steps -= 1
+
+
+def _try_load_snapshot(path: str) -> dict | None:
+    """The snapshot image when magic + checksum + unpickle all pass,
+    else None (corruption falls back, never crashes recovery)."""
+    try:
+        with open(path, "rb") as fh:
+            if fh.read(len(SNAP_MAGIC)) != SNAP_MAGIC:
+                return None
+            hdr = fh.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return None
+            length, crc = _HDR.unpack(hdr)
+            payload = fh.read(length)
+        if len(payload) < length or _crc(payload) != crc:
+            return None
+        state = pickle.loads(payload)
+        if not isinstance(state, dict) or state.get("format") != 1:
+            return None
+        return state
+    except Exception:
+        return None
+
+
+def _replay_event(store: "ObjectStore", ev) -> None:
+    """Re-apply one journaled mutation to the store internals (bypassing
+    _emit — replay must not re-journal). The event carries the complete
+    post-write MVCC version, so application is a straight install."""
+    key = (ev.namespace, ev.name)
+    bucket = store._objs.setdefault(ev.kind, {})
+    if ev.type == "Deleted":
+        old = bucket.pop(key, None)
+        if old is not None:
+            store._index_remove(ev.kind, key, old)
+    else:
+        old = bucket.get(key)
+        if old is not None:
+            store._index_remove(ev.kind, key, old)
+        bucket[key] = ev.obj
+        store._index_add(ev.kind, key, ev.obj)
+    store._kind_serial[ev.kind] = ev.seq
+    store._events.append(ev)
+
+
+def load_durable_state(wal_dir: str, store: "ObjectStore") -> dict[str, Any]:
+    """Rebuild `store` (whose state containers must be empty) from the
+    durable dir: newest valid snapshot, then WAL replay in seq order,
+    torn-tail tolerant. Returns the recovery stats dict (also stashed on
+    the store as `recovery_stats` by the callers)."""
+    if not os.path.isdir(wal_dir):
+        raise DurabilityError(f"no durable state at {wal_dir!r}")
+    names = os.listdir(wal_dir)
+    if not any(_SNAP_RE.match(n) or _SEG_RE.match(n) for n in names):
+        # an existing-but-empty (or mistyped) directory must fail LOUD:
+        # "recovering" an empty store from the wrong path would read as
+        # the whole cluster history silently vanishing — on the exact
+        # code path whose job is disaster recovery. (A legitimately
+        # fresh deployment starts through Cluster/DurableLog, which
+        # writes the genesis segment before any recovery can run.)
+        raise DurabilityError(
+            f"{wal_dir!r} holds no durable state (no snapshot or WAL "
+            "segment) — wrong directory?"
+        )
+    snap_seqs = sorted(
+        int(m.group(1)) for m in map(_SNAP_RE.match, names) if m
+    )
+    snap_paths = [
+        os.path.join(wal_dir, f"snapshot-{seq:020d}.bin")
+        for seq in snap_seqs
+    ]
+    state = None
+    snapshots_skipped = 0
+    for path in reversed(snap_paths):
+        state = _try_load_snapshot(path)
+        if state is not None:
+            break
+        snapshots_skipped += 1
+        # QUARANTINE the corrupt image (kept for forensics, excluded from
+        # the snapshot namespace): a corrupt file must never count as a
+        # retained generation again — the retention window that prunes
+        # WAL segments assumes every retained snapshot can actually
+        # anchor a fallback, and a corrupt one silently breaking that
+        # assumption is how history gets lost on the SECOND corruption
+        os.replace(path, path + ".corrupt")
+    snapshot_seq = 0
+    if state is not None:
+        snapshot_seq = state["last_seq"]
+        store._uid = state["uid"]
+        store._compacted_seq = state["compacted_seq"]
+        store._kind_serial = dict(state["kind_serial"])
+        store._objs = {k: dict(b) for k, b in state["objs"].items()}
+        store._events = list(state["events"])
+        for kind, bucket in store._objs.items():
+            for key, obj in bucket.items():
+                store._index_add(kind, key, obj)
+        if hasattr(store.clock, "_now"):
+            # recovery never rewinds a live clock (in-place recovery on a
+            # running harness); a fresh clock adopts the snapshot time
+            store.clock._now = max(store.clock._now, state["clock"])
+
+    replayed = 0
+    torn = False
+    max_uid = store._uid
+    applied_seq = snapshot_seq
+    bases = sorted(
+        int(m.group(1)) for m in map(_SEG_RE.match, names) if m
+    )
+    for i, base in enumerate(bases):
+        # a segment is skippable when the NEXT segment starts at or below
+        # the snapshot (every record in it predates the snapshot)
+        if i + 1 < len(bases) and bases[i + 1] <= snapshot_seq:
+            continue
+        if base > applied_seq:
+            # the chain has a hole: this segment's records start past the
+            # recovered position (every anchoring snapshot AND the
+            # bridging segments are gone — e.g. more corrupted snapshots
+            # than keep_snapshots covers). Splicing disjoint histories
+            # would hand back a silently inconsistent store; fail loud.
+            raise DurabilityError(
+                f"unrecoverable durable state in {wal_dir!r}: no valid "
+                f"snapshot anchors seq {base} (recovered up to "
+                f"{applied_seq}); retained history has a gap"
+            )
+        seg_torn = False
+        for rec in _read_records(os.path.join(wal_dir, f"wal-{base:020d}.log")):
+            if rec[0] == "__torn__":
+                torn = seg_torn = True
+                break
+            if rec[0] == _REC_EVENT:
+                _, seq, stamp, ev = rec
+                if seq <= applied_seq:
+                    continue  # covered by the snapshot (or duplicate)
+                _replay_event(store, ev)
+                if hasattr(store.clock, "_now"):
+                    store.clock._now = max(store.clock._now, stamp)
+                applied_seq = seq
+                replayed += 1
+                if ev.type == "Added":
+                    m = _UID_RE.match(ev.obj.metadata.uid or "")
+                    if m:
+                        max_uid = max(max_uid, int(m.group(1)) + 1)
+            elif rec[0] == _REC_COMPACT:
+                # journaled with the post-clamp horizon; idempotent, so a
+                # compaction already reflected in the snapshot re-applies
+                # as a no-op (events ≤ horizon are long gone, max() keeps
+                # the newer _compacted_seq)
+                _, _lsn, before_seq = rec
+                store._events = [
+                    e for e in store._events if e.seq > before_seq
+                ]
+                store._compacted_seq = max(
+                    store._compacted_seq, before_seq
+                )
+        if seg_torn and not (
+            i + 1 < len(bases) and bases[i + 1] <= applied_seq
+        ):
+            # a torn record ends the stream UNLESS the next segment
+            # resumes at or below the replay position (the layout a
+            # post-recovery checkpoint leaves: the sealed torn tail is
+            # fully covered by the next generation) — replaying past a
+            # genuine gap would splice disjoint histories
+            break
+    store._uid = max_uid
+    last = store._events[-1].seq if store._events else store._compacted_seq
+    store._seq = itertools.count(last + 1)
+    outcome = "clean"
+    if snapshots_skipped:
+        outcome = "snapshot_fallback"
+    elif torn:
+        outcome = "torn_tail"
+    return {
+        "outcome": outcome,
+        "snapshot_seq": snapshot_seq,
+        "snapshots_skipped": snapshots_skipped,
+        "wal_records_replayed": replayed,
+        "torn_tail": torn,
+        "recovered_last_seq": last,
+    }

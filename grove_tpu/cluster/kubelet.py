@@ -166,6 +166,20 @@ class SimKubelet:
                     self._nodes.add(ev.name)
                     self._nodes_lost.discard(ev.name)
 
+    def reset_for_recovery(self) -> None:
+        """Re-sync against a store whose state was REPLACED under us (a
+        control-plane cold restart recovered it from disk): the event
+        cursor may point past the recovered head, and the incremental
+        candidate/ready/node sets may reflect writes the recovery rolled
+        back — relist everything from live state, like an informer after
+        its watch connection died. Kubelet-side infrastructure truth
+        (crashed containers, suppressed heartbeats) survives: the node
+        agents did not restart, the control plane did."""
+        self._cursor = self.store.last_seq
+        self._authz_cache.clear()
+        self._nodes_lost.clear()
+        self._relist()
+
     def crash_pod(self, namespace: str, name: str) -> None:
         """Container crash: pod stays bound/Running but NotReady until
         recover_pod(); restart_count marks it unhealthy for MinAvailable."""

@@ -263,7 +263,19 @@ class ObjectStore:
         self._compacted_seq = 0  # compaction horizon (see compact_events)
         self._kind_serial: dict[str, int] = {}
         self._seq = itertools.count(1)
-        self._uid = itertools.count(1)
+        # next uid number (a plain int, not itertools.count: the durable
+        # snapshot must capture and restore the counter position exactly —
+        # recycling a deleted object's uid after recovery would diverge
+        # from a never-crashed store)
+        self._uid = 1
+        #: write-ahead log (cluster/durability.DurableLog) when attached;
+        #: None = the classic in-memory-only store — the hot path pays one
+        #: predicted-not-taken branch per commit
+        self._wal = None
+        self.durability = None
+        #: stats of the last recover()/recover_in_place() (None = this
+        #: store never recovered from disk)
+        self.recovery_stats: dict | None = None
         #: authorize(actor, verb, obj) -> None | raise Forbidden. None =
         #: authorization disabled (the default; see api.config).
         self.authorizer: Optional[Callable[[str, str, Any], None]] = None
@@ -397,6 +409,12 @@ class ObjectStore:
         dropped = before - len(self._events)
         if dropped:
             self._compacted_seq = max(self._compacted_seq, before_seq)
+            if self._wal is not None:
+                # journal the (post-clamp) horizon: replay must reproduce
+                # the retained watch window, not just the object table.
+                # The WAL itself is never truncated here — its truncation
+                # is tied to snapshots (durability.DurableLog._prune)
+                self._wal.log_compaction(self, before_seq)
         return dropped
 
     def relist(self) -> tuple[list[Event], int]:
@@ -454,17 +472,21 @@ class ObjectStore:
         — so events reference versions directly; no snapshot copies."""
         seq = next(self._seq)
         self._kind_serial[obj.KIND] = seq
-        self._events.append(
-            Event(
-                seq=seq,
-                type=type_,
-                kind=obj.KIND,
-                namespace=obj.metadata.namespace,
-                name=obj.metadata.name,
-                obj=obj,
-                old=old,
-            )
+        event = Event(
+            seq=seq,
+            type=type_,
+            kind=obj.KIND,
+            namespace=obj.metadata.namespace,
+            name=obj.metadata.name,
+            obj=obj,
+            old=old,
         )
+        self._events.append(event)
+        if self._wal is not None:
+            # durability: the emitted event IS the committed mutation —
+            # one WAL record per write, snapshots cut on cadence inside
+            # (cluster/durability.py)
+            self._wal.commit(self, event)
 
     def kind_serial(self, kind: str) -> int:
         """Monotonic change marker: the seq of the last write touching
@@ -564,7 +586,8 @@ class ObjectStore:
         if key in bucket:
             raise AlreadyExists(f"{kind} {key} already exists")
         meta = obj.metadata
-        meta.uid = f"uid-{next(self._uid)}"
+        meta.uid = f"uid-{self._uid}"
+        self._uid += 1
         meta.generation = 1
         meta.resource_version = next(self._seq)
         meta.creation_timestamp = self.clock.now()
@@ -769,6 +792,54 @@ class ObjectStore:
                 current.KIND, _key(namespace, name), current,
                 lambda m: m.finalizers.append(finalizer),
             )
+
+    # -- durability ---------------------------------------------------------
+    def attach_durability(self, log) -> None:
+        """Attach a cluster.durability.DurableLog: every committed
+        mutation from here on is write-ahead logged and snapshotted on
+        cadence. Attach BEFORE the first write so the WAL covers the
+        whole history (Cluster does this right after store construction);
+        a recovery then needs no out-of-band bootstrap state."""
+        self._wal = log
+        self.durability = log
+
+    @classmethod
+    def recover(cls, wal_dir: str, clock: SimClock | None = None) -> "ObjectStore":
+        """Cold-start recovery: rebuild a store from the durable state at
+        `wal_dir` — newest valid snapshot (checksum-verified, falling
+        back to older retained ones on corruption) + WAL replay in seq
+        order, torn-tail tolerant. The result is bit-identical to the
+        crashed store up to the last durable record: objects, retained
+        event log, compaction horizon, kind serials, and the seq/uid
+        counters all resume exactly. Recovery stats land on
+        `recovery_stats`. The returned store has NO DurableLog attached
+        (and no admission/authorizer wiring) — callers re-wire those, or
+        use Harness.cold_restart which does (docs/operations.md "Cold
+        restart & disaster recovery")."""
+        from .durability import load_durable_state
+
+        store = cls(clock=clock)
+        store.recovery_stats = load_durable_state(wal_dir, store)
+        return store
+
+    def recover_in_place(self, wal_dir: str) -> dict:
+        """Replace THIS store's state with the recovered image, keeping
+        every piece of runtime wiring (admission chains, authorizer,
+        actor, flight recorder, attached DurableLog, clock) — how a
+        process-crash fault recovers mid-run without re-plumbing every
+        store reference (kubelet, cluster, chaos proxy). Returns the
+        recovery stats."""
+        from .durability import load_durable_state
+
+        self._objs = {}
+        self._events = []
+        self._label_idx = {}
+        self._kind_serial = {}
+        self._compacted_seq = 0
+        self._seq = itertools.count(1)
+        self._uid = 1
+        self.recovery_stats = load_durable_state(wal_dir, self)
+        return self.recovery_stats
 
     # -- garbage collection ------------------------------------------------
     def collect_orphans(self) -> int:

@@ -24,6 +24,31 @@ from itertools import count as _count
 _replica_counter = _count()
 
 
+def _expire_coordination_objects(store, config) -> None:
+    """Delete a crashed process's coordination objects: every Lease
+    outside kube-node-lease (leader election, shard workers, shard
+    coordinator) plus the ShardMap. Node heartbeat leases survive — the
+    kubelet fleet did not crash. The deletions go through the store like
+    any write — journaled, so a second crash during recovery replays
+    them too. Module-level (not a Harness method) because Harness.recover
+    must run it BEFORE the managers are built."""
+    from ..cluster.nodehealth import NODE_LEASE_NAMESPACE
+    from .leaderelection import Lease
+    from .sharding import ShardMap
+
+    doomed = [
+        (Lease.KIND, o.metadata.namespace, o.metadata.name)
+        for o in store.scan(Lease.KIND)
+        if o.metadata.namespace != NODE_LEASE_NAMESPACE
+    ] + [
+        (ShardMap.KIND, o.metadata.namespace, o.metadata.name)
+        for o in store.scan(ShardMap.KIND)
+    ]
+    with store.impersonate(config.authorization.operator_identity):
+        for kind, ns, name in doomed:
+            store.delete(kind, ns, name)
+
+
 class Harness:
     def __init__(self, nodes: list[Node] | None = None,
                  cluster: Cluster | None = None, engine_cls=None,
@@ -172,6 +197,62 @@ class Harness:
         self.scheduler = owner.components["scheduler"]
         self.autoscaler = owner.components["autoscaler"]
         self.node_monitor = owner.components["node_monitor"]
+
+    @classmethod
+    def recover(cls, config: OperatorConfig | dict,
+                engine_cls=None) -> "Harness":
+        """Boot a GENUINELY NEW process from the durable state at
+        `config.durability.wal_dir` — the disaster-recovery path when
+        the crashed predecessor's process is gone (cold_restart covers
+        the in-process crash model). The store is rebuilt bit-identical
+        from disk (latest valid snapshot + WAL replay, torn-tail
+        tolerant), a boot checkpoint seals the pre-crash tail, the dead
+        process's coordination leases and ShardMap are expired, and the
+        fresh manager/scheduler/kubelet derive their soft state exactly
+        like any cold restart; settle() then reaches the pre-crash
+        fixpoint. Journaling RESUMES into the same wal_dir."""
+        if isinstance(config, dict):
+            config = load_operator_config(config)
+        cluster = Cluster.from_durable(config)
+        # expire BEFORE the managers are built, mirroring cold_restart's
+        # expire -> rebuild order: a ShardedManager constructed against
+        # the dead fleet's ShardMap would adopt its shard width instead
+        # of the (possibly changed) config's
+        _expire_coordination_objects(cluster.store, cluster.config)
+        return cls(cluster=cluster, engine_cls=engine_cls)
+
+    def cold_restart(self) -> dict:
+        """Whole-process crash-restart from durable state (requires
+        config.durability.wal_dir): the live store is dropped and
+        recovered from disk (latest valid snapshot + WAL replay —
+        Cluster.cold_restart), then every piece of soft state is
+        re-derived the way a genuinely fresh process would derive it:
+
+          - control-plane coordination EXPIRES: the dead process's
+            leader-election lease, shard worker/coordinator leases and
+            the ShardMap are deleted, so the rebuilt manager re-elects
+            and rebuilds the shard map from scratch (node heartbeat
+            leases in kube-node-lease are infrastructure state and
+            survive — the kubelet fleet did not crash);
+          - a brand-new manager + reconciler set (cursor 0: replay, or
+            relist past the compaction horizon), fresh scheduler with
+            reservations reconstructed from bound pods, fresh engine
+            (device state rebuilt — the free-delta journal was reset by
+            Cluster.invalidate_soft_state);
+          - the kubelet relists against the recovered store.
+
+        After settle() the control plane reaches the same fixpoint a
+        never-crashed run holds (tests/test_durability.py pins this;
+        chaos arms it as the process_crash fault). Returns the recovery
+        stats dict."""
+        stats = self.cluster.cold_restart()
+        self._expire_coordination()
+        self._build_manager()
+        self.kubelet.reset_for_recovery()
+        return stats
+
+    def _expire_coordination(self) -> None:
+        _expire_coordination_objects(self.store, self.config)
 
     def autoscale(self) -> None:
         """One periodic HPA sweep + settle (the HPA sync interval). The

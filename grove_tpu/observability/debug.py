@@ -71,7 +71,16 @@ def manager_dump(manager) -> dict[str, Any]:
 
 def store_dump(store) -> dict[str, Any]:
     counts = store.object_counts()
+    # durable state store (cluster/durability.py): WAL/snapshot
+    # bookkeeping + the last recovery's stats. {"enabled": False} when
+    # running in-memory-only (the default).
+    dur = getattr(store, "durability", None)
+    durability: dict[str, Any] = {"enabled": dur is not None}
+    if dur is not None:
+        durability.update(dur.debug_state())
+        durability["last_recovery"] = getattr(store, "recovery_stats", None)
     return {
+        "durability": durability,
         "objects_by_kind": counts,
         "event_log_length": store.event_log_length,
         "last_seq": store.last_seq,

@@ -107,12 +107,41 @@ TENANT_SKEW_CONFIG = {
 }
 
 
+#: durability config for --durability sweeps: aggressive snapshot cadence
+#: so crashes land on every recovery path (fresh WAL tail, snapshot +
+#: replay, post-checkpoint generations). fsync "never" deliberately: the
+#: sim never kills the interpreter, so physical-durability tears are
+#: injected explicitly (wal_torn_write), and skipping fsync keeps the
+#: sweep fast on CI disks.
+DURABILITY_CONFIG = {
+    "fsync": "never",
+    "snapshot_interval_seconds": 30.0,
+    "wal_max_bytes": 262144,
+}
+
+
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
              explain_dir: Path | None = None,
              tenant_skew: bool = False,
-             shards: int = 1) -> dict:
+             shards: int = 1,
+             durability: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    wal_tmp = None
+    if durability:
+        # the durable-store fault axis: whole-process crashes recovering
+        # from disk mid-plan, torn WAL tails, corrupted snapshots, disk
+        # stalls — convergence is still checked against the same
+        # fault-free fixpoint (recovery must be workload-invisible)
+        overrides.update(
+            process_crash_rate=0.12,
+            wal_torn_write_rate=0.4,
+            snapshot_corruption_rate=0.3,
+            disk_stall_rate=0.1,
+        )
+        import tempfile
+
+        wal_tmp = tempfile.TemporaryDirectory(prefix=f"grove-wal-{seed}-")
     if shards > 1:
         # the shard-failover axis: worker crashes, frozen map views,
         # handoff storms — convergence is still checked against the
@@ -131,6 +160,26 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     config = dict(TENANT_SKEW_CONFIG) if tenant_skew else {}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
+    if wal_tmp is not None:
+        config = {
+            **config,
+            "durability": {**DURABILITY_CONFIG, "wal_dir": wal_tmp.name},
+        }
+    try:
+        return _run_seed_inner(
+            seed, nodes, baseline, plan, config, trace_path,
+            explain_dir, durability,
+        )
+    finally:
+        # exception-safe: a seed that raises out of harness construction
+        # or the dump paths must not leak its per-seed WAL dir across a
+        # multi-seed CI sweep
+        if wal_tmp is not None:
+            wal_tmp.cleanup()
+
+
+def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
+                    explain_dir, durability) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -166,6 +215,11 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         "manager_restarts": ch.manager_restarts,
         "wall_seconds": round(time.perf_counter() - t0, 3),
     }
+    if durability:
+        result["process_restarts"] = ch.process_restarts
+        result["recovery_outcomes"] = [
+            s["outcome"] for s in ch.recovery_stats
+        ]
     if not ok and trace_path is not None:
         # every failure class leaves the postmortem, not just the wedged
         # settle that settle_recovered auto-dumps (a diverged fingerprint
@@ -223,6 +277,17 @@ def main(argv=None) -> int:
                          "convergence is checked against the "
                          "single-replica fault-free fixpoint with the "
                          "ownership audit armed")
+    ap.add_argument("--durability", action="store_true",
+                    help="arm the durable-store fault axis: the harness "
+                         "runs with a write-ahead-logged store "
+                         "(per-seed temp wal_dir) and the plan adds "
+                         "seeded whole-process crashes that recover "
+                         "from disk mid-plan (snapshot + WAL replay, "
+                         "soft state re-derived), torn WAL tails, "
+                         "corrupted snapshots (recovery falls back to "
+                         "the previous retained generation), and disk "
+                         "stalls; convergence is checked against the "
+                         "same fault-free fixpoint")
     ap.add_argument("--tenant-skew", dest="tenant_skew",
                     action="store_true",
                     help="enable tenant-skew load faults: tenancy "
@@ -261,7 +326,8 @@ def main(argv=None) -> int:
         result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir,
                           explain_dir=explain_dir,
                           tenant_skew=args.tenant_skew,
-                          shards=args.shards)
+                          shards=args.shards,
+                          durability=args.durability)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -271,6 +337,7 @@ def main(argv=None) -> int:
         "start": args.start,
         "nodes": args.nodes,
         "shards": args.shards,
+        "durability": args.durability,
         "failed_seeds": failed,
         "ok": not failed,
     }

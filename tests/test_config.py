@@ -140,6 +140,62 @@ class TestConfigDecode:
         assert h.manager.error_backoff_max_seconds == 40.0
         assert h.manager.error_retry_budget == 4
 
+    def test_durability_defaults_and_decode(self):
+        cfg = load_operator_config({})
+        assert cfg.durability.wal_dir is None  # off by default
+        assert cfg.durability.fsync == "commit"
+        assert cfg.durability.keep_snapshots == 2
+        cfg = load_operator_config({"durability": {
+            "wal_dir": "/tmp/grove-wal",
+            "fsync": "snapshot",
+            "snapshot_interval_seconds": 60.0,
+            "wal_max_bytes": 1 << 20,
+            "keep_snapshots": 3,
+        }})
+        assert cfg.durability.wal_dir == "/tmp/grove-wal"
+        assert cfg.durability.fsync == "snapshot"
+        assert cfg.durability.snapshot_interval_seconds == 60.0
+
+    def test_durability_rejected_combinations(self):
+        # disabling is wal_dir: null, never the empty string
+        with pytest.raises(ValidationError, match="wal_dir"):
+            load_operator_config({"durability": {"wal_dir": ""}})
+        with pytest.raises(ValidationError, match="fsync"):
+            load_operator_config(
+                {"durability": {"fsync": "always"}}  # not a policy
+            )
+        with pytest.raises(ValidationError,
+                           match="snapshot_interval_seconds"):
+            load_operator_config(
+                {"durability": {"snapshot_interval_seconds": 0}}
+            )
+        # a segment bound below one record forces a snapshot per write
+        with pytest.raises(ValidationError, match="wal_max_bytes"):
+            load_operator_config({"durability": {"wal_max_bytes": 512}})
+        # < 2 retained generations breaks corrupted-snapshot fallback
+        with pytest.raises(ValidationError, match="keep_snapshots"):
+            load_operator_config({"durability": {"keep_snapshots": 1}})
+        # aggregated like every other block
+        with pytest.raises(ValidationError) as e:
+            load_operator_config({"durability": {
+                "fsync": "maybe",
+                "wal_max_bytes": -1,
+                "keep_snapshots": 0,
+            }})
+        assert sum("durability" in m for m in e.value.errors) == 3
+
+    def test_durability_knobs_reach_the_store(self, tmp_path):
+        h = Harness(
+            nodes=make_nodes(2),
+            config={"durability": {"wal_dir": str(tmp_path / "wal"),
+                                   "fsync": "never"}},
+        )
+        assert h.cluster.durability is not None
+        assert h.store.durability is h.cluster.durability
+        assert h.cluster.durability.config.fsync == "never"
+        # and off-by-default leaves the store WAL-less
+        assert Harness(nodes=make_nodes(2)).cluster.durability is None
+
     def test_topology_levels_validation(self):
         with pytest.raises(ValidationError, match="duplicate domain"):
             load_operator_config(

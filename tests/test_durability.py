@@ -1,0 +1,644 @@
+"""Durable state store: WAL + snapshots + cold-restart recovery.
+
+The contract (cluster/durability.py): every committed mutation is
+write-ahead logged, snapshots bound replay, and recovery — latest valid
+snapshot + WAL replay, torn-tail tolerant — rebuilds a BIT-IDENTICAL
+store: objects, retained event log, compaction horizon, kind serials,
+and the seq/uid counters all resume exactly where the crashed store
+stopped. On top of it, `Harness.cold_restart` re-derives all soft state
+(leases expired, ShardMap rebuilt, scheduler reservations reconstructed,
+caches invalidated) and settles to the same fixpoint a never-crashed run
+holds; chaos arms it as the `process_crash` / `wal_torn_write` /
+`snapshot_corruption` / `disk_stall` faults.
+"""
+
+import io
+import os
+
+import pytest
+
+from grove_tpu.api.auxiliary import PriorityClass
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Pod, PodCliqueSet
+from grove_tpu.chaos import (
+    ChaosHarness,
+    FaultPlan,
+    check_invariants,
+    settled_fingerprint,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.cluster.durability import DurabilityError, DurableLog
+from grove_tpu.cluster.store import ObjectStore
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+NODES = 16
+
+#: fast-cadence durability config: snapshots actually happen in tests
+DUR = {
+    "fsync": "never",
+    "snapshot_interval_seconds": 30.0,
+    "wal_max_bytes": 65536,
+}
+
+
+def durable_config(wal_dir, **overrides):
+    return {"durability": {**DUR, "wal_dir": str(wal_dir), **overrides}}
+
+
+def durable_harness(tmp_path, nodes=NODES, **config):
+    cfg = durable_config(tmp_path / "wal")
+    cfg.update(config)
+    return Harness(nodes=make_nodes(nodes), config=cfg)
+
+
+def assert_bit_identical(recovered: ObjectStore, live: ObjectStore):
+    """The tentpole claim, field by field: the recovered store IS the
+    crashed store up to the last durable record."""
+    assert recovered.last_seq == live.last_seq
+    assert recovered.compaction_horizon == live.compaction_horizon
+    assert recovered._kind_serial == live._kind_serial
+    assert recovered._uid == live._uid
+    assert recovered.event_log_length == live.event_log_length
+    for mine, theirs in zip(recovered._events, live._events):
+        assert mine == theirs
+    live_objs = {k: b for k, b in live._objs.items() if b}
+    rec_objs = {k: b for k, b in recovered._objs.items() if b}
+    assert rec_objs.keys() == live_objs.keys()
+    for kind, bucket in live_objs.items():
+        assert rec_objs[kind].keys() == bucket.keys(), kind
+        for key, obj in bucket.items():
+            assert rec_objs[kind][key] == obj, (kind, key)
+
+
+def workload():
+    return simple_pcs(cliques=[clique("w", replicas=3)])
+
+
+class TestWalRoundTrip:
+    def test_recover_is_bit_identical(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert recovered.recovery_stats["outcome"] == "clean"
+        assert_bit_identical(recovered, h.store)
+        assert settled_fingerprint(recovered) == settled_fingerprint(
+            h.store
+        )
+
+    def test_every_mutation_path_is_journaled(self, tmp_path):
+        """create / update / update_status / patch_status / bind_pod /
+        ungate_pod / finalizers / delete / GC all flow through _emit and
+        therefore the WAL; the replayed store matches after each."""
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        store = h.store
+        # spec update (generation bump)
+        pcs = store.get(PodCliqueSet.KIND, "default", "simple1")
+        pcs.spec.replicas = 2
+        store.update(pcs)
+        h.settle()
+        # user-level delete cascades through finalizers + GC
+        store.delete(PodCliqueSet.KIND, "default", "simple1")
+        h.settle()
+        assert store.list(Pod.KIND) == []
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert_bit_identical(recovered, store)
+
+    def test_uid_counter_never_recycles_after_recovery(self, tmp_path):
+        h = durable_harness(tmp_path)
+        store = h.store
+        pc = store.create(PriorityClass(
+            metadata=ObjectMeta(name="doomed", namespace=""), value=1.0
+        ))
+        store.delete(PriorityClass.KIND, "", "doomed")
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        mine = recovered.create(PriorityClass(
+            metadata=ObjectMeta(name="next", namespace=""), value=1.0
+        ))
+        theirs = store.create(PriorityClass(
+            metadata=ObjectMeta(name="next", namespace=""), value=1.0
+        ))
+        assert mine.metadata.uid == theirs.metadata.uid
+        assert mine.metadata.uid != pc.metadata.uid
+        assert mine.metadata.resource_version == (
+            theirs.metadata.resource_version
+        )
+
+    def test_durability_off_by_default(self, tmp_path):
+        h = Harness(nodes=make_nodes(4))
+        assert h.cluster.durability is None
+        assert h.store.durability is None
+        with pytest.raises(RuntimeError, match="durability"):
+            h.cluster.cold_restart()
+
+    def test_fresh_cluster_refuses_a_populated_wal_dir(self, tmp_path):
+        durable_harness(tmp_path)
+        with pytest.raises(DurabilityError, match="already holds"):
+            durable_harness(tmp_path)
+
+
+class TestTornTail:
+    def test_torn_inflight_append_loses_nothing_committed(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        h.cluster.durability.tear_tail()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert recovered.recovery_stats["outcome"] == "torn_tail"
+        assert recovered.recovery_stats["torn_tail"] is True
+        assert_bit_identical(recovered, h.store)
+
+    def test_truncated_committed_record_rewinds_exactly_one_write(
+        self, tmp_path
+    ):
+        """A crash can also tear a record whose write DID commit in
+        memory (fsync raced the power cut): recovery rewinds to the
+        previous record — a consistent earlier state, never a mangled
+        one."""
+        h = durable_harness(tmp_path)
+        store = h.store
+        store.create(PriorityClass(
+            metadata=ObjectMeta(name="kept", namespace=""), value=1.0
+        ))
+        seq_before = store.last_seq
+        store.create(PriorityClass(
+            metadata=ObjectMeta(name="torn", namespace=""), value=2.0
+        ))
+        log = h.cluster.durability
+        seg = log._segment_path(log.segment_bases()[-1])
+        size = os.path.getsize(seg)
+        log._segment.flush()
+        with open(seg, "r+b") as fh:
+            fh.truncate(size - 7)  # mid-way through the last record
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert recovered.recovery_stats["outcome"] == "torn_tail"
+        assert recovered.last_seq == seq_before
+        assert recovered.peek(PriorityClass.KIND, "", "kept") is not None
+        assert recovered.peek(PriorityClass.KIND, "", "torn") is None
+
+
+class TestSnapshotFallback:
+    def _two_snapshots(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        log = h.cluster.durability
+        log.snapshot(h.store, force=True)
+        h.apply(simple_pcs(cliques=[clique("x", replicas=2)],
+                           name="simple2"))
+        h.settle()
+        log.snapshot(h.store, force=True)
+        assert len(log.snapshot_seqs()) == 2
+        return h, log
+
+    def test_corrupt_newest_falls_back_to_previous(self, tmp_path):
+        h, log = self._two_snapshots(tmp_path)
+        newest = log.snapshot_seqs()[-1]
+        log.corrupt_latest_snapshot()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        stats = recovered.recovery_stats
+        assert stats["outcome"] == "snapshot_fallback"
+        assert stats["snapshots_skipped"] == 1
+        assert stats["snapshot_seq"] < newest
+        assert stats["wal_records_replayed"] > 0  # the longer suffix
+        assert_bit_identical(recovered, h.store)
+        # the corrupt image is QUARANTINED: it must never count as a
+        # retained generation again (a later prune trusting it would
+        # drop the WAL records its fallback needs)
+        names = os.listdir(tmp_path / "wal")
+        assert any(n.endswith(".corrupt") for n in names)
+        assert newest not in log.snapshot_seqs()
+
+    def test_sole_snapshot_corrupt_replays_full_wal(self, tmp_path):
+        """With an incomplete retention window nothing was pruned, so a
+        corrupted sole snapshot falls all the way back to the empty
+        store + full genesis-WAL replay — still exact."""
+        h = Harness(nodes=make_nodes(NODES), config=durable_config(
+            tmp_path / "wal", wal_max_bytes=1 << 22,
+        ))
+        h.apply(workload())
+        h.settle()
+        log = h.cluster.durability
+        log.snapshot(h.store, force=True)
+        assert len(log.snapshot_seqs()) == 1
+        log.corrupt_latest_snapshot()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        stats = recovered.recovery_stats
+        assert stats["outcome"] == "snapshot_fallback"
+        assert stats["snapshot_seq"] == 0  # empty state + full replay
+        assert_bit_identical(recovered, h.store)
+
+    def test_corruption_beyond_the_retention_window_fails_loud(
+        self, tmp_path
+    ):
+        """keep_snapshots=2 guarantees surviving ONE corrupted snapshot.
+        Corrupting every retained generation after truncation has pruned
+        the genesis WAL leaves a history gap — recovery must refuse to
+        splice disjoint histories into a silently inconsistent store."""
+        h, log = self._two_snapshots(tmp_path)
+        assert log.wal_floor() > 0  # full window: genesis was pruned
+        for seq in list(log.snapshot_seqs()):
+            path = log._snapshot_path(seq)
+            with open(path, "r+b") as fh:
+                fh.seek(os.path.getsize(path) // 2)
+                fh.write(b"\xde\xad\xbe\xef")
+        with pytest.raises(DurabilityError, match="gap"):
+            ObjectStore.recover(str(tmp_path / "wal"))
+
+
+class TestWalTruncationInvariant:
+    """WAL truncation vs compact_events — the pinned invariants:
+
+    1. segments are pruned only when covered by the OLDEST retained
+       snapshot (wal_floor() <= oldest retained seq, once the retention
+       window is full; nothing pruned before then), and
+    2. the in-memory event-compaction horizon never constrains recovery,
+       because compaction is itself a journaled record — an aggressive
+       compact_events far beyond the last snapshot must not cost
+       recovery fidelity.
+    """
+
+    def test_wal_floor_never_outruns_oldest_retained_snapshot(
+        self, tmp_path
+    ):
+        h = durable_harness(tmp_path)
+        log = h.cluster.durability
+        for i in range(5):
+            h.apply(simple_pcs(cliques=[clique("w", replicas=1)],
+                               name=f"pcs{i}"))
+            h.settle()
+            log.snapshot(h.store, force=True)
+            snaps = log.snapshot_seqs()
+            assert len(snaps) <= h.config.durability.keep_snapshots
+            assert log.wal_floor() <= snaps[0]
+            # every retained snapshot can anchor a recovery: the segment
+            # chain from it to the head is contiguous
+            bases = log.segment_bases()
+            assert bases == sorted(bases)
+            assert any(b <= snaps[0] for b in bases)
+
+    def test_incomplete_retention_window_prunes_nothing(self, tmp_path):
+        """With fewer than keep_snapshots generations on disk the deepest
+        fallback is the empty store + full WAL — pruning anything would
+        break it (the bug the quarantine + horizon rule closed)."""
+        h = Harness(nodes=make_nodes(NODES), config=durable_config(
+            tmp_path / "wal", wal_max_bytes=1 << 22,
+        ))
+        h.apply(workload())
+        h.settle()
+        log = h.cluster.durability
+        log.snapshot(h.store, force=True)
+        assert len(log.snapshot_seqs()) == 1
+        assert log.wal_floor() == 0  # the genesis segment survived
+
+    def test_compaction_beyond_snapshot_is_replayed_not_lost(
+        self, tmp_path
+    ):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        log = h.cluster.durability
+        log.snapshot(h.store, force=True)
+        # more history, then compact PAST the snapshot — the horizon
+        # outruns the last snapshot, which must cost nothing: the
+        # compaction is a WAL record, and the WAL retains everything
+        # since the snapshot regardless of the in-memory horizon
+        h.apply(simple_pcs(cliques=[clique("x", replicas=2)],
+                           name="simple2"))
+        h.settle()
+        dropped = h.store.compact_events(h.store.last_seq)
+        assert dropped > 0
+        assert h.store.compaction_horizon > log.last_snapshot_seq
+        assert log.wal_floor() <= log.snapshot_seqs()[0]
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert_bit_identical(recovered, h.store)
+        # and the recovered consumers relist exactly like live ones
+        assert recovered.event_log_length == h.store.event_log_length
+
+    def test_compaction_before_snapshot_roundtrips(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        h.compact_events()
+        h.cluster.durability.snapshot(h.store, force=True)
+        h.apply(simple_pcs(cliques=[clique("x", replicas=1)],
+                           name="simple2"))
+        h.settle()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert_bit_identical(recovered, h.store)
+
+
+class TestColdRestart:
+    def test_cold_restart_settles_to_identical_fixpoint(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        fixpoint = settled_fingerprint(h.store)
+        stats = h.cold_restart()
+        assert stats["outcome"] == "clean"
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+        assert check_invariants(h.store) == []
+        # the restarted plane still schedules NEW work (soft state —
+        # reservations, engines, usage accounting — actually rebuilt)
+        h.apply(simple_pcs(cliques=[clique("y", replicas=2)],
+                           name="after"))
+        h.settle()
+        pods = h.store.list(Pod.KIND)
+        assert all(p.node_name and p.status.ready for p in pods)
+
+    def test_cold_restart_expires_leader_lease(self, tmp_path):
+        from grove_tpu.controller.leaderelection import Lease
+
+        h = durable_harness(
+            tmp_path, leader_election={"enabled": True}
+        )
+        h.apply(workload())
+        h.settle()
+        le = h.config.leader_election
+        assert h.store.get(
+            Lease.KIND, le.lease_namespace, le.lease_name
+        ) is not None
+        h.cold_restart()
+        # the dead process's lease is gone; the rebuilt manager
+        # re-acquires on its next settle, and node heartbeat leases
+        # (infrastructure state) survived
+        assert h.store.get(
+            Lease.KIND, le.lease_namespace, le.lease_name
+        ) is None
+        from grove_tpu.cluster.nodehealth import NODE_LEASE_NAMESPACE
+
+        assert h.store.scan(Lease.KIND, namespace=NODE_LEASE_NAMESPACE)
+        h.settle()
+        assert h.store.get(
+            Lease.KIND, le.lease_namespace, le.lease_name
+        ) is not None
+
+    def test_cold_restart_rebuilds_shard_map(self, tmp_path):
+        from grove_tpu.controller.sharding import (
+            SHARD_MAP_NAME,
+            SHARD_NAMESPACE,
+            ShardMap,
+        )
+
+        h = durable_harness(
+            tmp_path, controllers={"shards": 2}
+        )
+        h.apply(workload())
+        h.settle()
+        fixpoint = settled_fingerprint(h.store)
+        old_map = h.store.get(ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+        assert old_map is not None
+        h.cold_restart()
+        h.settle()
+        new_map = h.store.get(ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME)
+        assert new_map is not None
+        assert new_map.metadata.uid != old_map.metadata.uid  # rebuilt
+        assert settled_fingerprint(h.store) == fixpoint
+
+    def test_kubelet_relists_against_the_recovered_store(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        # a node-level fault in flight across the crash: kubelet-side
+        # infrastructure truth must survive the control-plane restart
+        victim = h.store.scan("Node")[0].metadata.name
+        h.kubelet.fail_heartbeat(victim)
+        h.cold_restart()
+        assert victim in h.kubelet.heartbeat_failed
+        assert h.kubelet.event_cursor == h.store.last_seq
+        h.settle()
+        assert check_invariants(h.store) == []
+
+
+class TestNewProcessBoot:
+    """Harness.recover: booting a GENUINELY NEW process from the files
+    alone — the disaster-recovery path where the crashed predecessor's
+    Python objects are gone (cold_restart covers the in-process model)."""
+
+    def test_recover_boots_to_the_same_fixpoint_and_resumes_journaling(
+        self, tmp_path
+    ):
+        cfg = durable_config(tmp_path / "wal")
+        old = Harness(nodes=make_nodes(NODES), config=cfg)
+        old.apply(workload())
+        old.settle()
+        fixpoint = settled_fingerprint(old.store)
+        old.cluster.durability.close()  # the old process is gone
+        del old
+
+        h = Harness.recover(cfg)
+        assert h.store.recovery_stats["outcome"] == "clean"
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+        assert check_invariants(h.store) == []
+        # journaling RESUMED into the same dir: new work lands on disk
+        # and a further file-level recovery sees it
+        h.apply(simple_pcs(cliques=[clique("z", replicas=2)],
+                           name="after-boot"))
+        h.settle()
+        again = ObjectStore.recover(str(tmp_path / "wal"))
+        assert settled_fingerprint(again) == settled_fingerprint(h.store)
+        assert again.last_seq == h.store.last_seq
+
+    def test_recover_survives_torn_tail_on_disk(self, tmp_path):
+        cfg = durable_config(tmp_path / "wal")
+        old = Harness(nodes=make_nodes(NODES), config=cfg)
+        old.apply(workload())
+        old.settle()
+        fixpoint = settled_fingerprint(old.store)
+        old.cluster.durability.tear_tail()  # crash mid-append
+        old.cluster.durability.close()
+        del old
+        h = Harness.recover(cfg)
+        assert h.store.recovery_stats["outcome"] == "torn_tail"
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+
+    def test_recover_from_an_empty_directory_fails_loud(self, tmp_path):
+        """A mistyped-but-existing path (or a freshly mounted empty
+        volume) must never 'recover' to an empty cluster on the disaster
+        recovery path — the history would appear silently lost."""
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(DurabilityError, match="no durable state"):
+            ObjectStore.recover(str(tmp_path / "empty"))
+        with pytest.raises(DurabilityError, match="no durable state"):
+            Harness.recover(durable_config(tmp_path / "empty"))
+
+    def test_sharded_recover_rebuilds_the_map_before_serving(
+        self, tmp_path
+    ):
+        """Harness.recover expires the dead fleet's ShardMap BEFORE the
+        managers are built (a ShardedManager constructed against the
+        stale map would adopt its shard width instead of the config's)."""
+        from grove_tpu.controller.sharding import (
+            SHARD_MAP_NAME,
+            SHARD_NAMESPACE,
+            ShardMap,
+        )
+
+        cfg = durable_config(tmp_path / "wal")
+        cfg["controllers"] = {"shards": 2}
+        old = Harness(nodes=make_nodes(NODES), config=cfg)
+        old.apply(workload())
+        old.settle()
+        fixpoint = settled_fingerprint(old.store)
+        old_uid = old.store.get(
+            ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME
+        ).metadata.uid
+        old.cluster.durability.close()
+        del old
+        h = Harness.recover(cfg)
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+        new_map = h.store.get(
+            ShardMap.KIND, SHARD_NAMESPACE, SHARD_MAP_NAME
+        )
+        assert new_map is not None and new_map.metadata.uid != old_uid
+
+    def test_from_durable_guards(self, tmp_path):
+        from grove_tpu.api.config import load_operator_config
+        from grove_tpu.cluster.cluster import Cluster
+
+        with pytest.raises(ValueError, match="wal_dir"):
+            Cluster.from_durable(load_operator_config({}))
+        cfg = durable_config(tmp_path / "wal")
+        Harness(nodes=make_nodes(2), config=cfg)
+        with pytest.raises(ValueError, match="neither"):
+            Cluster(
+                nodes=make_nodes(2),
+                recovered_store=ObjectStore.recover(
+                    str(tmp_path / "wal")
+                ),
+            )
+
+
+class TestObservability:
+    def test_debug_dump_durability_block_and_metrics(self, tmp_path):
+        h = durable_harness(tmp_path)
+        h.apply(workload())
+        h.settle()
+        dump = h.debug_dump()["store"]["durability"]
+        assert dump["enabled"] is True
+        assert dump["wal_records_total"] > 0
+        assert dump["wal_bytes_total"] > 0
+        assert dump["last_recovery"] is None
+        m = h.cluster.metrics
+        assert m.counter("grove_store_wal_records_total").total() == (
+            dump["wal_records_total"]
+        )
+        assert m.counter("grove_store_wal_bytes_total").total() == (
+            dump["wal_bytes_total"]
+        )
+        h.cold_restart()
+        h.settle()
+        dump = h.debug_dump()["store"]["durability"]
+        assert dump["last_recovery"]["outcome"] == "clean"
+        assert dump["last_snapshot_seq"] > 0  # the recovery checkpoint
+        assert m.counter("grove_store_recoveries_total").value(
+            outcome="clean"
+        ) == 1.0
+
+    def test_disabled_dump_shape(self):
+        h = Harness(nodes=make_nodes(2))
+        assert h.debug_dump()["store"]["durability"] == {"enabled": False}
+
+
+@pytest.mark.chaos
+class TestChaosRecoveryEquivalence:
+    """The recovery equivalence gate (acceptance criterion): for >= 10
+    chaos seeds with process_crash armed — whole-process crashes
+    recovering from disk mid-plan, torn WAL tails, corrupted snapshots,
+    disk stalls on top of the full classic fault mix — the recovered
+    run's settle state is fingerprint-identical to the fault-free
+    fixpoint. Wide matrix: scripts/chaos_sweep.py --durability."""
+
+    SEEDS = tuple(range(10))
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        h = Harness(nodes=make_nodes(NODES))
+        h.apply(workload())
+        h.settle()
+        return settled_fingerprint(h.store)
+
+    def _run(self, seed, tmp_path):
+        plan = FaultPlan.from_seed(
+            seed,
+            process_crash_rate=0.15,
+            wal_torn_write_rate=0.4,
+            snapshot_corruption_rate=0.3,
+            disk_stall_rate=0.1,
+        )
+        ch = ChaosHarness(
+            plan, nodes=make_nodes(NODES),
+            config=durable_config(tmp_path / f"wal{seed}"),
+        )
+        quiet = io.StringIO()
+        ch.harness.cluster.logger.stream = quiet
+        ch.harness.manager.logger.stream = quiet
+        ch.apply(workload())
+        ch.run_chaos()
+        return ch
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_recovered_settle_matches_fault_free_fixpoint(
+        self, seed, tmp_path, baseline
+    ):
+        ch = self._run(seed, tmp_path)
+        assert settled_fingerprint(ch.raw_store) == baseline, (
+            f"seed {seed} diverged (faults: {ch.plan.counts}, "
+            f"recoveries: {ch.recovery_stats})"
+        )
+        assert check_invariants(ch.raw_store) == []
+        if ch.process_restarts:
+            assert len(ch.recovery_stats) == ch.process_restarts
+            assert all(
+                s["outcome"] in (
+                    "clean", "torn_tail", "snapshot_fallback"
+                )
+                for s in ch.recovery_stats
+            )
+
+    def test_matrix_actually_exercised_every_recovery_path(
+        self, tmp_path, baseline
+    ):
+        """A vacuous gate must not read as coverage: across the seed
+        matrix, crashes happened and every outcome class appeared."""
+        outcomes: set[str] = set()
+        crashes = 0
+        for seed in self.SEEDS:
+            ch = self._run(seed, tmp_path)
+            crashes += ch.process_restarts
+            outcomes.update(s["outcome"] for s in ch.recovery_stats)
+        assert crashes >= len(self.SEEDS), "process_crash barely fired"
+        assert outcomes >= {"clean", "torn_tail", "snapshot_fallback"}
+
+    def test_durability_seed_is_bit_reproducible(self, tmp_path):
+        a = self._run(5, tmp_path / "a")
+        b = self._run(5, tmp_path / "b")
+        assert a.plan.counts == b.plan.counts
+        assert a.process_restarts == b.process_restarts
+        assert [s["outcome"] for s in a.recovery_stats] == [
+            s["outcome"] for s in b.recovery_stats
+        ]
+        assert settled_fingerprint(a.raw_store) == settled_fingerprint(
+            b.raw_store
+        )
+
+    def test_wedged_summary_names_the_replay_position(self, tmp_path):
+        """The flight-recorder postmortem carries the recovery audit
+        trail: which snapshot each crash recovered from and where WAL
+        replay stopped."""
+        ch = self._run(0, tmp_path)
+        wedged = ch.wedged_summary()
+        assert wedged["process_restarts"] == ch.process_restarts
+        assert len(wedged["recoveries"]) == ch.process_restarts
+        for rec in wedged["recoveries"]:
+            assert "snapshot_seq" in rec
+            assert "recovered_last_seq" in rec
+            assert "wal_records_replayed" in rec

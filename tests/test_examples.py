@@ -46,6 +46,9 @@ def test_operations_tour_runs(capsys):
     assert "node lifecycle: draining" in out
     assert "repaired onto healthy racks" in out
     assert "rack recovered" in out
+    assert "cold restart: steady state journaled" in out
+    assert "bit-identical store" in out
+    assert "re-settled to the identical fixpoint" in out
     try:
         import grpc  # noqa: F401
         from cryptography import x509  # noqa: F401
