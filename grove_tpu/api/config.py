@@ -145,6 +145,13 @@ class SolverConfig:
     # fused_solve AND device_state_cache — the engine degrades to the
     # full fused path when either is off.
     incremental_resolve: bool = True
+    # Gang-level reservation reuse (scheduler pre-pass, podgang.go:66-72):
+    # a gang naming a predecessor in reuse_reservation_ref is trial-placed
+    # onto that predecessor's remembered nodes before general search —
+    # near-free, topology-stable scale-up/rebuild re-placement. Off = the
+    # pre-pass is skipped wholesale (every gang takes the general solve),
+    # kept for the diurnal bench's reuse-on/off A/B.
+    reservation_reuse: bool = True
 
 
 #: built-in priority-tier ladder seeded as PriorityClass objects when
@@ -210,9 +217,53 @@ class TenancyConfig:
 
 @dataclass
 class AutoscalerConfig:
-    """k8s HPA controller knobs."""
+    """k8s HPA controller knobs (controller/autoscaler.py).
+
+      tolerance                         no scale while |ratio - 1| <=
+                                        tolerance (k8s HPA default 0.1)
+      sync_interval_seconds             periodic HPA sweep cadence
+                                        (Harness.maybe_autoscale; the
+                                        kube-controller-manager
+                                        --horizontal-pod-autoscaler-
+                                        sync-period analog)
+      scale_down_stabilization_seconds  desired-on-scale-down is the MAX
+                                        recommendation over this window
+                                        (k8s stabilizationWindowSeconds)
+                                        so a noisy signal never flaps the
+                                        replica count; 0 = immediate
+      metrics_max_age_seconds           utilization samples older than
+                                        this read as MISSING (and missing
+                                        metrics never drive scale-down)
+    """
 
     tolerance: float = 0.1  # no scale while |ratio - 1| <= tolerance
+    sync_interval_seconds: float = 15.0
+    scale_down_stabilization_seconds: float = 300.0
+    metrics_max_age_seconds: float = 120.0
+
+
+@dataclass
+class ServingConfig:
+    """Elastic-serving traffic model (grove_tpu/serving/): a deterministic
+    virtual-time TrafficTrace mapped through per-clique workload shapes
+    onto the per-pod utilization samples SimKubelet reports each tick —
+    the metrics pipeline that feeds the autoscaler. Off by default; when
+    enabled the kubelet reports and the diurnal bench / chaos traffic
+    faults have a demand stream to drive.
+
+      trace      TrafficTrace fields: base_rps, peak_rps, period_seconds,
+                 peak_at_fraction, noise, seed, sample_seconds, spikes
+                 (list of {at_seconds, duration_seconds, multiplier})
+      workloads  serving tiers, each {clique: <clique template name>,
+                 shape: prefill|decode|router, rps_per_replica?,
+                 demand_fraction?} — the reference's disaggregated
+                 serving roles (README.md:38-44); fractions/capacities
+                 default per shape (serving/traffic.py DEFAULT_SHAPES)
+    """
+
+    enabled: bool = False
+    trace: dict = field(default_factory=dict)
+    workloads: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -318,6 +369,7 @@ class OperatorConfig:
     solver: SolverConfig = field(default_factory=SolverConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
     topology_aware_scheduling: TopologyAwareSchedulingConfig = field(
         default_factory=TopologyAwareSchedulingConfig
@@ -362,6 +414,7 @@ _TYPES = {
     "SolverConfig": SolverConfig,
     "TenancyConfig": TenancyConfig,
     "AutoscalerConfig": AutoscalerConfig,
+    "ServingConfig": ServingConfig,
     "AuthorizationConfig": AuthorizationConfig,
     "TopologyAwareSchedulingConfig": TopologyAwareSchedulingConfig,
     "LogConfig": LogConfig,
@@ -504,6 +557,8 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append("config.solver.fused_solve: must be a bool")
     if not isinstance(sv.incremental_resolve, bool):
         errs.append("config.solver.incremental_resolve: must be a bool")
+    if not isinstance(sv.reservation_reuse, bool):
+        errs.append("config.solver.reservation_reuse: must be a bool")
 
     errs += _validate_tenancy(cfg.tenancy)
 
@@ -524,8 +579,34 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             "runs its own coordinator election; see docs/operations.md)"
         )
 
-    if not _num(cfg.autoscaler.tolerance) or not (0 <= cfg.autoscaler.tolerance < 1):
+    au = cfg.autoscaler
+    if not _num(au.tolerance) or not (0 <= au.tolerance < 1):
         errs.append("config.autoscaler.tolerance: must be in [0, 1)")
+    if not _num(au.sync_interval_seconds) or au.sync_interval_seconds <= 0:
+        errs.append("config.autoscaler.sync_interval_seconds: must be > 0")
+    if not _num(au.scale_down_stabilization_seconds) or (
+        au.scale_down_stabilization_seconds < 0
+    ):
+        errs.append(
+            "config.autoscaler.scale_down_stabilization_seconds: must be "
+            ">= 0 (0 = scale down immediately)"
+        )
+    if not _num(au.metrics_max_age_seconds) or au.metrics_max_age_seconds <= 0:
+        errs.append("config.autoscaler.metrics_max_age_seconds: must be > 0")
+    elif (
+        _num(au.sync_interval_seconds)
+        and au.sync_interval_seconds > 0
+        and au.metrics_max_age_seconds < au.sync_interval_seconds
+    ):
+        # every sample would be stale by the next sync: the HPA could
+        # never see a metric and the autoscaler would be silently inert
+        errs.append(
+            "config.autoscaler.metrics_max_age_seconds: must be >= "
+            "sync_interval_seconds (samples must survive to the next "
+            "HPA sync or no metric is ever observed)"
+        )
+
+    errs += _validate_serving(cfg.serving)
 
     az = cfg.authorization
     if not isinstance(az.enabled, bool):
@@ -604,6 +685,127 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             "config.durability.keep_snapshots: must be an int >= 2 — "
             "recovery from a corrupted newest snapshot needs at least "
             "one older generation to fall back to"
+        )
+    return errs
+
+
+#: allowed serving.trace keys, mirroring serving/traffic.py TrafficTrace
+_TRACE_KEYS = {
+    "base_rps", "peak_rps", "period_seconds", "peak_at_fraction",
+    "noise", "seed", "sample_seconds", "spikes",
+}
+_SPIKE_KEYS = {"at_seconds", "duration_seconds", "multiplier"}
+_WORKLOAD_KEYS = {"clique", "shape", "rps_per_replica", "demand_fraction"}
+#: the shape vocabulary (serving/traffic.py DEFAULT_SHAPES keys, inlined
+#: so the config layer stays import-light)
+_SHAPES = ("prefill", "decode", "router")
+
+
+def _validate_serving(sv: ServingConfig) -> list[str]:
+    """Aggregated semantic validation of the serving block (structural
+    problems short-circuit per entry, like the tenancy validator)."""
+    errs: list[str] = []
+    if not isinstance(sv.enabled, bool):
+        errs.append("config.serving.enabled: must be a bool")
+    tr = sv.trace
+    if not isinstance(tr, dict):
+        errs.append("config.serving.trace: must be a mapping")
+        tr = {}
+    unknown = set(tr) - _TRACE_KEYS
+    if unknown:
+        errs.append(
+            f"config.serving.trace: unknown field(s) {sorted(unknown)}"
+        )
+    for key, lo_ok in (
+        ("base_rps", lambda v: v > 0),
+        ("peak_rps", lambda v: v > 0),
+        ("period_seconds", lambda v: v > 0),
+        ("sample_seconds", lambda v: v > 0),
+        ("noise", lambda v: v >= 0),
+        ("peak_at_fraction", lambda v: 0 <= v <= 1),
+    ):
+        if key in tr and (not _num(tr[key]) or not lo_ok(tr[key])):
+            errs.append(f"config.serving.trace.{key}: invalid value "
+                        f"{tr[key]!r}")
+    if "seed" in tr and not _int(tr["seed"]):
+        errs.append("config.serving.trace.seed: must be an int")
+    # compare the EFFECTIVE values: an omitted key falls back to the
+    # TrafficTrace dataclass default, and the invariant must hold for
+    # the curve the engine will actually run (function-level import so
+    # the config layer stays import-light at module load)
+    from ..serving.traffic import TrafficTrace as _TT
+
+    base_eff = tr.get("base_rps", _TT.base_rps)
+    peak_eff = tr.get("peak_rps", _TT.peak_rps)
+    if _num(base_eff) and _num(peak_eff) and peak_eff < base_eff:
+        errs.append(
+            f"config.serving.trace.peak_rps: must be >= base_rps (the "
+            f"diurnal curve sweeps base..peak; effective "
+            f"{peak_eff} < {base_eff})"
+        )
+    spikes = tr.get("spikes", [])
+    if not isinstance(spikes, list):
+        errs.append("config.serving.trace.spikes: must be a list")
+        spikes = []
+    for i, sp in enumerate(spikes):
+        path = f"config.serving.trace.spikes[{i}]"
+        if not isinstance(sp, dict) or set(sp) - _SPIKE_KEYS:
+            errs.append(
+                f"{path}: must be an {{at_seconds, duration_seconds, "
+                "multiplier}} mapping"
+            )
+            continue
+        if not _num(sp.get("at_seconds", 0)) or sp.get("at_seconds", 0) < 0:
+            errs.append(f"{path}.at_seconds: must be a number >= 0")
+        if not _num(sp.get("duration_seconds", 1)) or (
+            sp.get("duration_seconds", 1) <= 0
+        ):
+            errs.append(f"{path}.duration_seconds: must be a number > 0")
+        if not _num(sp.get("multiplier", 1)) or sp.get("multiplier", 1) <= 0:
+            errs.append(f"{path}.multiplier: must be a number > 0")
+
+    if not isinstance(sv.workloads, list):
+        errs.append("config.serving.workloads: must be a list")
+        return errs
+    seen_cliques: set[str] = set()
+    for i, w in enumerate(sv.workloads):
+        path = f"config.serving.workloads[{i}]"
+        if not isinstance(w, dict):
+            errs.append(f"{path}: must be a mapping")
+            continue
+        unknown = set(w) - _WORKLOAD_KEYS
+        if unknown:
+            errs.append(f"{path}: unknown field(s) {sorted(unknown)}")
+        clique = w.get("clique")
+        if not isinstance(clique, str) or not clique:
+            errs.append(f"{path}.clique: must be a non-empty clique "
+                        "template name")
+        elif clique in seen_cliques:
+            errs.append(f"{path}.clique: duplicate workload for clique "
+                        f"{clique!r}")
+        else:
+            seen_cliques.add(clique)
+        shape = w.get("shape", "decode")
+        if shape not in _SHAPES:
+            errs.append(
+                f"{path}.shape: unknown shape {shape!r} "
+                f"(supported: {list(_SHAPES)})"
+            )
+        for key in ("rps_per_replica", "demand_fraction"):
+            if key in w and (not _num(w[key]) or w[key] <= 0):
+                errs.append(f"{path}.{key}: must be a number > 0")
+        if "demand_fraction" in w and _num(w["demand_fraction"]) and (
+            w["demand_fraction"] > 1
+        ):
+            errs.append(f"{path}.demand_fraction: must be <= 1")
+    if sv.enabled is True and not sv.workloads:
+        # an enabled-but-workload-less serving block would tick the
+        # reporting hook forever and report nothing — reject rather than
+        # hand out a silently inert metrics pipeline
+        errs.append(
+            "config.serving.workloads: must not be empty when serving is "
+            "enabled (the kubelet would report no samples and every HPA "
+            "would hold on missing metrics)"
         )
     return errs
 

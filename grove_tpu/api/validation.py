@@ -196,6 +196,19 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
                 errs.append(f"{path}.spec.scaleConfig.maxReplicas must be >= replicas")
             if sc.min_replicas < 1:
                 errs.append(f"{path}.spec.scaleConfig.minReplicas must be >= 1")
+            # the HPA built from this config must itself pass admission
+            # (validate_hpa); catching the bad bounds here names the
+            # template field instead of wedging the component sync
+            if sc.min_replicas > sc.max_replicas:
+                errs.append(
+                    f"{path}.spec.scaleConfig: minReplicas must be <= "
+                    "maxReplicas"
+                )
+            if not (0 < sc.target_utilization <= 1):
+                errs.append(
+                    f"{path}.spec.scaleConfig.targetUtilization must be in "
+                    "(0, 1]"
+                )
         # empty means the framework's own scheduler — mixing it with a
         # foreign name would deadlock the gang (half its pods routed
         # elsewhere), so it counts toward the single-name rule
@@ -274,6 +287,14 @@ def validate_podcliqueset(pcs: PodCliqueSet) -> None:
         if sg.scale_config is not None:
             if sg.scale_config.min_replicas < 1:
                 errs.append(f"{path}.scaleConfig.minReplicas must be >= 1")
+            if sg.scale_config.min_replicas > sg.scale_config.max_replicas:
+                errs.append(
+                    f"{path}.scaleConfig: minReplicas must be <= maxReplicas"
+                )
+            if not (0 < sg.scale_config.target_utilization <= 1):
+                errs.append(
+                    f"{path}.scaleConfig.targetUtilization must be in (0, 1]"
+                )
             if sg.replicas is not None and not (
                 sg.scale_config.min_replicas <= sg.replicas <= sg.scale_config.max_replicas
             ):
@@ -355,6 +376,43 @@ def validate_podgang(pg, allowed_priorities=None) -> None:
             f"priority tier or PriorityClass "
             f"(allowed: {sorted(allowed_priorities)})"
         ])
+
+
+#: HPA scale-target vocabulary: the two kinds carrying a scale
+#: subresource (the reference puts scale markers on PCLQ and PCSG;
+#: PCS scaling is replica-count on the spec, not an HPA target here)
+HPA_TARGET_KINDS = ("PodClique", "PodCliqueScalingGroup")
+
+
+def validate_hpa(hpa) -> None:
+    """HorizontalPodAutoscaler admission (registered unconditionally by
+    Cluster). Before this, a min>max HPA was accepted and the controller
+    clamped nonsensically (desired pinned wherever the clamp order
+    happened to land); now the bad object is rejected at create/update
+    with the full error list, like every other admitted kind."""
+    errs: list[str] = []
+    spec = hpa.spec
+    if spec.target_kind not in HPA_TARGET_KINDS:
+        errs.append(
+            f"spec.target_kind: {spec.target_kind!r} is not a scalable "
+            f"kind (allowed: {list(HPA_TARGET_KINDS)})"
+        )
+    if not spec.target_name:
+        errs.append("spec.target_name: must name the scale target")
+    if spec.min_replicas < 1:
+        errs.append("spec.min_replicas: must be >= 1")
+    if spec.min_replicas > spec.max_replicas:
+        errs.append(
+            f"spec.min_replicas: must be <= max_replicas "
+            f"({spec.min_replicas} > {spec.max_replicas})"
+        )
+    if not (0 < spec.target_utilization <= 1):
+        errs.append(
+            f"spec.target_utilization: must be in (0, 1], got "
+            f"{spec.target_utilization!r}"
+        )
+    if errs:
+        raise ValidationError(errs)
 
 
 def validate_cluster_topology(ct) -> None:

@@ -421,6 +421,82 @@ class ChaosHarness:
                 if sharded.chaos_revoke_worker(idx):
                     self._record("handoff_storm")
 
+    # -- elastic-serving faults ----------------------------------------------
+    @property
+    def _serving(self):
+        """The cluster's TrafficEngine when config.serving.enabled, else
+        None (serving faults and the chaotic HPA sync loop are skipped
+        entirely — rate-guarded AND capability-guarded, so pre-existing
+        seeds replay identically either way)."""
+        return getattr(self.harness.cluster, "serving", None)
+
+    def _inject_serving_faults(self) -> None:
+        """Per-step elastic-serving fault draws (see FaultPlan): transient
+        traffic spikes onto the trace, metrics-pipeline dropouts. Every
+        draw is guarded on rate > 0 AND on serving being configured."""
+        plan = self.plan
+        serving = self._serving
+        if serving is None:
+            return
+        if plan.traffic_spike_rate > 0 and plan.flip(
+            plan.traffic_spike_rate
+        ):
+            self._record("traffic_spike")
+            duration = plan.step_seconds * (2 + plan.pick(6))
+            # the configured multiplier is a CEILING the draw must
+            # honor (a seed tuned to stay under a tier's max_replicas
+            # must not be blown past it by a hidden floor); the draw
+            # floor is 1.5 only when the ceiling allows it
+            hi = max(plan.traffic_spike_multiplier, 1.0)
+            multiplier = plan.uniform(min(1.5, hi), hi)
+            serving.inject_spike(
+                self.clock.now(), duration, multiplier
+            )
+        if plan.metrics_dropout_rate > 0 and plan.flip(
+            plan.metrics_dropout_rate
+        ):
+            self._record("metrics_dropout")
+            pm = self.harness.cluster.pod_metrics
+            pm.dropout_steps += 2 + plan.pick(4)
+
+    def _chaos_autoscale(self) -> None:
+        """The HPA sync loop keeps its config cadence THROUGH the storm
+        (serving runs only): maybe_autoscale without settling —
+        convergence is the interleaved manager rounds' job — and treat a
+        mid-sweep ManagerCrash like any other (the chaos store raises it
+        from committed writes)."""
+        try:
+            self.harness.maybe_autoscale(settle=False)
+        except ManagerCrash:
+            self.restart_manager()
+
+    def _drain_serving(self) -> None:
+        """Post-disarm serving drain: let every stabilization-window
+        entry from the spike era expire, then sweep on the sync cadence
+        until the HPAs stop moving — the recovered fixpoint must carry
+        the same replica counts a fault-free run holds (the injected
+        spikes are gone; the trace demand is whatever it is at the
+        current virtual time, which the convergence suites pin by using
+        a FLAT trace)."""
+        h = self.harness
+        if self._serving is None:
+            return
+        cfg = h.config.autoscaler
+        h.advance(
+            cfg.scale_down_stabilization_seconds
+            + cfg.sync_interval_seconds + 1.0
+        )
+        ctr = h.cluster.metrics.counter(
+            "grove_autoscaler_scale_events_total",
+            "applied HPA scale events by direction",
+        )
+        for _ in range(8):
+            before = ctr.total()
+            h.autoscale()
+            if ctr.total() == before:
+                return
+            h.advance(cfg.sync_interval_seconds + 1.0)
+
     # -- durable-store faults -----------------------------------------------
     @property
     def _durable(self):
@@ -568,6 +644,7 @@ class ChaosHarness:
                     self._inject_tenant_skew()
                 self._inject_shard_faults()
                 self._inject_durability_faults()
+                self._inject_serving_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")
@@ -577,9 +654,16 @@ class ChaosHarness:
                     self.restart_manager()
                 if not stalled:
                     h.kubelet.tick()
+                if self._serving is not None:
+                    # the HPA sync loop runs through the storm on its
+                    # config cadence (no-op without serving, so
+                    # pre-existing seeds' sequences are untouched)
+                    self._chaos_autoscale()
                 self._tick_node_faults()
                 if self._durable is not None:
                     self._durable.tick_stall()
+                if self._serving is not None:
+                    self.harness.cluster.pod_metrics.tick_dropout()
                 # give backoff requeues a chance to fire mid-chaos
                 h.clock.advance(plan.step_seconds)
         finally:
@@ -590,6 +674,11 @@ class ChaosHarness:
                 # disarm-time repair, like every other fault class: the
                 # disk recovers, deferred snapshot work may resume
                 self._durable.stalled_steps = 0
+            if self._serving is not None:
+                # injected spikes leave with the faults; the metrics
+                # pipeline resumes reporting immediately
+                self._serving.clear_injected()
+                self.harness.cluster.pod_metrics.dropout_steps = 0
         self.settle_recovered()
 
     def settle_recovered(self, max_iters: int = 64) -> None:
@@ -613,6 +702,7 @@ class ChaosHarness:
         h = self.harness
         horizon = h.config.controllers.error_backoff_max_seconds * 2 + 1
         h.settle()
+        self._drain_serving()
         for _ in range(max_iters):
             nxt = h.manager.next_requeue_at()
             if nxt is None or nxt - h.clock.now() > horizon:

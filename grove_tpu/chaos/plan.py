@@ -135,6 +135,27 @@ class FaultPlan:
     snapshot_corruption_rate: float = 0.0
     disk_stall_rate: float = 0.0
 
+    # elastic-serving faults (per chaos step; meaningful only when the
+    # harness runs with config.serving.enabled — skipped entirely
+    # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the
+    # tenant_skew/shard/durability contract), so every pre-existing
+    # seed's draw sequence — and its verified convergence — is
+    # bit-identical.
+    #   traffic_spike   — a transient demand spike (seeded duration and
+    #                     multiplier up to traffic_spike_multiplier)
+    #                     lands on the traffic trace; the HPA sync loop
+    #                     must absorb it (scale up, then stabilize back
+    #                     down) — injected spikes are removed at disarm
+    #                     so the recovered fixpoint matches fault-free
+    #   metrics_dropout — the metrics pipeline drops every report for a
+    #                     few steps (metrics-server outage): samples go
+    #                     stale and the HPA must HOLD, never scale down
+    #                     on missing metrics — cleared at disarm
+    traffic_spike_rate: float = 0.0
+    #: upper bound of the seeded spike multiplier draw (>= 1)
+    traffic_spike_multiplier: float = 4.0
+    metrics_dropout_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

@@ -14,11 +14,12 @@ from ..api import (
     default_podcliqueset,
     default_podgang,
     validate_cluster_topology,
+    validate_hpa,
     validate_podcliqueset,
     validate_podcliqueset_update,
     validate_podgang,
 )
-from ..api.auxiliary import PriorityClass
+from ..api.auxiliary import HorizontalPodAutoscaler, PriorityClass
 from ..api.config import OperatorConfig
 from ..api.meta import ObjectMeta
 from ..api.podgang import PodGang
@@ -94,6 +95,28 @@ class Cluster:
                     "store recoveries from durable state by outcome",
                 ).inc(outcome=self.store.recovery_stats["outcome"])
         self.kubelet = SimKubelet(self.store)
+        # The serving metrics pipeline (grove_tpu/serving): the aggregator
+        # is cluster-owned like the DecisionLog — samples are
+        # infrastructure truth reported by the node agents, so they
+        # survive manager crash-restarts and the rebuilt autoscaler
+        # resumes from the same window. Built unconditionally (cheap,
+        # and Autoscaler.observe() feeds it even without a traffic
+        # engine); the TrafficEngine itself only exists when
+        # config.serving.enabled, and wires the kubelet's per-tick
+        # reporting hook.
+        from ..serving import PodMetrics
+
+        self.pod_metrics = PodMetrics(
+            self.config.autoscaler.metrics_max_age_seconds
+        )
+        self.serving = None
+        if self.config.serving.enabled:
+            from ..serving import TrafficEngine
+
+            self.serving = TrafficEngine(
+                self.config.serving, self.pod_metrics, metrics=self.metrics
+            )
+            self.kubelet.reporter = self.serving
         # Placement-decision audit ring (observability/explain.py):
         # cluster-owned — like the metrics registry — so explanations
         # survive scheduler engine rebuilds and manager crash-restarts.
@@ -129,6 +152,11 @@ class Cluster:
         )
         self.store.register_admission(
             "ClusterTopology", Admission(validate=validate_cluster_topology)
+        )
+        # HPA admission is unconditional (no tenancy gate): a min>max HPA
+        # used to be accepted and clamp nonsensically in the controller
+        self.store.register_admission(
+            HorizontalPodAutoscaler.KIND, Admission(validate=validate_hpa)
         )
         if self.tenancy.enabled:
             # PodGang admission under tenancy: an empty priority class

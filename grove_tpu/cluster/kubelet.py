@@ -94,6 +94,11 @@ class SimKubelet:
         #: nodes whose heartbeat lease renewal is suppressed (injected
         #: node failure — partition, kubelet death, domain outage)
         self._hb_failed: set[str] = set()
+        #: serving metrics reporter (grove_tpu/serving TrafficEngine),
+        #: wired by Cluster when config.serving.enabled: every tick ends
+        #: with one utilization sample per READY pod — the kubelet end of
+        #: the metrics pipeline (kubelet -> aggregation -> HPA sync)
+        self.reporter = None
 
     @property
     def event_cursor(self) -> int:
@@ -351,6 +356,14 @@ class SimKubelet:
                 changes += 1
                 if trace:
                     self._trace_pod("kubelet.pod_ready", ns, name, pod_meta)
+        if self.reporter is not None:
+            # serving metrics reporting rides the tick like the heartbeat
+            # renewals: the reported capacity is the readiness snapshot as
+            # of tick start (this tick's readiness writes drain next tick
+            # — the one-hop propagation delay a real metrics-server
+            # pipeline has), and reporting is NOT counted in `changes` —
+            # a tick that only reports metrics is quiescent for settle.
+            self.reporter.report(self.store, now, self._ready)
         return changes
 
     def _trace_pod(self, span_name: str, ns: str, pod_name: str,

@@ -254,20 +254,48 @@ class Harness:
     def _expire_coordination(self) -> None:
         _expire_coordination_objects(self.store, self.config)
 
-    def autoscale(self) -> None:
-        """One periodic HPA sweep + settle (the HPA sync interval). The
-        sweep mutates managed scale targets, so it runs as the operator
-        identity like any reconcile — and, under HA, only on the replica
-        holding the lease (a standby sweeping would be split-brain)."""
+    def autoscale_sweep(self) -> bool:
+        """The HPA sweep ALONE, no settle — the chaos driver interleaves
+        it with faulted manager rounds (a settle mid-storm could blow the
+        round budget on transient faults). The sweep mutates managed
+        scale targets, so it runs as the operator identity like any
+        reconcile — and, under HA, only on the replica holding the lease
+        (a standby sweeping would be split-brain). Returns whether the
+        sweep ran."""
         if self.elector is not None:
             with self.store.impersonate(
                 self.manager.identity or self.store.actor
             ):
                 if not self.elector.try_acquire():
-                    return  # standing by: the leader sweeps
+                    return False  # standing by: the leader sweeps
         with self.store.impersonate(self.manager.identity or self.store.actor):
             self.autoscaler.run_all()
+        return True
+
+    def autoscale(self) -> None:
+        """One periodic HPA sweep + settle (the HPA sync interval)."""
+        self.autoscale_sweep()
         self.settle()
+
+    def maybe_autoscale(self, settle: bool = True) -> bool:
+        """The periodic HPA sync: sweep (+ settle) when at least
+        `autoscaler.sync_interval_seconds` of virtual time passed since
+        the last sweep. Serving drivers (bench.py --diurnal, the chaos
+        loop) call this every step so the HPA cadence is governed by the
+        validated config, not by the driver's step size. Returns whether
+        a sweep ran — an HA standby's skipped sweep returns False and
+        pays no settle. settle=False leaves convergence to the caller's
+        own manager rounds (the chaos storm's posture)."""
+        if (
+            self.clock.now() - self.autoscaler.last_sync
+            < self.config.autoscaler.sync_interval_seconds
+        ):
+            return False
+        if not self.autoscale_sweep():
+            return False  # standing by: the leader sweeps
+        if settle:
+            self.settle()
+        return True
 
     def apply(self, pcs: PodCliqueSet):
         return self.store.create(pcs)

@@ -22,6 +22,7 @@ from ..api.types import (
     PodCliqueSet,
 )
 from ..cluster.store import Event, ObjectStore, clone
+from ..observability.events import EventRecorder
 from .common import base_labels, new_meta
 from .podcliqueset import _shallow_spec
 from .errors import (
@@ -43,6 +44,7 @@ class PCSGReconciler:
 
     def __init__(self, store: ObjectStore):
         self.store = store
+        self.recorder = EventRecorder(store, controller=self.name)
         #: PCSGs with a rollout in flight: only then do POD events feed
         #: this reconciler (clique_updated reads pod hashes/readiness);
         #: outside rollouts pod churn is the PodClique controller's job.
@@ -387,6 +389,16 @@ class PCSGReconciler:
                     for p in group
                 ):
                     available += 1
+        if before.replicas and fresh.spec.replicas != before.replicas:
+            # the scale subresource moved (HPA write, manual resize):
+            # surface it as an Event so the elastic-serving runbook's
+            # `kubectl get events` analog shows the scale loop acting
+            # (docs/operations.md "Elastic serving")
+            self.recorder.normal(
+                fresh,
+                "ScalingGroupResized",
+                f"replicas {before.replicas} -> {fresh.spec.replicas}",
+            )
         status.replicas = fresh.spec.replicas
         status.scheduled_replicas = scheduled
         status.available_replicas = available

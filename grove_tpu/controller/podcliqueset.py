@@ -490,13 +490,22 @@ class PodCliqueSetReconciler:
                     target_utilization=sg.scale_config.target_utilization,
                 )
         for hpa_name, spec in expected.items():
-            if self.store.peek(HorizontalPodAutoscaler.KIND, ns, hpa_name) is None:
+            existing = self.store.peek(HorizontalPodAutoscaler.KIND, ns, hpa_name)
+            if existing is None:
                 self.store.create(
                     HorizontalPodAutoscaler(
                         metadata=new_meta(hpa_name, ns, pcs, labels), spec=spec
                     ),
                     owned=True,
                 )
+            elif existing.spec != spec:
+                # template drift: a changed scaleConfig (new bounds /
+                # target) must reach the live HPA — create-if-missing
+                # alone left the old bounds pinned forever after a
+                # rolling update retargeted the template
+                fresh = self.store.get(HorizontalPodAutoscaler.KIND, ns, hpa_name)
+                fresh.spec = spec
+                self.store.update(fresh)
         for hpa in self.store.scan(
             HorizontalPodAutoscaler.KIND, namespace=ns, labels=labels
         ):

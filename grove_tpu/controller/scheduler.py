@@ -165,6 +165,9 @@ class GangScheduler:
         #: when it still fits (pod-level reservation reuse)
         self._vacated: dict[tuple[str, str], str] = {}
         self.preemption_enabled = cfg.solver.preemption_enabled
+        #: gang-level reservation-reuse pre-pass enable (the diurnal
+        #: bench's A/B knob); pod-level vacated hints stay on either way
+        self.reservation_reuse = cfg.solver.reservation_reuse
         #: engine reused across reconciles while the snapshot's static
         #: encoding is unchanged (identity check against the cluster cache)
         self._engine = None
@@ -690,8 +693,10 @@ class GangScheduler:
             self.tenancy.count_decisions(encoded)
         solver_by_name = {g.name: g for g in encoded}
         by_name = {g.metadata.name: g for g in backlog}
-        solver_gangs = self._try_reserved(
-            encoded, by_name, snapshot, free, engine
+        solver_gangs = (
+            self._try_reserved(encoded, by_name, snapshot, free, engine)
+            if self.reservation_reuse
+            else encoded
         )
         kw = (
             {"fairness": fairness}
@@ -1020,12 +1025,14 @@ class GangScheduler:
             if level >= 0 and len(idx):
                 ids = snapshot.domain_ids[level, idx]
                 if not (ids == ids[0]).all():
+                    self._count_reuse("miss-scattered")
                     remaining.append(sg)
                     continue
             higher = [
                 g for g in remaining if g.priority > sg.priority
             ]
             if higher and len(higher) > TRIAL_CAP:
+                self._count_reuse("miss-unverifiable")
                 remaining.append(sg)  # unverifiable cheaply: general
                 continue
             assign = (
@@ -1035,6 +1042,7 @@ class GangScheduler:
             )
             if assign is None:
                 # reservation gone/too small: general solve handles it
+                self._count_reuse("miss-unplaceable")
                 remaining.append(sg)
                 continue
             # declare the committed rows to the device-state cache NOW,
@@ -1055,8 +1063,10 @@ class GangScheduler:
                     for g in higher
                 ):
                     np.add.at(free, assign, sg.demand)
+                    self._count_reuse("miss-inversion")
                     remaining.append(sg)
                     continue
+            self._count_reuse("hit")
             self._bind(
                 pg,
                 GangPlacement(
@@ -1070,6 +1080,15 @@ class GangScheduler:
                 ),
             )
         return remaining
+
+    def _count_reuse(self, outcome: str) -> None:
+        """Reservation-reuse attempt accounting (the diurnal bench's hit
+        rate reads this): counted only for gangs that HAD a usable-looking
+        reservation — gangs without one are not attempts."""
+        self.metrics.counter(
+            "grove_scheduler_reservation_reuse_total",
+            "gang-level reservation-reuse attempts by outcome",
+        ).inc(outcome=outcome)
 
     # -- priority preemption (the reclaim the reference outsources to KAI;
     # SURVEY §2: Grove hands PodGangs to an external scheduler that owns

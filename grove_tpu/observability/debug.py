@@ -128,6 +128,12 @@ def harness_dump(harness) -> dict[str, Any]:
         # the tenant-queue arithmetic behind admission/fairness decisions
         # (grove_tpu/tenancy): shares, entitlements, deficits, budgets
         out["tenancy"] = tenancy.debug_state()
+    serving = getattr(harness.cluster, "serving", None)
+    if serving is not None:
+        # the elastic-serving loop (grove_tpu/serving): trace shape,
+        # workload tiers, injected spikes, metrics-pipeline occupancy —
+        # the runbook's first stop for "why didn't the HPA scale"
+        out["serving"] = serving.debug_state()
     return out
 
 

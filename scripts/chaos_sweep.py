@@ -40,12 +40,15 @@ from grove_tpu.cluster import make_nodes  # noqa: E402
 from grove_tpu.controller import Harness  # noqa: E402
 
 
-def sweep_workload():
+def sweep_workload(scaled: bool = False):
     """The reference chaos workload: startup ordering + a scaling group —
     every orchestration flow (gang create/defer, gates, scaled gangs,
-    RBAC) is on the fault path."""
+    RBAC) is on the fault path. `scaled=True` (the --serving axis) adds
+    an HPA scaleConfig on the scaling group so the traffic-driven scale
+    loop has a subresource to write."""
     from grove_tpu.api.meta import ObjectMeta
     from grove_tpu.api.types import (
+        AutoScalingConfig,
         Container,
         PodCliqueSet,
         PodCliqueSetSpec,
@@ -82,6 +85,13 @@ def sweep_workload():
                     PodCliqueScalingGroupConfig(
                         name="g", clique_names=["be"],
                         replicas=2, min_available=1,
+                        scale_config=(
+                            AutoScalingConfig(
+                                min_replicas=1, max_replicas=4,
+                                target_utilization=0.7,
+                            )
+                            if scaled else None
+                        ),
                     )
                 ],
                 startup_type="CliqueStartupTypeExplicit",
@@ -107,6 +117,29 @@ TENANT_SKEW_CONFIG = {
 }
 
 
+#: serving config for --serving sweeps: a FLAT trace (base == peak,
+#: noise 0) so the autoscaler fixpoint is time-invariant — the chaotic
+#: run's injected spikes scale the fleet up mid-storm, and at disarm the
+#: drain must bring it back to exactly the fault-free equilibrium
+#: (PCSG replicas 3 at these numbers: 126 rps over 2 PCS replicas x
+#: 3 PCSG replicas x 3 be-pods x 10 rps/pod = 0.7 utilization, on
+#: target). Short stabilization window so the sweep drains fast.
+SERVING_CONFIG = {
+    "serving": {
+        "enabled": True,
+        "trace": {"base_rps": 126.0, "peak_rps": 126.0, "noise": 0.0},
+        "workloads": [
+            {"clique": "be", "shape": "decode", "rps_per_replica": 10.0,
+             "demand_fraction": 1.0},
+        ],
+    },
+    "autoscaler": {
+        "sync_interval_seconds": 10.0,
+        "scale_down_stabilization_seconds": 30.0,
+    },
+}
+
+
 #: durability config for --durability sweeps: aggressive snapshot cadence
 #: so crashes land on every recovery path (fresh WAL tail, snapshot +
 #: replay, post-checkpoint generations). fsync "never" deliberately: the
@@ -125,8 +158,18 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              explain_dir: Path | None = None,
              tenant_skew: bool = False,
              shards: int = 1,
-             durability: bool = False) -> dict:
+             durability: bool = False,
+             serving: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    if serving:
+        # the elastic-serving fault axis: seeded traffic spikes onto the
+        # flat trace (the HPA loop scales up mid-storm and must
+        # stabilize back down after disarm) + metrics-pipeline dropouts
+        # (stale samples must HOLD the fleet, never collapse it)
+        overrides.update(
+            traffic_spike_rate=0.3,
+            metrics_dropout_rate=0.25,
+        )
     wal_tmp = None
     if durability:
         # the durable-store fault axis: whole-process crashes recovering
@@ -158,6 +201,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         if trace_dir is not None else None
     )
     config = dict(TENANT_SKEW_CONFIG) if tenant_skew else {}
+    if serving:
+        config = {**config, **SERVING_CONFIG}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
     if wal_tmp is not None:
@@ -168,7 +213,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     try:
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
-            explain_dir, durability,
+            explain_dir, durability, serving,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -179,7 +224,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 
 
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
-                    explain_dir, durability) -> dict:
+                    explain_dir, durability, serving=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -197,7 +242,15 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
     t0 = time.perf_counter()
     error = None
     try:
-        ch.apply(sweep_workload())
+        ch.apply(sweep_workload(scaled=serving))
+        if serving:
+            # reach the traffic-driven equilibrium BEFORE the storm, the
+            # same way the baseline does — chaos then measures recovery
+            # back to it, not initial convergence under fire
+            ch.settle()
+            for _ in range(4):
+                ch.harness.advance(11.0)
+                ch.harness.autoscale()
         ch.run_chaos()
         fingerprint_ok = settled_fingerprint(ch.raw_store) == baseline
         violations = check_invariants(ch.raw_store)
@@ -288,6 +341,17 @@ def main(argv=None) -> int:
                          "the previous retained generation), and disk "
                          "stalls; convergence is checked against the "
                          "same fault-free fixpoint")
+    ap.add_argument("--serving", action="store_true",
+                    help="arm the elastic-serving fault axis: serving is "
+                         "configured with a FLAT traffic trace feeding "
+                         "the kubelet->aggregation->HPA metrics "
+                         "pipeline, the scaling group gets an HPA, and "
+                         "the plan adds seeded traffic spikes (the loop "
+                         "must scale up and stabilize back down after "
+                         "disarm) and metrics-pipeline dropouts (stale "
+                         "samples must never drive scale-down); "
+                         "convergence is checked against the fault-free "
+                         "traffic-driven equilibrium")
     ap.add_argument("--tenant-skew", dest="tenant_skew",
                     action="store_true",
                     help="enable tenant-skew load faults: tenancy "
@@ -312,12 +376,21 @@ def main(argv=None) -> int:
     # SINGLE-replica: the sharded runs must converge to the same
     # workload state a lone manager reaches (sharding is
     # workload-invisible by contract)
+    baseline_config = dict(TENANT_SKEW_CONFIG) if args.tenant_skew else {}
+    if args.serving:
+        baseline_config = {**baseline_config, **SERVING_CONFIG}
     baseline_h = Harness(
         nodes=make_nodes(args.nodes),
-        config=TENANT_SKEW_CONFIG if args.tenant_skew else None,
+        config=baseline_config or None,
     )
-    baseline_h.apply(sweep_workload())
+    baseline_h.apply(sweep_workload(scaled=args.serving))
     baseline_h.settle()
+    if args.serving:
+        # drive the HPA loop to its flat-trace equilibrium: the chaotic
+        # runs must converge back to exactly this fleet shape
+        for _ in range(4):
+            baseline_h.advance(11.0)
+            baseline_h.autoscale()
     baseline = settled_fingerprint(baseline_h.store)
 
     results = []
@@ -327,7 +400,8 @@ def main(argv=None) -> int:
                           explain_dir=explain_dir,
                           tenant_skew=args.tenant_skew,
                           shards=args.shards,
-                          durability=args.durability)
+                          durability=args.durability,
+                          serving=args.serving)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -338,6 +412,7 @@ def main(argv=None) -> int:
         "nodes": args.nodes,
         "shards": args.shards,
         "durability": args.durability,
+        "serving": args.serving,
         "failed_seeds": failed,
         "ok": not failed,
     }

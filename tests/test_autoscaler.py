@@ -1,0 +1,374 @@
+"""Autoscaler controller suite: the k8s HPA algorithm's edges.
+
+The AS* e2e cases in test_e2e_updates.py prove the happy path end to
+end; this file pins the controller semantics the diurnal serving loop
+leans on — the tolerance band, min/max clamping, the
+missing-metrics-never-scale-down rule (absent AND stale samples),
+PCSG-vs-PodClique pod selection, the scale-down stabilization window,
+sample GC for deleted pods, and HPA admission.
+"""
+
+import pytest
+
+from grove_tpu.api import ValidationError
+from grove_tpu.api.auxiliary import (
+    HorizontalPodAutoscaler,
+    HPASpec,
+)
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import (
+    AutoScalingConfig,
+    Pod,
+    PodClique,
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupConfig,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+
+from test_e2e_basic import clique, simple_pcs
+
+
+def scaled_pcs(min_replicas=1, max_replicas=5, target=0.5):
+    return simple_pcs(
+        name="as",
+        cliques=[clique("w", replicas=2)],
+        sgs=[PodCliqueScalingGroupConfig(
+            name="grp", clique_names=["w"], replicas=2, min_available=1,
+            scale_config=AutoScalingConfig(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                target_utilization=target,
+            ))],
+    )
+
+
+def harness(config=None):
+    return Harness(nodes=make_nodes(16), config=config)
+
+
+def observe_all(h, utilization):
+    for p in h.store.list(Pod.KIND):
+        h.autoscaler.observe(p.metadata.name, utilization)
+
+
+def grp_replicas(h):
+    return h.store.get(
+        PodCliqueScalingGroup.KIND, "default", "as-0-grp"
+    ).spec.replicas
+
+
+class TestToleranceAndClamping:
+    def test_within_tolerance_no_scale(self):
+        h = harness()
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 0.54)  # ratio 1.08, inside the 0.1 band
+        h.autoscale()
+        assert grp_replicas(h) == 2
+
+    def test_just_outside_tolerance_scales(self):
+        h = harness()
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 0.56)  # ratio 1.12 > 1.1
+        h.autoscale()
+        assert grp_replicas(h) == 3
+
+    def test_max_clamp(self):
+        h = harness()
+        h.apply(scaled_pcs(max_replicas=3))
+        h.settle()
+        observe_all(h, 5.0)  # ratio 10 -> desired 20, clamped
+        h.autoscale()
+        assert grp_replicas(h) == 3
+
+    def test_min_clamp(self):
+        h = harness(config={
+            "autoscaler": {"scale_down_stabilization_seconds": 0.0}
+        })
+        h.apply(scaled_pcs(min_replicas=2))
+        h.settle()
+        observe_all(h, 0.01)  # desired 1, clamped up to min 2
+        h.autoscale()
+        assert grp_replicas(h) == 2
+
+    def test_float_dust_does_not_overscale(self):
+        """126/120/0.7 = 1.5000000000000002: a bare ceil would scale
+        2 -> 4; the epsilon-guarded one lands on 3 like the k8s
+        milli-unit math."""
+        h = harness()
+        h.apply(scaled_pcs(target=0.7))
+        h.settle()
+        observe_all(h, 1.05)  # ratio "1.5"
+        h.autoscale()
+        assert grp_replicas(h) == 3
+
+
+class TestMissingMetrics:
+    def test_no_samples_no_scale(self):
+        h = harness()
+        h.apply(scaled_pcs())
+        h.settle()
+        h.autoscale()
+        assert grp_replicas(h) == 2
+
+    def test_stale_samples_never_drive_scale_down(self):
+        h = harness(config={
+            "autoscaler": {
+                "metrics_max_age_seconds": 30.0,
+                "scale_down_stabilization_seconds": 0.0,
+            }
+        })
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 0.05)  # would scale to min...
+        h.advance(31.0)       # ...but the samples age past the horizon
+        h.autoscale()
+        assert grp_replicas(h) == 2
+
+    def test_fresh_samples_do_scale_down(self):
+        h = harness(config={
+            "autoscaler": {
+                "metrics_max_age_seconds": 30.0,
+                "scale_down_stabilization_seconds": 0.0,
+            }
+        })
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 0.05)
+        h.autoscale()
+        assert grp_replicas(h) == 1
+
+
+class TestPodSelection:
+    def test_pcsg_target_averages_only_its_pods(self):
+        """The PCSG-target HPA selects by the grove.io/
+        podcliquescalinggroup label: samples on the standalone clique's
+        pods must not feed it."""
+        h = harness()
+        pcs = simple_pcs(
+            name="as",
+            cliques=[clique("w", replicas=2), clique("solo", replicas=2)],
+            sgs=[PodCliqueScalingGroupConfig(
+                name="grp", clique_names=["w"], replicas=2, min_available=1,
+                scale_config=AutoScalingConfig(
+                    min_replicas=1, max_replicas=5, target_utilization=0.5,
+                ))],
+        )
+        h.apply(pcs)
+        h.settle()
+        from grove_tpu.api import constants
+
+        for p in h.store.list(Pod.KIND):
+            if constants.LABEL_PCSG in p.metadata.labels:
+                h.autoscaler.observe(p.metadata.name, 0.5)  # on target
+            else:
+                h.autoscaler.observe(p.metadata.name, 5.0)  # screaming
+        h.autoscale()
+        assert grp_replicas(h) == 2  # the solo pods' load is not ours
+
+    def test_clique_target_scales_pod_count(self):
+        """A standalone clique with scale_config gets a
+        PodClique-target HPA whose writes change the clique's pod count
+        directly (selection by the grove.io/podclique label)."""
+        h = harness()
+        pcs = simple_pcs(
+            name="as",
+            cliques=[clique("solo", replicas=2)],
+        )
+        pcs.spec.template.cliques[0].spec.scale_config = AutoScalingConfig(
+            min_replicas=1, max_replicas=6, target_utilization=0.5,
+        )
+        h.apply(pcs)
+        h.settle()
+        observe_all(h, 1.0)  # 2x target
+        h.autoscale()
+        pclq = h.store.get(PodClique.KIND, "default", "as-0-solo")
+        assert pclq.spec.replicas == 4
+        pods = [p for p in h.store.list(Pod.KIND) if p.status.ready]
+        assert len(pods) == 4
+
+
+class TestStabilizationWindow:
+    def cfg(self, window):
+        return {"autoscaler": {
+            "scale_down_stabilization_seconds": window,
+            "metrics_max_age_seconds": 600.0,
+            "sync_interval_seconds": 10.0,
+        }}
+
+    def test_scale_down_held_by_recent_high_recommendation(self):
+        h = harness(config=self.cfg(120.0))
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 1.0)   # recommends 4
+        h.autoscale()
+        assert grp_replicas(h) == 4
+        observe_all(h, 0.05)  # noisy trough: raw recommendation is min
+        h.advance(20.0)
+        h.autoscale()
+        # the 4-recommendation is still inside the window: held
+        assert grp_replicas(h) == 4
+        holds = h.cluster.metrics.counter(
+            "grove_autoscaler_stabilized_holds_total"
+        )
+        assert holds.total() >= 1
+
+    def test_scale_down_applies_after_window_expires(self):
+        h = harness(config=self.cfg(120.0))
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 1.0)
+        h.autoscale()
+        assert grp_replicas(h) == 4
+        h.advance(121.0)      # the high recommendation ages out
+        observe_all(h, 0.05)
+        h.autoscale()
+        assert grp_replicas(h) == 1
+
+    def test_zero_window_scales_down_immediately(self):
+        h = harness(config=self.cfg(0.0))
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 1.0)
+        h.autoscale()
+        observe_all(h, 0.05)
+        h.advance(1.0)
+        h.autoscale()
+        assert grp_replicas(h) == 1
+
+    def test_scale_up_is_never_stabilized(self):
+        h = harness(config=self.cfg(300.0))
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 1.0)
+        h.autoscale()
+        assert grp_replicas(h) == 4  # immediate, window is down-only
+
+
+class TestMetricsGC:
+    def test_samples_of_deleted_pods_are_pruned(self):
+        h = harness()
+        h.apply(scaled_pcs())
+        h.settle()
+        pipeline = h.cluster.pod_metrics
+        observe_all(h, 0.5)
+        live = len(pipeline)
+        for i in range(50):
+            h.autoscaler.observe(f"ghost-{i}", 1.0)
+        assert len(pipeline) == live + 50
+        h.autoscale()  # the sweep GCs entries for pods that don't exist
+        assert len(pipeline) == live
+        gced = h.cluster.metrics.counter(
+            "grove_autoscaler_samples_gced_total"
+        )
+        assert gced.total() == 50
+
+    def test_churn_does_not_grow_the_aggregator(self):
+        """Scale up then down: the deleted scaled pods' samples leave on
+        the next sweep instead of surviving forever."""
+        h = harness(config={
+            "autoscaler": {"scale_down_stabilization_seconds": 0.0}
+        })
+        h.apply(scaled_pcs())
+        h.settle()
+        observe_all(h, 1.0)
+        h.autoscale()
+        assert grp_replicas(h) == 4
+        observe_all(h, 0.05)
+        h.advance(1.0)
+        h.autoscale()
+        assert grp_replicas(h) == 1
+        h.autoscale()
+        pipeline = h.cluster.pod_metrics
+        live = {
+            (p.metadata.namespace, p.metadata.name)
+            for p in h.store.list(Pod.KIND)
+        }
+        # hand-fed observe() samples live under the ANY_NAMESPACE
+        # sentinel; either way every surviving key names a live pod
+        allowed = live | {
+            (pipeline.ANY_NAMESPACE, name) for _, name in live
+        }
+        assert set(pipeline._samples) <= allowed
+
+
+class TestHPAAdmission:
+    def mk(self, **kw):
+        spec = dict(
+            target_kind=PodCliqueScalingGroup.KIND, target_name="t",
+            min_replicas=1, max_replicas=3, target_utilization=0.5,
+        )
+        spec.update(kw)
+        return HorizontalPodAutoscaler(
+            metadata=ObjectMeta(name="h"), spec=HPASpec(**spec)
+        )
+
+    def test_valid_hpa_admitted(self):
+        h = harness()
+        h.store.create(self.mk())
+
+    def test_min_above_max_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="min_replicas"):
+            h.store.create(self.mk(min_replicas=4, max_replicas=3))
+
+    def test_min_below_one_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="min_replicas"):
+            h.store.create(self.mk(min_replicas=0))
+
+    def test_nonpositive_target_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="target_utilization"):
+            h.store.create(self.mk(target_utilization=0.0))
+
+    def test_unscalable_target_kind_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="target_kind"):
+            h.store.create(self.mk(target_kind="Pod"))
+
+    def test_template_scale_config_min_above_max_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="minReplicas"):
+            h.apply(scaled_pcs(min_replicas=6, max_replicas=5))
+
+    def test_template_scale_config_bad_target_rejected(self):
+        h = harness()
+        with pytest.raises(ValidationError, match="targetUtilization"):
+            h.apply(scaled_pcs(target=1.5))
+
+
+class TestConfigValidation:
+    def test_bad_autoscaler_knobs_rejected(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(ValidationError) as exc:
+            load_operator_config({
+                "autoscaler": {
+                    "sync_interval_seconds": 0,
+                    "scale_down_stabilization_seconds": -1,
+                    "metrics_max_age_seconds": -5,
+                }
+            })
+        msg = str(exc.value)
+        assert "sync_interval_seconds" in msg
+        assert "scale_down_stabilization_seconds" in msg
+        assert "metrics_max_age_seconds" in msg
+
+    def test_max_age_below_sync_interval_rejected(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(ValidationError, match="metrics_max_age"):
+            load_operator_config({
+                "autoscaler": {
+                    "sync_interval_seconds": 60.0,
+                    "metrics_max_age_seconds": 30.0,
+                }
+            })
+
+    def test_reservation_reuse_must_be_bool(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(ValidationError, match="reservation_reuse"):
+            load_operator_config({"solver": {"reservation_reuse": 1}})

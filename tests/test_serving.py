@@ -1,0 +1,450 @@
+"""Elastic-serving suite: the traffic engine, the metrics pipeline, the
+closed scale loop, reservation reuse on scale cycles, and the
+traffic-fault chaos convergence contract (grove_tpu/serving/,
+docs/operations.md "Elastic serving")."""
+
+import pytest
+
+from grove_tpu.api import ValidationError
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import (
+    AutoScalingConfig,
+    Container,
+    Pod,
+    PodCliqueScalingGroup,
+    PodCliqueScalingGroupConfig,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.serving import (
+    PodMetrics,
+    SpikeEvent,
+    TrafficTrace,
+    WorkloadShape,
+)
+
+#: a flat trace (base == peak, no noise) whose equilibrium at these
+#: numbers is PCSG replicas 3: 126 rps over 2 PCS x 3 PCSG x 3 be-pods
+#: x 10 rps/pod = 0.7 utilization, exactly on target
+FLAT_SERVING = {
+    "serving": {
+        "enabled": True,
+        "trace": {"base_rps": 126.0, "peak_rps": 126.0, "noise": 0.0},
+        "workloads": [
+            {"clique": "be", "shape": "decode", "rps_per_replica": 10.0,
+             "demand_fraction": 1.0},
+        ],
+    },
+    "autoscaler": {
+        "sync_interval_seconds": 10.0,
+        "scale_down_stabilization_seconds": 30.0,
+    },
+}
+
+
+def serving_workload():
+    """The chaos-sweep workload shape with an HPA on the scaling group
+    (scripts/chaos_sweep.py sweep_workload(scaled=True))."""
+    return PodCliqueSet(
+        metadata=ObjectMeta(name="chaos"),
+        spec=PodCliqueSetSpec(
+            replicas=2,
+            template=PodCliqueSetTemplateSpec(
+                cliques=[
+                    PodCliqueTemplateSpec(
+                        name="fe",
+                        spec=PodCliqueSpec(
+                            replicas=2,
+                            pod_spec=PodSpec(containers=[
+                                Container(name="m", resources={"cpu": 1.0})
+                            ]),
+                        ),
+                    ),
+                    PodCliqueTemplateSpec(
+                        name="be",
+                        spec=PodCliqueSpec(
+                            replicas=3,
+                            pod_spec=PodSpec(containers=[
+                                Container(name="m", resources={"cpu": 1.0})
+                            ]),
+                        ),
+                    ),
+                ],
+                pod_clique_scaling_group_configs=[
+                    PodCliqueScalingGroupConfig(
+                        name="g", clique_names=["be"],
+                        replicas=2, min_available=1,
+                        scale_config=AutoScalingConfig(
+                            min_replicas=1, max_replicas=4,
+                            target_utilization=0.7,
+                        ),
+                    )
+                ],
+            ),
+        ),
+    )
+
+
+def drive_to_equilibrium(h, sweeps=5):
+    for _ in range(sweeps):
+        h.advance(11.0)
+        h.autoscale()
+
+
+def grp_replicas(h, name="chaos-0-g"):
+    return h.store.get(PodCliqueScalingGroup.KIND, "default", name).spec.replicas
+
+
+class TestTrafficTrace:
+    def test_diurnal_swing_spans_base_to_peak(self):
+        tr = TrafficTrace(base_rps=100.0, peak_rps=1000.0,
+                          period_seconds=3600.0, noise=0.0)
+        assert tr.demand(0.0) == pytest.approx(100.0)
+        assert tr.demand(1800.0) == pytest.approx(1000.0)
+        assert tr.demand(3600.0) == pytest.approx(100.0)
+
+    def test_demand_is_a_pure_function_of_time(self):
+        """Calling demand() repeatedly, out of order, or from a second
+        identically-configured instance gives bit-identical values —
+        the chaos-replay contract."""
+        a = TrafficTrace(base_rps=50, peak_rps=500, period_seconds=600,
+                         noise=0.2, seed=7)
+        b = TrafficTrace(base_rps=50, peak_rps=500, period_seconds=600,
+                         noise=0.2, seed=7)
+        times = [0.0, 17.0, 599.0, 17.0, 301.5, 0.0]
+        assert [a.demand(t) for t in times] == [b.demand(t) for t in reversed(times)][::-1]
+        assert a.demand(17.0) == a.demand(17.0)
+
+    def test_noise_draw_depends_on_bucket_not_call_count(self):
+        tr = TrafficTrace(base_rps=100, peak_rps=100, noise=0.3, seed=3,
+                          sample_seconds=15.0)
+        v1 = tr.demand(16.0)
+        for _ in range(10):
+            tr.demand(500.0)
+        assert tr.demand(16.0) == v1
+        # different seed, different stream
+        assert TrafficTrace(base_rps=100, peak_rps=100, noise=0.3, seed=4,
+                            sample_seconds=15.0).demand(16.0) != v1
+
+    def test_spikes_multiply_while_active(self):
+        tr = TrafficTrace(
+            base_rps=100, peak_rps=100, noise=0.0,
+            spikes=[SpikeEvent(at_seconds=10, duration_seconds=5,
+                               multiplier=3.0)],
+        )
+        assert tr.demand(9.9) == pytest.approx(100.0)
+        assert tr.demand(12.0) == pytest.approx(300.0)
+        assert tr.demand(15.0) == pytest.approx(100.0)
+
+    def test_workload_shape_math(self):
+        w = WorkloadShape(clique="d", shape="decode", rps_per_replica=50.0,
+                          demand_fraction=0.5)
+        assert w.utilization(1000.0, 20) == pytest.approx(0.5)
+        assert w.utilization(1000.0, 0) == 1.0  # no capacity = saturated
+        assert w.required_pods(1000.0, 0.7) == 15  # 500/(50*0.7)=14.3
+
+    def test_shape_defaults_fill_in(self):
+        w = WorkloadShape(clique="p", shape="prefill")
+        assert w.rps_per_replica == 25.0
+        assert w.demand_fraction == 0.45
+
+
+class TestPodMetrics:
+    def test_staleness_horizon(self):
+        pm = PodMetrics(max_age_seconds=30.0)
+        pm.report("p", 0.5, now=100.0)
+        assert pm.get("p", 120.0) == 0.5
+        assert pm.get("p", 131.0) is None
+        assert pm.get("ghost", 0.0) is None
+
+    def test_gc_drops_dead_pods(self):
+        pm = PodMetrics()
+        for i in range(5):
+            pm.report(f"p{i}", 0.1, now=0.0)
+        live = {("default", "p0"), ("default", "p3")}
+        assert pm.gc(live) == 3
+        assert len(pm) == 2
+
+    def test_namespaced_pods_do_not_collide(self):
+        """Same-named pods in two namespaces keep independent samples —
+        a name-keyed map would let one tier's reports overwrite the
+        other's and cross-scale the HPAs."""
+        pm = PodMetrics()
+        pm.report("serve-0-w-0", 0.2, now=0.0, namespace="a")
+        pm.report("serve-0-w-0", 0.9, now=0.0, namespace="b")
+        assert pm.get("serve-0-w-0", 0.0, namespace="a") == 0.2
+        assert pm.get("serve-0-w-0", 0.0, namespace="b") == 0.9
+
+    def test_dropout_suppresses_reports(self):
+        pm = PodMetrics()
+        pm.dropout_steps = 2
+        pm.report("p", 0.5, now=0.0)
+        assert pm.get("p", 0.0) is None
+        assert pm.dropped_total == 1
+        pm.tick_dropout()
+        pm.tick_dropout()
+        pm.report("p", 0.5, now=1.0)
+        assert pm.get("p", 1.0) == 0.5
+
+
+class TestServingConfig:
+    def test_enabled_requires_workloads(self):
+        with pytest.raises(ValidationError, match="workloads"):
+            Harness(nodes=make_nodes(4),
+                    config={"serving": {"enabled": True}})
+
+    def test_bad_trace_rejected(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(ValidationError) as exc:
+            load_operator_config({"serving": {"trace": {
+                "base_rps": 100.0, "peak_rps": 50.0, "noise": -1,
+                "bogus": 1,
+            }}})
+        msg = str(exc.value)
+        assert "peak_rps" in msg and "noise" in msg and "bogus" in msg
+
+    def test_bad_workload_rejected(self):
+        from grove_tpu.api.config import load_operator_config
+
+        with pytest.raises(ValidationError) as exc:
+            load_operator_config({"serving": {"workloads": [
+                {"clique": "a", "shape": "nosuch"},
+                {"clique": "a", "demand_fraction": 2.0},
+                {"shape": "decode"},
+            ]}})
+        msg = str(exc.value)
+        assert "shape" in msg and "duplicate" in msg and "clique" in msg
+
+
+class TestScaleLoop:
+    """The closed loop: trace -> kubelet reporting -> aggregation ->
+    HPA sync -> scale subresource -> scaled PodGangs -> bound pods."""
+
+    def test_kubelet_reports_into_the_pipeline(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        pipeline = h.cluster.pod_metrics
+        assert len(pipeline) > 0
+        # only the configured tier's pods report (fe has no workload)
+        be_pods = {
+            (p.metadata.namespace, p.metadata.name)
+            for p in h.store.list(Pod.KIND)
+            if "-g-" in p.metadata.name
+        }
+        assert set(pipeline._samples) <= {
+            (p.metadata.namespace, p.metadata.name)
+            for p in h.store.list(Pod.KIND)
+        }
+        assert be_pods & set(pipeline._samples)
+        assert h.cluster.metrics.gauge(
+            "grove_serving_demand_rps"
+        ).value() == pytest.approx(126.0)
+
+    def test_traffic_drives_scale_to_equilibrium(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        drive_to_equilibrium(h)
+        assert grp_replicas(h, "chaos-0-g") == 3
+        assert grp_replicas(h, "chaos-1-g") == 3
+        # the loop created the scaled gangs and bound their pods
+        gangs = sorted(g.metadata.name for g in h.store.list("PodGang"))
+        assert "chaos-0-g-1" in gangs
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+    def test_spike_scales_up_then_stabilizes_back(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        drive_to_equilibrium(h)
+        h.cluster.serving.inject_spike(h.clock.now(), 60.0, 3.0)
+        h.advance(11.0)
+        h.autoscale()
+        assert grp_replicas(h) == 4  # clamped at max
+        h.cluster.serving.clear_injected()
+        # past the stabilization window the fleet returns to equilibrium
+        h.advance(45.0)
+        h.autoscale()
+        drive_to_equilibrium(h, sweeps=2)
+        assert grp_replicas(h) == 3
+
+    def test_dropout_holds_the_fleet(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        drive_to_equilibrium(h)
+        pm = h.cluster.pod_metrics
+        pm.dropout_steps = 10**6  # pipeline outage
+        # make every sample stale: without fresh metrics the HPA must
+        # HOLD at 3, not collapse to min
+        h.advance(200.0)
+        h.autoscale()
+        h.advance(11.0)
+        h.autoscale()
+        assert grp_replicas(h) == 3
+        pm.dropout_steps = 0
+        drive_to_equilibrium(h, sweeps=2)
+        assert grp_replicas(h) == 3
+
+    def test_hpa_sync_cadence_is_config_driven(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        assert h.maybe_autoscale() is True   # first opportunity sweeps
+        assert h.maybe_autoscale() is False  # same instant: not due
+        h.advance(9.0)
+        assert h.maybe_autoscale() is False  # inside the 10s interval
+        h.advance(2.0)
+        assert h.maybe_autoscale() is True
+
+    def test_debug_dump_carries_serving_section(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        dump = h.debug_dump()["serving"]
+        assert dump["trace"]["base_rps"] == 126.0
+        assert dump["workloads"][0]["clique"] == "be"
+        assert dump["pipeline"]["samples"] > 0
+
+
+class TestReservationReuseOnScaleCycle:
+    def one_pcs(self):
+        return PodCliqueSet(
+            metadata=ObjectMeta(name="s"),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[PodCliqueTemplateSpec(
+                        name="w",
+                        spec=PodCliqueSpec(
+                            replicas=3,
+                            pod_spec=PodSpec(containers=[
+                                Container(name="m", resources={"cpu": 1.0})
+                            ]),
+                        ),
+                    )],
+                    pod_clique_scaling_group_configs=[
+                        PodCliqueScalingGroupConfig(
+                            name="g", clique_names=["w"],
+                            replicas=3, min_available=1,
+                        )
+                    ],
+                ),
+            ),
+        )
+
+    def scale(self, h, replicas):
+        pcsg = h.store.get(PodCliqueScalingGroup.KIND, "default", "s-0-g")
+        pcsg.spec.replicas = replicas
+        h.store.update(pcsg)
+        h.settle()
+
+    def placements(self, h):
+        return {
+            p.metadata.name: p.node_name
+            for p in h.store.list(Pod.KIND)
+            if "-g-" in p.metadata.name
+        }
+
+    def test_scale_cycle_reuses_vacated_slots(self):
+        h = Harness(nodes=make_nodes(
+            24, allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}
+        ))
+        h.apply(self.one_pcs())
+        h.settle()
+        before = self.placements(h)
+        self.scale(h, 1)   # trough: scaled gangs deleted
+        self.scale(h, 3)   # ramp: same-named gangs recreated
+        ctr = h.cluster.metrics.counter(
+            "grove_scheduler_reservation_reuse_total"
+        )
+        assert ctr.value(outcome="hit") == 2  # both scaled gangs
+        assert self.placements(h) == before  # topology-stable
+
+    def test_reuse_disabled_by_config(self):
+        h = Harness(
+            nodes=make_nodes(
+                24, allocatable={"cpu": 1.0, "memory": 8.0, "tpu": 0.0}
+            ),
+            config={"solver": {"reservation_reuse": False}},
+        )
+        h.apply(self.one_pcs())
+        h.settle()
+        self.scale(h, 1)
+        self.scale(h, 3)
+        ctr = h.cluster.metrics.counter(
+            "grove_scheduler_reservation_reuse_total"
+        )
+        assert ctr.total() == 0  # the pre-pass never ran
+        # the workload still converges through the general solve
+        assert all(p.status.ready for p in h.store.list(Pod.KIND))
+
+
+class TestServingChaos:
+    """The acceptance contract: with traffic_spike/metrics_dropout armed
+    the chaotic run must converge to the fault-free traffic-driven
+    equilibrium once the faults leave at disarm (the wide sweep is
+    scripts/chaos_sweep.py --serving)."""
+
+    def baseline(self):
+        h = Harness(nodes=make_nodes(24), config=FLAT_SERVING)
+        h.apply(serving_workload())
+        h.settle()
+        for _ in range(4):
+            h.advance(11.0)
+            h.autoscale()
+        from grove_tpu.chaos import settled_fingerprint
+
+        return settled_fingerprint(h.store)
+
+    def run_seed(self, seed):
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        plan = FaultPlan.from_seed(
+            seed, traffic_spike_rate=0.3, metrics_dropout_rate=0.25,
+        )
+        ch = ChaosHarness(plan, nodes=make_nodes(24), config=FLAT_SERVING)
+        ch.apply(serving_workload())
+        ch.settle()
+        for _ in range(4):
+            ch.harness.advance(11.0)
+            ch.harness.autoscale()
+        ch.run_chaos()
+        return ch, plan
+
+    @pytest.mark.parametrize("seed", [1, 5])
+    def test_traffic_faults_converge_to_fault_free_fixpoint(self, seed):
+        from grove_tpu.chaos import check_invariants, settled_fingerprint
+
+        baseline = self.baseline()
+        ch, plan = self.run_seed(seed)
+        assert settled_fingerprint(ch.raw_store) == baseline
+        assert check_invariants(ch.raw_store) == []
+        # the seed actually exercised the serving fault vocabulary
+        assert (
+            plan.counts.get("traffic_spike", 0)
+            + plan.counts.get("metrics_dropout", 0)
+        ) > 0
+        # disarm repair really ran
+        assert ch.harness.cluster.serving.injected_spikes == ()
+        assert ch.harness.cluster.pod_metrics.dropout_steps == 0
+
+    def test_rate_zero_plans_never_draw_serving_faults(self):
+        """Pre-existing seeds' draw sequences are untouched: a plan with
+        the default 0 rates injects nothing even with serving armed."""
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        plan = FaultPlan.from_seed(3)
+        ch = ChaosHarness(plan, nodes=make_nodes(24), config=FLAT_SERVING)
+        ch.apply(serving_workload())
+        ch.run_chaos()
+        assert "traffic_spike" not in plan.counts
+        assert "metrics_dropout" not in plan.counts
