@@ -55,6 +55,66 @@ def make_cluster(num_nodes: int):
     return encode_topology(ct, nodes)
 
 
+def make_tier_cluster(num_nodes: int):
+    """Synthetic 4-level topology for the --scale-tier regimes:
+    zone (4096 nodes) > block (256) > rack (16) > host. At 100k nodes
+    this is ~25 zones / ~391 blocks / 6250 racks — the shape whose flat
+    [G, D] cost tensor (D ~ 107k with the per-node host level) is
+    infeasible to materialize, which is exactly what the hierarchical
+    solve exists for."""
+    nodes = []
+    for i in range(num_nodes):
+        z, zr = divmod(i, 4096)
+        b = zr // 256
+        r = (zr % 256) // 16
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(
+                    name=f"n{i}",
+                    labels={
+                        "t/zone": f"z{z}",
+                        "t/block": f"z{z}b{b}",
+                        "t/rack": f"z{z}b{b}r{r}",
+                    },
+                ),
+                allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+            )
+        )
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="zone", key="t/zone"),
+            TopologyLevel(domain="block", key="t/block"),
+            TopologyLevel(domain="rack", key="t/rack"),
+        ]
+    )
+    return encode_topology(ct, nodes)
+
+
+def make_tier_gangs(num_gangs: int) -> list[SolverGang]:
+    """Block-confined 8-pod gangs (required block, preferred rack) for
+    the tier regimes — the gang-packing shape the reference's workloads
+    carry, and what confines the backlog so the hierarchy can prune at
+    the block level."""
+    gangs = []
+    demand = np.tile(np.array([4.0, 16.0, 1.0], np.float32), (8, 1))
+    for i in range(num_gangs):
+        gangs.append(
+            SolverGang(
+                name=f"tier{i:06d}",
+                namespace="bench",
+                demand=demand,
+                pod_names=[f"tier{i:06d}-p{j}" for j in range(8)],
+                group_ids=np.zeros(8, np.int32),
+                group_names=["workers"],
+                group_required_level=np.array([-1], np.int32),
+                group_preferred_level=np.array([-1], np.int32),
+                required_level=1,
+                preferred_level=2,
+            )
+        )
+    return gangs
+
+
 def make_gangs(num_gangs: int, grouped: bool = False) -> list[SolverGang]:
     """Mixed backlog: plain 8-pod gangs (block-required, rack-preferred) and
     leader/worker gangs whose two groups each pack a rack.
@@ -213,6 +273,28 @@ def main() -> int:
                     "diurnal cycles span the run, so troughs scale the "
                     "fleet down and the second ramp re-places onto "
                     "remembered reservations); --small clamps to 2.0")
+    ap.add_argument("--scale-tier", choices=("20k", "100k"), default=None,
+                    help="hierarchical scale-tier regime (ROADMAP item 1): "
+                    "solve a block-confined backlog over a synthetic "
+                    "4-level topology (zone/block/rack/host; 20k nodes / "
+                    "4k gangs or 100k nodes / 20k gangs) with the "
+                    "HIERARCHICAL two-level engine — coarse block-level "
+                    "pruning + per-domain sub-solves with shard-local "
+                    "incrementality — reporting p50/min/max backlog-bind "
+                    "over dirty-tick repeats plus the dispatch-kind "
+                    "counters proving the incremental tier ran. "
+                    "Interleaved A/B against the flat engine where the "
+                    "flat cost tensor is still materializable (20k); at "
+                    "100k the flat side is reported as skipped — its "
+                    "[G, D] tensor alone is tens of GB, which is the "
+                    "ceiling this regime exists to break. Combine with "
+                    "--sharded for the mesh path; exits nonzero if the "
+                    "incremental tier never ran shard-locally")
+    ap.add_argument("--tier-repeats", type=int, default=5,
+                    help="--scale-tier: dirty-tick repeats per side "
+                    "(min/median/max reported; this host's throttling "
+                    "swings walls ~2x run-to-run, so single numbers "
+                    "mislead)")
     ap.add_argument("--recovery", action="store_true",
                     help="add the cold-restart recovery probe: run the "
                     "control-plane workload with the durable store "
@@ -233,6 +315,8 @@ def main() -> int:
     from grove_tpu.tuning import enable_compilation_cache
 
     enable_compilation_cache()
+    if args.scale_tier:
+        return bench_scale_tier(args)
     if args.diurnal:
         return bench_diurnal(args)
     if args.service:
@@ -532,31 +616,64 @@ def main() -> int:
     # Scale-ceiling probes (VERDICT r3 #8 + r4 #9): datapoints at 2x and
     # 4x the north star proving the bucketing/padding strategy and memory
     # hold past the stress config (and mapping where the curve bends).
+    # Each probe is an INTERLEAVED hierarchical-vs-flat A/B with
+    # min/median/max over repeats — this host's throttling swings walls
+    # ~2x run-to-run, so single uninterleaved numbers mislead (the flat
+    # fields keep their historical names for trajectory continuity).
     probe = {}
     if not args.small and args.nodes >= 5000:
         for factor in (2, 4):
             p_snapshot = make_cluster(args.nodes * factor)
             p_gangs = make_gangs(args.gangs * factor)
-            # single-device probe; incremental off — repeated identical
-            # solves would degenerate into the zero-dispatch reuse tier
-            p_engine = PlacementEngine(p_snapshot, incremental=False)
-            p_engine.solve(p_gangs)  # warm-up: new shapes compile
-            p_walls = []
-            p_placed = 0
+            # single-device probes; incremental off on BOTH sides (the
+            # knob also disables the hierarchy's domain-reuse memo) —
+            # repeated identical solves would otherwise degenerate into
+            # the zero-dispatch reuse tiers and misreport solve cost
+            p_flat = PlacementEngine(p_snapshot, incremental=False)
+            p_hier = PlacementEngine(
+                p_snapshot, incremental=False, hierarchical=True
+            )
+            p_flat.solve(p_gangs)  # warm-up: new shapes compile
+            p_hier.solve(p_gangs)
+            f_walls, h_walls = [], []
+            p_placed = h_placed = 0
             for _ in range(3):
                 t0 = time.perf_counter()
-                p_placed = p_engine.solve(p_gangs).num_placed
-                p_walls.append(time.perf_counter() - t0)
-            p_walls.sort()
+                h_placed = p_hier.solve(p_gangs).num_placed
+                h_walls.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                p_placed = p_flat.solve(p_gangs).num_placed
+                f_walls.append(time.perf_counter() - t0)
+            f_walls.sort()
+            h_walls.sort()
             probe.update({
                 f"scale{factor}x_nodes": args.nodes * factor,
                 f"scale{factor}x_gangs": args.gangs * factor,
                 f"scale{factor}x_placed": p_placed,
                 f"scale{factor}x_p50_backlog_bind_seconds": round(
-                    p_walls[1], 4
+                    f_walls[1], 4
+                ),
+                f"scale{factor}x_min_backlog_bind_seconds": round(
+                    f_walls[0], 4
+                ),
+                f"scale{factor}x_max_backlog_bind_seconds": round(
+                    f_walls[-1], 4
                 ),
                 f"scale{factor}x_gangs_per_sec": round(
-                    args.gangs * factor / p_walls[1], 1
+                    args.gangs * factor / f_walls[1], 1
+                ),
+                f"scale{factor}x_hier_placed": h_placed,
+                f"scale{factor}x_hier_p50_backlog_bind_seconds": round(
+                    h_walls[1], 4
+                ),
+                f"scale{factor}x_hier_min_backlog_bind_seconds": round(
+                    h_walls[0], 4
+                ),
+                f"scale{factor}x_hier_max_backlog_bind_seconds": round(
+                    h_walls[-1], 4
+                ),
+                f"scale{factor}x_hier_vs_flat_speedup": round(
+                    f_walls[1] / h_walls[1], 2
                 ),
             })
 
@@ -932,6 +1049,155 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
     freshen(1)
     solve_all("inc-rebind-resume", current, free, expect_inc="inc")
 
+    # 7) HIERARCHICAL two-level vs flat — the gate's n-way grows the
+    #    tier that restructures the solve itself. The coarse assignment
+    #    legitimately resolves cross-domain ties differently than the
+    #    flat scan's jitter (a gang may land in a DIFFERENT
+    #    equal-scoring domain), so the pin here is SCORE-equality, not
+    #    bitwise: identical placed set, identical per-gang
+    #    placement_score, identical unplaced reason codes, and identical
+    #    per-resource committed totals. Everything else about the gate
+    #    (carried state, seeded churn, coverage-or-fail) mirrors the
+    #    bitwise tiers above.
+    from grove_tpu.observability.explain import unsat_code
+
+    eng_h = mk_engine(hierarchical=True, state_cache=True,
+                      state_verify=True, fused=True, incremental=True)
+    hier_pruned = 0
+    hier_solves = 0
+
+    def diff_hier(label, res_h, res_f, free_h, free_f) -> None:
+        if sorted(res_h.placed) != sorted(res_f.placed):
+            only_h = sorted(set(res_h.placed) - set(res_f.placed))[:4]
+            only_f = sorted(set(res_f.placed) - set(res_h.placed))[:4]
+            failures.append(
+                f"hier[{label}]: placed sets differ (hier-only {only_h}, "
+                f"flat-only {only_f})"
+            )
+            return
+        for gname, p_h in res_h.placed.items():
+            if p_h.placement_score != res_f.placed[gname].placement_score:
+                failures.append(
+                    f"hier[{label}]: {gname} score "
+                    f"{p_h.placement_score} != flat "
+                    f"{res_f.placed[gname].placement_score}"
+                )
+        for gname, reason_f in res_f.unplaced.items():
+            code_h = unsat_code(res_h.unplaced.get(gname))
+            if code_h != unsat_code(reason_f):
+                failures.append(
+                    f"hier[{label}]: {gname} unplaced code {code_h} != "
+                    f"flat {unsat_code(reason_f)}"
+                )
+        # committed capacity totals: the same gangs bound the same
+        # demand, wherever the ties landed them
+        if not np.allclose(
+            free_h.sum(axis=0), free_f.sum(axis=0), rtol=1e-5, atol=1e-3
+        ):
+            failures.append(
+                f"hier[{label}]: committed per-resource totals diverge"
+            )
+
+    def solve_hier(label, gang_list, free, expect_hier=True):
+        """Solve on the flat reference and the hierarchical candidate
+        (each from the same free content; the reference's post-solve
+        free is the carried canonical state)."""
+        nonlocal hier_pruned, hier_solves, solves
+        solves += 1
+        hier_solves += 1
+        free_f, free_h = free.copy(), free.copy()
+        res_f = eng_f.solve(gang_list, free=free_f)
+        # the carried canonical state is the flat REFERENCE's committed
+        # free — which diverges row-wise from the hier engine's own
+        # commits (same demand, different tie-broken nodes), so its
+        # mutations were never declared to eng_h: unknown scope per the
+        # note_free_rows contract (full content diff, stays correct)
+        eng_h.note_free_rows(None)
+        res_h = eng_h.solve(gang_list, free=free_h)
+        took_hier = bool(res_h.stats.get("hierarchical"))
+        if took_hier != expect_hier:
+            failures.append(
+                f"hier[{label}]: expected "
+                f"{'hierarchical' if expect_hier else 'flat'} path, "
+                f"engine took the other"
+            )
+        hier_pruned += int(res_h.stats.get("hier_pruned_pairs", 0))
+        diff_hier(label, res_h, res_f, free_h, free_f)
+        return free_f
+
+    # 7a) plain backlog with one coarse domain drained near-empty: the
+    #     coarse pass must PRUNE it (aggregate capacity cut) and route
+    #     every gang around it — pruning coverage is asserted below
+    drained = snapshot.free.copy()
+    block_ids = snapshot.domain_ids[0]
+    drained[block_ids == (int(block_ids.max()))] *= 0.01
+    free = solve_hier("drained-domain", gangs, drained)
+
+    # 7b) seeded bind/unbind churn with carried committed state: every
+    #     round moves the free content and re-solves a subset
+    for rnd in range(3):
+        rows = rng.choice(n, size=min(24, n), replace=False)
+        scale = rng.uniform(0.4, 1.1, size=(rows.size, 1)).astype(
+            np.float32
+        )
+        free[rows] = np.minimum(
+            snapshot.capacity[rows], free[rows] * scale
+        ).astype(np.float32)
+        subset = [
+            gangs[i]
+            for i in sorted(rng.choice(
+                len(gangs), size=min(max(8, len(gangs) // 8), len(gangs)),
+                replace=False,
+            ))
+        ]
+        free = solve_hier(f"churn[{rnd}]", subset, free)
+
+    # 7c) structurally unplaceable gangs (per-pod demand no node can
+    #     hold): both paths must report the same CAPACITY verdicts
+    doomed = make_gangs(4)
+    for j, g in enumerate(doomed):
+        g.name = f"doomed{j:02d}"
+        g.demand = g.demand * 0 + np.array([64.0, 16.0, 1.0], np.float32)
+    solve_hier("doomed", list(gangs[:8]) + doomed, snapshot.free.copy())
+
+    # 7d) repeat of an identical solve: the domain-reuse memo must
+    #     replay bitwise-identical outcomes (compared against the flat
+    #     reference exactly like a fresh solve), then a DIRTY TICK on
+    #     unchanged free content — one replaced gang — must ride the
+    #     shard-local incremental re-solve inside its domain
+    solve_hier("domain-reuse", gangs, snapshot.free.copy())
+    solve_hier("domain-reuse[1]", gangs, snapshot.free.copy())
+    dirty_backlog = list(gangs)
+    fresh = make_gangs(1)[0]
+    fresh.name = "hier-dirty-0"
+    dirty_backlog[3] = fresh
+    solve_hier("dirty-tick", dirty_backlog, snapshot.free.copy())
+
+    # 7e) unconfined backlog (a root-level gang): a documented
+    #     forced-flat trigger — the hierarchical engine must take the
+    #     flat path and stay bitwise-compatible there
+    unconfined = make_gangs(8)
+    for g in unconfined:
+        g.required_level = -1
+    solve_hier("unconfined-flat", unconfined, snapshot.free.copy(),
+               expect_hier=False)
+
+    # vacuous-coverage guard (same pattern as the incremental tiers
+    # above): if the coarse level never pruned a single (gang, domain)
+    # pair across the scenario set, the hierarchical gate proved nothing
+    if hier_pruned == 0:
+        failures.append("coverage: the hierarchical coarse level never "
+                        "pruned anything — the gate is vacuous")
+    hier_ds = eng_h.debug_summary()
+    # shard-local incrementality works on the SHARDED engine too (the
+    # domain is the shard unit), so this coverage check has no
+    # single-device gate — unlike the flat incremental tier's above
+    if eng_h._hier_incremental and (
+        hier_ds["device_state"]["dispatches"]["incremental"] == 0
+    ):
+        failures.append("coverage: the hierarchical tier's shard-local "
+                        "incremental re-solve never ran")
+
     # the gate is only meaningful if the incremental tiers actually ran
     inc_ds = candidates["inc"].debug_summary()["device_state"]
     if check_paths and inc_ds["dispatches"]["incremental"] == 0:
@@ -955,11 +1221,190 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         "incremental_dispatches": inc_ds["dispatches"]["incremental"],
         "incremental_rows": inc_ds["incremental_rows"],
         "reuse_hits": inc_ds["reuse_hits"],
+        "hier_solves_compared": hier_solves,
+        "hier_pruned_pairs": hier_pruned,
+        "hier_incremental_dispatches": (
+            hier_ds["device_state"]["dispatches"]["incremental"]
+        ),
         "engine": "sharded" if args.sharded else "single",
         "backend": __import__("jax").default_backend(),
     }
     for f in failures:
         print(f"EQUIVALENCE FAILURE: {f}", file=sys.stderr)
+    print(json.dumps(out))
+    return 1 if failures else 0
+
+
+#: --scale-tier regimes: nodes / gangs. 20k mirrors the scale4x probe's
+#: size on the 4-level topology (flat A/B still feasible); 100k is the
+#: ROADMAP tier whose flat tensor does not fit.
+_TIERS = {"20k": (20_000, 4_000), "100k": (100_000, 20_000)}
+
+#: past roughly this many value-tensor entries (G_pad x D, f32) the flat
+#: engine's device matrices stop fitting CI-class hosts — the flat A/B
+#: side is SKIPPED (loudly) above it rather than OOM-killed
+_FLAT_TENSOR_CEILING = 2.5e8
+
+
+def bench_scale_tier(args) -> int:
+    """The hierarchical scale-tier regime (--scale-tier 20k|100k): a
+    block-confined backlog over the synthetic 4-level topology, solved
+    by the two-level engine with a dirty tick per repeat (a few gangs
+    replaced) so the SHARD-LOCAL incremental tier genuinely runs —
+    clean domains ride the domain-reuse memo / sub-engine reuse, dirty
+    domains re-score O(dirty) rows — and the dispatch-kind counters
+    prove it. Interleaved A/B against the flat engine where its tensor
+    still fits; min/median/max over repeats because this class of host
+    throttles hard run-to-run."""
+    from grove_tpu.observability import MetricsRegistry
+    from grove_tpu.solver.engine import _bucket
+
+    num_nodes, num_gangs = _TIERS[args.scale_tier]
+    if args.small:
+        # CI-friendly miniature of the same shape (still 4-level, still
+        # hierarchical): the regime's mechanics, not its scale
+        num_nodes, num_gangs = 8_192, 1_024
+        print(
+            f"bench --small: clamping --scale-tier {args.scale_tier} to "
+            f"{num_nodes} nodes / {num_gangs} gangs",
+            file=sys.stderr,
+        )
+    snapshot = make_tier_cluster(num_nodes)
+    gangs = make_tier_gangs(num_gangs)
+    registry = MetricsRegistry()
+
+    if args.sharded:
+        from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
+
+        mesh = make_solver_mesh()
+
+        def mk(**kw):
+            return ShardedPlacementEngine(snapshot, mesh, **kw)
+    else:
+        mesh = None
+
+        def mk(**kw):
+            return PlacementEngine(snapshot, **kw)
+
+    hier = mk(hierarchical=True, metrics=registry)
+    # solver microbench: decision-ring recording off (the documented
+    # opt-out) — at 20k gangs/solve the ring's LRU churn is a visible
+    # constant the deployed path amortizes across its cluster-owned log
+    hier.decisions = None
+    DIRTY = 8
+
+    def dirty_tick(backlog, tick):
+        """Replace DIRTY gangs with fresh content UNDER THE SAME sort
+        position (name-adjacent successor): the control plane's churn
+        shape — a rebuilt replica keeps its identity — so a tick
+        dirties its gangs' own domains instead of shifting every
+        gang's position in the sorted order (which would re-chunk the
+        whole coarse assignment and invalidate every domain)."""
+        out = list(backlog)
+        for j in range(DIRTY):
+            pos = (tick * DIRTY + j) % len(out)
+            g = make_tier_gangs(1)[0]
+            g.name = out[pos].name.split(".")[0] + f".{tick}"
+            out[pos] = g
+        return out
+
+    # flat A/B feasibility: G_pad x D value tensor (the [N, D]
+    # membership product behind it is bigger still)
+    num_domains = 1 + int(np.asarray(snapshot.num_domains).sum())
+    flat_entries = _bucket(num_gangs) * num_domains
+    flat_ok = flat_entries <= _FLAT_TENSOR_CEILING
+    # the flat A/B side keeps its incremental tier ON (the deployed
+    # default): every timed repeat is a DIRTY tick, so the flat engine
+    # legitimately re-scores O(dirty) rows too — unlike the scale2x/4x
+    # probes' identical repeats, pinning incremental off here would
+    # compare hier-with-incrementality against a flat config nobody
+    # deploys and overstate the win
+    flat = mk(hierarchical=False) if flat_ok else None
+
+    # warm-up: compile + device-resident state + sub-engine population,
+    # plus one untimed dirty tick so the incremental program's shapes
+    # compile OUTSIDE the timed window (every bench here excludes
+    # compile; the first-ever dirty tick would otherwise carry it)
+    backlog = list(gangs)
+    hier.solve(backlog, free=snapshot.free.copy())
+    backlog = dirty_tick(backlog, -1)
+    hier.solve(backlog, free=snapshot.free.copy())
+    if flat is not None:
+        flat.solve(backlog, free=snapshot.free.copy())
+
+    h_walls, f_walls = [], []
+    placed = 0
+    for rep in range(max(args.tier_repeats, 3)):
+        backlog = dirty_tick(backlog, rep)
+        # interleaved A/B: host throttling noise lands on both sides
+        t0 = time.perf_counter()
+        placed = hier.solve(backlog, free=snapshot.free.copy()).num_placed
+        h_walls.append(time.perf_counter() - t0)
+        if flat is not None:
+            t0 = time.perf_counter()
+            flat.solve(backlog, free=snapshot.free.copy())
+            f_walls.append(time.perf_counter() - t0)
+    h_walls.sort()
+    ds = hier.debug_summary()
+    disp = ds["device_state"]["dispatches"]
+    hier_block = ds["hierarchical"]
+    failures = []
+    if disp.get("incremental", 0) == 0:
+        failures.append(
+            "coverage: the shard-local incremental tier never ran — the "
+            "dirty ticks should have re-scored O(dirty) rows per "
+            "affected domain"
+        )
+    if hier_block["last_pruned_pairs"] == 0 and hier_block["shards_built"] <= 1:
+        failures.append(
+            "coverage: the coarse level neither pruned nor partitioned "
+            "anything — the tier ran effectively flat"
+        )
+    p50 = h_walls[len(h_walls) // 2]
+    out = {
+        "metric": f"hierarchical scale tier ({num_gangs} x 8-pod gangs, "
+        f"{num_nodes} nodes, 4-level topology)",
+        "value": round(num_gangs / p50, 1),
+        "unit": "gangs/sec",
+        "vs_baseline": round(
+            (sorted(f_walls)[len(f_walls) // 2] / p50), 2
+        ) if f_walls else 0.0,
+        "tier": args.scale_tier,
+        "placed": placed,
+        "tier_p50_backlog_bind_seconds": round(p50, 4),
+        "tier_min_backlog_bind_seconds": round(h_walls[0], 4),
+        "tier_max_backlog_bind_seconds": round(h_walls[-1], 4),
+        "tier_sub_second_p50": p50 < 1.0,
+        "tier_repeats": len(h_walls),
+        "tier_dirty_gangs_per_tick": DIRTY,
+        "dispatches_by_kind": dict(disp),
+        "incremental_rows": ds["device_state"]["incremental_rows"],
+        "reuse_hits": ds["device_state"]["reuse_hits"],
+        "hier_prune_level": hier_block["prune_level"],
+        "hier_coarse_domains": hier_block["coarse_domains"],
+        "hier_shards_built": hier_block["shards_built"],
+        "hier_last_pruned_pairs": hier_block["last_pruned_pairs"],
+        "flat_ab": (
+            {
+                "flat_p50_seconds": round(
+                    sorted(f_walls)[len(f_walls) // 2], 4
+                ),
+                "flat_min_seconds": round(min(f_walls), 4),
+                "flat_max_seconds": round(max(f_walls), 4),
+                "interleaved": True,
+            }
+            if f_walls
+            else f"skipped: flat [G_pad x D] = {flat_entries:.2e} "
+            "value-tensor entries exceeds the materializable ceiling "
+            f"({_FLAT_TENSOR_CEILING:.0e}) — the wall the hierarchy "
+            "removes"
+        ),
+        "engine": "sharded" if args.sharded else "single",
+        **({"mesh": dict(mesh.shape)} if mesh is not None else {}),
+        "backend": __import__("jax").default_backend(),
+    }
+    for f in failures:
+        print(f"SCALE-TIER FAILURE: {f}", file=sys.stderr)
     print(json.dumps(out))
     return 1 if failures else 0
 

@@ -152,6 +152,31 @@ class SolverConfig:
     # pre-pass is skipped wholesale (every gang takes the general solve),
     # kept for the diurnal bench's reuse-on/off A/B.
     reservation_reuse: bool = True
+    # Hierarchical two-level solve (solver/hierarchy.py): a coarse
+    # domain-level pass over prune-level domains as super-nodes
+    # (aggregated free capacity, admissible by construction — aggregation
+    # may only over-admit, never cut a domain the flat solve would place
+    # into) assigns each gang its surviving domains, then exact
+    # node-level solves run only inside survivors through per-domain
+    # sub-engines — which keep their own device-resident state and
+    # incremental caches, so incrementality is SHARD-LOCAL and composes
+    # with the mesh-sharded engine. Falls back to the flat solve when the
+    # backlog is not confined (any gang's required pack level is broader
+    # than the prune level), the cluster is below
+    # hierarchical_min_nodes, or the prune level has fewer than two
+    # domains (docs/scheduling.md "Hierarchical solve").
+    hierarchical_solve: bool = True
+    # Prune level of the coarse pass: a topology level INDEX
+    # (broadest = 0). None = auto — the broadest level every backlog
+    # gang is confined to (the minimum required pack level across the
+    # backlog). A configured level narrower than some gang's required
+    # level is clamped down to keep confinement sound.
+    hierarchical_prune_level: int | None = None
+    # Forced-flat threshold: below this many nodes the flat cost tensor
+    # is small enough that the two-level restructure only adds overhead
+    # — the solve runs flat. 0 forces the hierarchy on any cluster
+    # (tests, chaos smokes).
+    hierarchical_min_nodes: int = 4096
 
 
 #: built-in priority-tier ladder seeded as PriorityClass objects when
@@ -559,6 +584,20 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append("config.solver.incremental_resolve: must be a bool")
     if not isinstance(sv.reservation_reuse, bool):
         errs.append("config.solver.reservation_reuse: must be a bool")
+    if not isinstance(sv.hierarchical_solve, bool):
+        errs.append("config.solver.hierarchical_solve: must be a bool")
+    if sv.hierarchical_prune_level is not None and (
+        not _int(sv.hierarchical_prune_level)
+        or sv.hierarchical_prune_level < 0
+    ):
+        errs.append(
+            "config.solver.hierarchical_prune_level: must be None (auto) "
+            "or a topology level index >= 0"
+        )
+    if not _int(sv.hierarchical_min_nodes) or sv.hierarchical_min_nodes < 0:
+        errs.append(
+            "config.solver.hierarchical_min_nodes: must be an int >= 0"
+        )
 
     errs += _validate_tenancy(cfg.tenancy)
 

@@ -106,6 +106,22 @@ class GangScheduler:
             self._engine_kwargs["incremental"] = (
                 cfg.solver.incremental_resolve
             )
+        # hierarchical two-level solve (solver/hierarchy.py), same
+        # capability gating: the engine itself decides per backlog
+        # whether the hierarchy applies (forced-flat triggers) — the
+        # scheduler only threads the config knobs through
+        if accepts_kwarg(engine_cls, "hierarchical"):
+            self._engine_kwargs["hierarchical"] = (
+                cfg.solver.hierarchical_solve
+            )
+        if accepts_kwarg(engine_cls, "hier_prune_level"):
+            self._engine_kwargs["hier_prune_level"] = (
+                cfg.solver.hierarchical_prune_level
+            )
+        if accepts_kwarg(engine_cls, "hier_min_nodes"):
+            self._engine_kwargs["hier_min_nodes"] = (
+                cfg.solver.hierarchical_min_nodes
+            )
         if accepts_kwarg(engine_cls, "decision_log"):
             # the CLUSTER-owned decision ring (observability/explain.py):
             # injected so placement explanations survive engine rebuilds
@@ -735,6 +751,17 @@ class GangScheduler:
             )
         elif result.stats.get("reused"):
             solve_sp.set(reused=True)
+        # hierarchical visibility: the pruning level the two-level solve
+        # partitioned at plus how much of the (gang, domain) space the
+        # coarse pass eliminated before any exact work ran
+        if result.stats.get("hierarchical"):
+            solve_sp.set(
+                hierarchical=True,
+                hier_level=int(result.stats.get("hier_level", -1)),
+                hier_pruned_pairs=int(
+                    result.stats.get("hier_pruned_pairs", 0)
+                ),
+            )
         self.log.debug(
             "backlog solved", gangs=len(backlog),
             placed=result.num_placed, unplaced=len(result.unplaced),

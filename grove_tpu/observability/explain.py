@@ -159,7 +159,12 @@ def unsat_preemptible(reason) -> bool:
 def _gang_signatures(gang) -> list[tuple[np.ndarray, Optional[np.ndarray]]]:
     """(max-pod demand, eligibility mask) pairs, one per distinct mask
     class in the gang — the same node-granularity proxy the device score
-    uses (engine._gang_signatures), host-side and per-gang."""
+    uses (engine._gang_signatures), host-side and per-gang. Delegates to
+    SolverGang.elig_signatures (the canonical, cached implementation);
+    the inline fallback keeps duck-typed test gangs working."""
+    sig_fn = getattr(gang, "elig_signatures", None)
+    if sig_fn is not None:
+        return sig_fn()
     if gang.pod_elig is None:
         return [(gang.max_pod_demand(), None)]
     by_mask: dict[int, tuple[np.ndarray, Optional[np.ndarray]]] = {}
@@ -173,6 +178,42 @@ def _gang_signatures(gang) -> list[tuple[np.ndarray, Optional[np.ndarray]]]:
             mask,
         )
     return list(by_mask.values())
+
+
+def domain_level_aggregates(
+    ids: np.ndarray, nd: int, sched: np.ndarray, fm: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Gang-independent per-domain aggregates of one topology level:
+    (sched_cnt [nd], dom_free [nd, R]) from the masked free matrix `fm`
+    and the schedulable mask. The ONE aggregation both consumers of the
+    elimination structure run — the unsat-diagnosis funnel below and the
+    hierarchical pruner (solver/hierarchy.py) — so a domain can never be
+    'cut' by one and 'aggregate-feasible' by the other."""
+    sched_cnt = np.bincount(ids, weights=sched, minlength=nd)
+    # per-resource bincount instead of one np.add.at: same in-order
+    # float64 accumulation, several times faster at 100k nodes (R is
+    # tiny and static)
+    dom_free = np.empty((nd, fm.shape[1]), dtype=np.float64)
+    for r in range(fm.shape[1]):
+        dom_free[:, r] = np.bincount(
+            ids, weights=fm[:, r], minlength=nd
+        )
+    return sched_cnt, dom_free
+
+
+def classify_domain_cuts(
+    td: np.ndarray, dom_free: np.ndarray, sched_cnt: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The shared cordon/aggregate-capacity cut predicate over one
+    level's aggregates: (cordoned, agg_cut, remaining) boolean arrays.
+    Broadcasts — `td` may be one gang's [R] demand (the funnel) or a
+    whole backlog's [G, 1, R] against dom_free [nd, R] (the pruner), so
+    diagnosis and pruning literally evaluate the same expression."""
+    agg_ok = (dom_free + _EPS >= td).all(axis=-1)
+    cordoned = sched_cnt == 0
+    agg_cut = ~cordoned & ~agg_ok
+    remaining = ~cordoned & agg_ok
+    return cordoned, agg_cut, remaining
 
 
 def _domain_name(snapshot, level: int, local_id: int) -> str:
@@ -236,10 +277,7 @@ def diagnose_unplaced(gang, snapshot, free: np.ndarray) -> UnsatDiagnosis:
             # the hierarchy constraint cuts every domain here
             cut["topology"] += nd
             continue
-        sched_cnt = np.bincount(ids, weights=sched, minlength=nd)
-        dom_free = np.zeros((nd, fm.shape[1]), dtype=np.float64)
-        np.add.at(dom_free, ids, fm)
-        agg_ok = (dom_free + _EPS >= td).all(axis=1)
+        sched_cnt, dom_free = domain_level_aggregates(ids, nd, sched, fm)
         shape_fail = np.zeros(nd, dtype=bool)   # some pod fits NO node
         elig_fail = np.zeros(nd, dtype=bool)    # mask was the difference
         sig_raw: list[np.ndarray] = []          # per-sig unmasked fits [nd]
@@ -255,9 +293,9 @@ def diagnose_unplaced(gang, snapshot, free: np.ndarray) -> UnsatDiagnosis:
                 )
                 shape_fail |= ~raw
                 elig_fail |= raw & ~masked
-        cordoned = sched_cnt == 0
-        agg_cut = ~cordoned & ~agg_ok
-        rem = ~cordoned & agg_ok
+        cordoned, agg_cut, rem = classify_domain_cuts(
+            td, dom_free, sched_cnt
+        )
         shape_cut = rem & shape_fail
         elig_cut = rem & ~shape_fail & elig_fail
         ok = rem & ~shape_fail & ~elig_fail

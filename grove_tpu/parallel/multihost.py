@@ -17,6 +17,18 @@ What this module adds is the standard bring-up: `initialize_multihost`
 wraps `jax.distributed.initialize` with environment-variable fallbacks so
 the same binary works single-host (no-op) and multi-host (launcher sets
 the coordinator env), mirroring how JAX programs bring up TPU pod slices.
+
+The HIERARCHICAL path (solver/hierarchy.py) keeps the same contract by a
+different route: the coarse domain-level pass is pure host numpy (every
+process computes it identically), and each surviving domain's fine solve
+runs WHOLE on one of the process's own addressable devices
+(`ShardedPlacementEngine._sub_device`, round-robin by domain id) — no
+collective ever crosses a domain, so every process still reaches
+bitwise-identical placements with zero coordination, now with the
+per-domain incremental caches that the flat mesh path cannot keep. The
+driver dry-run's domain-sharded tier (`__graft_entry__.py`,
+MULTICHIP_r06 — see docs/scheduling.md) exercises exactly this shape at
+4096 nodes / 1024 gangs on the 8-device virtual mesh.
 """
 
 from __future__ import annotations

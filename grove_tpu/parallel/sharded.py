@@ -169,11 +169,18 @@ class ShardedPlacementEngine(PlacementEngine):
     def __init__(self, snapshot: TopologySnapshot, mesh: Mesh, top_k: int = 8,
                  **kwargs):
         super().__init__(snapshot, top_k=top_k, **kwargs)
-        #: the incremental dirty-row re-solve is single-device only: its
-        #: value-cache permutation is a gather across the GANGS axis,
-        #: which on a mesh is a cross-shard collective — not worth the
-        #: ICI traffic for a [G, D] matrix the mesh recomputes in one
-        #: pass. Sharded solves always run the full fused program.
+        #: the incremental dirty-row re-solve is single-device only ON
+        #: THE FLAT PATH: its value-cache permutation is a gather across
+        #: the GANGS axis, which on a mesh is a cross-shard collective —
+        #: not worth the ICI traffic for a [G, D] matrix the mesh
+        #: recomputes in one pass. Flat sharded solves always run the
+        #: full fused program. The HIERARCHICAL path shards by DOMAIN
+        #: instead of by row (each coarse domain's sub-engine lives
+        #: whole on one mesh device, round-robin — see _sub_device), so
+        #: its IncrementalCaches are shard-local and the incremental
+        #: tier stays ON there: fused + incremental + sharded hold at
+        #: once (self._hier_incremental, captured by the base __init__
+        #: before this override, is what sub-engines inherit).
         self.incremental = False
         self.mesh = mesh
         self._fn = sharded_score_fn(
@@ -189,6 +196,19 @@ class ShardedPlacementEngine(PlacementEngine):
         #: jax.device_put, whose host-value equality check is a
         #: collective the multi-process CPU backend cannot run.
         self._free_sharding = NamedSharding(mesh, P("nodes", None))
+
+    def _sub_device(self, dom: int):
+        """Domain-sharded hierarchy: coarse domain `dom`'s sub-engine is
+        pinned to one of THIS PROCESS's mesh devices, round-robin by
+        domain id. Each domain's fine problem (device state, fused
+        launches, incremental caches) lives whole on its device — the
+        domain IS the shard unit, so no fine-solve collective ever
+        crosses devices. Local (addressable) devices only: in a
+        multi-process mesh every process runs the identical host-side
+        coarse pass and fine solves on its own devices, preserving the
+        replicated-results multihost contract with zero coordination."""
+        local = self.mesh.local_devices
+        return local[dom % len(local)]
 
     def _pad_nodes(self, arr: np.ndarray, axis: int, mult: int) -> np.ndarray:
         n = arr.shape[axis]

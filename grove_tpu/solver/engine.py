@@ -79,9 +79,15 @@ from ..observability.explain import DecisionLog, diagnose_unplaced
 from ..observability.tracing import NOOP_TRACER
 from ..topology.encoding import TopologySnapshot
 from .fit import place_gang_in_domain, placement_score_for_nodes
+from .hierarchy import HierarchyState, coarse_admissible, coarse_assign
 from .problem import SolverGang
 from .result import GangPlacement, SolveResult
 from .serial import _place_one, gang_sort_key, stamp_fairness
+
+#: hierarchical solve: hard ceiling on the coarse pass's domain count —
+#: the [G, nd] admissibility/assignment matrices must stay small (that
+#: is the whole point); the prune level walks broader until under it
+_MAX_COARSE_DOMAINS = 4096
 
 _NEG = -1e9
 
@@ -617,10 +623,10 @@ class SolveDispatch:
     scores there)."""
 
     __slots__ = ("engine", "order", "free0", "token", "encode_seconds",
-                 "state_epoch", "path", "rows")
+                 "state_epoch", "path", "rows", "level")
 
     def __init__(self, engine, order, free0, token, encode_seconds,
-                 state_epoch=0, path=None, rows=0):
+                 state_epoch=0, path=None, rows=0, level=None):
         self.engine = engine
         self.order = order
         self.free0 = free0
@@ -628,10 +634,16 @@ class SolveDispatch:
         self.encode_seconds = encode_seconds
         self.state_epoch = state_epoch
         #: which device path produced the token (fused | split |
-        #: incremental | reused) + dirty rows re-scored — copied into the
-        #: consuming solve's stats so adoption keeps the path visible
+        #: incremental | reused | hierarchical) + dirty rows re-scored —
+        #: copied into the consuming solve's stats so adoption keeps the
+        #: path visible
         self.path = path
         self.rows = rows
+        #: hierarchical dispatches only: the coarse PRUNING LEVEL the
+        #: precomputed solve partitioned at (None on flat paths) — the
+        #: scheduler's solve span and debug surfaces read it off the
+        #: handle so the tier stays visible through adoption
+        self.level = level
 
     def cancel(self) -> None:
         """No-op (uniform handle API with the service client's
@@ -656,6 +668,10 @@ class PlacementEngine:
         decision_log=None,
         fused: bool = True,
         incremental: bool = True,
+        hierarchical: bool = False,
+        hier_prune_level: int | None = None,
+        hier_min_nodes: int = 0,
+        device=None,
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
@@ -752,6 +768,30 @@ class PlacementEngine:
         self._dispatches = {"fused": 0, "split": 0, "incremental": 0}
         self._inc_rows_total = 0
         self._inc_reuse_hits = 0
+        #: hierarchical two-level solve (solver/hierarchy.py): a coarse
+        #: domain-level pass prunes + assigns, exact solves run only
+        #: inside surviving domains through persistent per-domain
+        #: sub-engines (shard-local incrementality). Off, or any
+        #: forced-flat trigger (unconfined gang, cluster below
+        #: hier_min_nodes, < 2 coarse domains) = the flat path above.
+        self.hierarchical = hierarchical
+        self.hier_prune_level = hier_prune_level
+        self.hier_min_nodes = hier_min_nodes
+        #: what sub-engines inherit for their own incremental tier: the
+        #: NORMALIZED request, captured before ShardedPlacementEngine
+        #: forces its own (flat-path) incremental off — sub-engines are
+        #: single-device, so the mesh restriction does not apply to them
+        self._hier_incremental = self.incremental
+        self._hier: HierarchyState | None = None
+        #: rows the last _sync_free observed changed (None = full
+        #: upload / unknown scope) — fanned out to the hierarchy's
+        #: domain shards so unchanged domains stay O(1)
+        self._sync_changed: np.ndarray | None = None
+        #: optional committed placement device for every array this
+        #: engine ships (jax.device_put target). The domain-sharded
+        #: mesh engine round-robins its sub-engines across devices this
+        #: way; None = the backend default, the pre-hierarchy behavior.
+        self._device = device
 
     # -- device-resident cluster state ---------------------------------------
     def note_free_rows(self, rows) -> None:
@@ -784,6 +824,10 @@ class PlacementEngine:
         self._hints = False
         self._staged = None
         self._inc = None
+        # the hierarchy's shards (sub-engines, their device state and
+        # incremental caches, the domain-reuse memos) are rebuilt lazily
+        # — an invalidate means "trust nothing resident"
+        self._hier = None
 
     def rebind(self, snapshot: TopologySnapshot) -> bool:
         """Adopt a freshly-encoded snapshot WITHOUT rebuilding the engine
@@ -822,6 +866,11 @@ class PlacementEngine:
         self._inc = None
         if changed.size:
             self.note_free_rows(changed.tolist())
+        if self._hier is not None:
+            # shards re-slice their schedulable bits and rebind their
+            # sub-engines (the flips ride each shard's delta path);
+            # domain-reuse memos drop — the usable node set moved
+            self._hier.rebind(snapshot)
         return True
 
     def _masked_free(self, free: np.ndarray) -> np.ndarray:
@@ -830,15 +879,25 @@ class PlacementEngine:
             dtype=np.float32,
         )
 
+    def _to_device(self, arr):
+        """Commit a host array to this engine's device (None = backend
+        default). The committed-placement form keeps every jit launch of
+        a domain-sharded sub-engine on ITS device instead of the
+        default one."""
+        if self._device is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._device)
+
     def _state_put(self, masked: np.ndarray):
         """Full H2D upload of the masked free matrix (override point: the
         sharded engine pads and shards it across the mesh)."""
-        return jnp.asarray(masked)
+        return self._to_device(masked)
 
     def _state_delta(self, dev, upd: np.ndarray):
         """Jitted scatter-update of `upd` rows into the resident state;
         the stale buffer is donated off-CPU so the update aliases in
         place instead of allocating a second [N, R] copy."""
+        upd = self._to_device(upd)
         if jax.default_backend() == "cpu":
             return _scatter_rows(dev, upd)
         return _scatter_rows_donated(dev, upd)
@@ -855,6 +914,8 @@ class PlacementEngine:
         st.mirror = None if not self.state_cache else masked
         st.epoch += 1
         st.full_uploads += 1
+        #: full upload = unknown row scope for the hierarchy fan-out
+        self._sync_changed = None
         #: any staged (not yet dispatched) delta rows are content the
         #: full matrix already carries — shipping them again would
         #: scatter stale values over the fresh upload
@@ -901,10 +962,17 @@ class PlacementEngine:
             masked = self._masked_free(free)
             changed = np.flatnonzero((st.mirror != masked).any(axis=1))
             new_rows = masked[changed]
+        # record the observed changed rows for the hierarchical path's
+        # shard fan-out (every later branch below ships exactly these)
+        self._sync_changed = changed
         if changed.size == 0:
             st.hits += 1
         elif changed.size > self._delta_rows_max:
             self._upload_full(free, masked)
+            # the bulk path still OBSERVED exactly these rows — keep the
+            # precise scope for the hierarchy fan-out (the None stamped
+            # by _upload_full means "never diffed", which this was not)
+            self._sync_changed = changed
         elif defer and self.fused:
             with self.tracer.span(
                 "engine.delta_apply", kind="delta", staged=True,
@@ -1107,6 +1175,9 @@ class PlacementEngine:
         if not solvable:
             return None
         order = sorted(solvable, key=gang_sort_key)
+        hier_level = self._hier_plan(order)
+        if hier_level is not None:
+            return self._hier_dispatch(order, free, hier_level, t0)
         # the encode of an overlapped solve happens HERE (under the
         # scheduler.pre_round span when the scheduler drives it); the
         # consuming solve only emits the device/repair side. Fused
@@ -1171,6 +1242,370 @@ class PlacementEngine:
             )
         return fresh
 
+    # -- hierarchical two-level solve (solver/hierarchy.py) ------------------
+    def _hier_plan(self, order: list[SolverGang]) -> int | None:
+        """The prune level this backlog solves hierarchically at, or
+        None for the flat path. Forced-flat triggers (all documented in
+        docs/scheduling.md): the knob is off; the cluster is below
+        hier_min_nodes (the flat tensor is cheap there); the topology
+        has no levels; any gang is UNCONFINED (required pack level
+        broader than every prunable level — it may legally span coarse
+        domains, and a partitioned solve could not contend it
+        correctly); or the chosen level has fewer than two domains
+        (nothing to prune or partition). The decision is a pure
+        function of (order, engine config, static snapshot), so a
+        dispatch and its consuming solve always agree."""
+        if not self.hierarchical or not order:
+            return None
+        snap = self.snapshot
+        if snap.num_nodes < self.hier_min_nodes or snap.num_levels == 0:
+            return None
+        req_min = min(g.required_level for g in order)
+        if req_min < 0:
+            return None
+        level = req_min
+        if self.hier_prune_level is not None:
+            level = min(self.hier_prune_level, req_min)
+        # the coarse pass materializes [G, nd]: walk broader while the
+        # level is too fine-grained for that to stay small
+        while level > 0 and int(snap.num_domains[level]) > _MAX_COARSE_DOMAINS:
+            level -= 1
+        if int(snap.num_domains[level]) < 2:
+            return None
+        return level
+
+    def _sub_device(self, dom: int):
+        """Device a domain shard's sub-engine commits its arrays to
+        (override point: the mesh engine round-robins its devices)."""
+        return self._device
+
+    def _make_sub_engine(self, shard):
+        eng = PlacementEngine(
+            shard.snapshot,
+            top_k=self.top_k,
+            native_repair=self.native_repair,
+            commit_chunk=self.commit_chunk,
+            bucket_min=self.bucket_min,
+            state_cache=self.state_cache,
+            state_verify=self.state_verify,
+            fused=self.fused,
+            incremental=self._hier_incremental,
+            device=self._sub_device(shard.dom),
+        )
+        # the parent records placements/diagnoses at ITS level; letting
+        # every sub-engine ring-record too would double-count each gang
+        eng.decisions = None
+        return eng
+
+    def _solve_domain(self, hs, dom: int, members, free: np.ndarray,
+                      sub_stats: dict):
+        """Exact fine solve of one coarse domain's assigned gangs.
+        Returns ({name: global GangPlacement}, [failed (i, gang)]).
+        Tier 0 is the DOMAIN-REUSE memo: an identical gang set (by
+        object identity + fairness stamp) against bitwise-identical
+        free rows replays the previous placements and post-solve rows
+        in O(rows) — the hierarchy analog of the sub-engine's own
+        zero-dispatch reuse, one level up."""
+        shard = hs.shard(dom)
+        idx = shard.idx
+        sub_free = np.ascontiguousarray(free[idx])
+        gangs = [g for _i, g in members]
+        sig = (
+            tuple(id(g) for g in gangs),
+            tuple(g.fairness for g in gangs),
+        )
+        if (
+            # the memo is an incrementality tier: configured off
+            # (solver.incremental_resolve), every repeat pays the full
+            # fine solve — A/B benches and repeat probes stay honest
+            self._hier_incremental
+            and shard.last_placed is not None
+            and shard.last_sig == sig
+            and shard.last_pre is not None
+            and shard.last_pre.shape == sub_free.shape
+            and np.array_equal(shard.last_pre, sub_free)
+        ):
+            free[idx] = shard.last_post
+            sub_stats["hier_domain_reuse"] += 1
+            return {p.gang.name: p for p in shard.last_placed}, []
+        if shard.engine is None:
+            shard.engine = self._make_sub_engine(shard)
+        pend, shard.pending_rows = shard.pending_rows, set()
+        # the parent sync's custody chain scopes the sub diff: consumed
+        # pending rows (possibly empty = nothing external changed; the
+        # sub-engine's own commits were self-declared after its last
+        # repair), or None = unknown scope -> sub full diff
+        shard.engine.note_free_rows(
+            None if pend is None else sorted(pend)
+        )
+        pre = sub_free.copy()
+        proxies = [shard.proxy(g, hs.level) for g in gangs]
+        res = shard.engine.solve(proxies, free=sub_free)
+        free[idx] = sub_free
+        placed_here: dict[str, GangPlacement] = {}
+        failed = []
+        for i, g in members:
+            subp = res.placed.get(g.name)
+            if subp is None:
+                failed.append((i, g))
+                continue
+            gidx = idx[subp.node_indices]
+            placed_here[g.name] = GangPlacement(
+                gang=g,
+                pod_to_node=subp.pod_to_node,  # node names are global
+                node_indices=gidx,
+                placement_score=placement_score_for_nodes(
+                    self.snapshot, gidx
+                ),
+            )
+        shard.last_sig = sig
+        shard.last_pre = pre
+        shard.last_post = sub_free.copy()
+        # the memo only replays COMPLETE outcomes: a failed gang would
+        # re-enter the alternate walk, which a replay cannot reproduce
+        shard.last_placed = (
+            list(placed_here.values()) if not failed else None
+        )
+        # mirror the sub-engine's launch accounting into the parent's
+        # counters/metrics: the per-kind dispatch story must show the
+        # shard-local incremental tier running (the 100k bench gate)
+        sub_stats["hier_fine_solves"] += 1
+        disp = shard.engine._dispatches
+        for kind, total in disp.items():
+            for _ in range(total - shard.disp_seen[kind]):
+                self._count_dispatch_kind(kind)
+            shard.disp_seen[kind] = total
+        rows_total = shard.engine._inc_rows_total
+        if rows_total > shard.inc_rows_seen:
+            self._count_inc_rows(rows_total - shard.inc_rows_seen)
+            shard.inc_rows_seen = rows_total
+        hits = shard.engine._inc_reuse_hits
+        if hits > shard.reuse_seen:
+            self._inc_reuse_hits += hits - shard.reuse_seen
+            sub_stats["hier_sub_reused"] += hits - shard.reuse_seen
+            shard.reuse_seen = hits
+        if res.stats.get("incremental"):
+            sub_stats["hier_sub_incremental"] += 1
+            sub_stats["incremental_rows"] += res.stats.get(
+                "incremental_rows", 0.0
+            )
+        sub_stats["hier_repair_fallbacks"] += res.stats.get(
+            "fallbacks", 0.0
+        )
+        return placed_here, failed
+
+    def _hier_run(self, order: list[SolverGang], free: np.ndarray,
+                  result: SolveResult, level: int):
+        """The two-level solve body (no dispatch adoption, no metrics —
+        solve() and dispatch() both drive it): coarse admissibility +
+        assignment over aggregates, fine exact solves per surviving
+        domain, alternate walk for fine failures, serial full-scan
+        exactness net. Mutates `free` exactly like the flat repair.
+        Returns (placed_map, fallbacks)."""
+        hs = self._hier
+        if (
+            hs is None
+            or hs.snapshot is not self.snapshot
+            or hs.level != level
+        ):
+            hs = self._hier = HierarchyState(self.snapshot, level)
+        else:
+            hs.push_rows(self._sync_changed if self.state_cache else None)
+        t_c = time.perf_counter()
+        fm = self._masked_free(free)
+        admissible, dom_free, cstats, cls_ids = coarse_admissible(
+            order, self.snapshot, fm, level
+        )
+        choices = coarse_assign(
+            order, admissible, dom_free, self._cap_scale,
+            top_kc=min(4, hs.nd), class_ids=cls_ids,
+        )
+        hs.last_pruned = cstats["pruned"]
+        hs.last_admissible = cstats["admissible"]
+        result.stats["hier_coarse_seconds"] = time.perf_counter() - t_c
+        sub_stats = {
+            "hier_fine_solves": 0, "hier_domain_reuse": 0,
+            "hier_sub_incremental": 0, "hier_sub_reused": 0,
+            "incremental_rows": 0.0, "hier_repair_fallbacks": 0.0,
+        }
+        placed_map: dict[str, GangPlacement] = {}
+        pending = list(enumerate(order))
+        tried: dict[int, set] = {i: set() for i, _g in pending}
+        round_choices = dict(enumerate(choices))
+        for rnd in range(3):
+            if not pending:
+                break
+            if rnd > 0:
+                # RE-AGGREGATE for the still-failing gangs: their
+                # assign-time alternates were ranked against residuals
+                # that the committed rounds have since moved (every
+                # fine failure means the tried domain was tighter than
+                # its aggregate claimed), so re-rank against the LIVE
+                # residual free — the same live-state retry discipline
+                # the flat repair gets from its serial net — excluding
+                # the domains each gang already failed in.
+                sub = [g for _i, g in pending]
+                adm_r, dom_free_r, _, _cls = coarse_admissible(
+                    sub, self.snapshot, self._masked_free(free), level
+                )
+                for row, (i, _g) in enumerate(pending):
+                    if tried[i]:
+                        adm_r[row, sorted(tried[i])] = False
+                # class_ids deliberately NOT passed: the per-gang tried
+                # masks just edited the admissible rows, breaking the
+                # class -> row equivalence (coarse_assign recomputes)
+                ch = coarse_assign(
+                    sub, adm_r, dom_free_r, self._cap_scale,
+                    top_kc=min(4, hs.nd),
+                )
+                round_choices = {
+                    pending[row][0]: ch[row] for row in range(len(pending))
+                }
+            attempt = 0
+            while pending:
+                groups: dict[int, list] = {}
+                leftover = []
+                for i, g in pending:
+                    alts = round_choices.get(i) or []
+                    if attempt < len(alts):
+                        groups.setdefault(alts[attempt], []).append(
+                            (i, g)
+                        )
+                    else:
+                        leftover.append((i, g))
+                if not groups:
+                    pending = leftover
+                    break
+                failures = []
+                for dom in sorted(groups):
+                    placed_here, failed = self._solve_domain(
+                        hs, dom, groups[dom], free, sub_stats
+                    )
+                    for i, _g in groups[dom]:
+                        tried[i].add(dom)
+                    placed_map.update(placed_here)
+                    failures.extend(failed)
+                pending = sorted(leftover + failures)
+                attempt += 1
+        # exactness net: gangs inadmissible everywhere or failed in all
+        # surviving domains take the flat repair's serial scan, so
+        # hard-feasibility semantics stay identical to the flat path
+        # (an over-conservative coarse cut costs speed, never a gang).
+        # The scan is RESTRICTED to the gang's admissible domains'
+        # nodes when any exist — sound because free only decreases
+        # during a solve, so placeable-now domains are a subset of the
+        # solve-start admissible set; a gang admissible NOWHERE scans
+        # the full cluster, exactly like the flat fallback (the
+        # diagnosis that follows must match flat's).
+        fallbacks = 0
+        for i, gang in pending:
+            fallbacks += 1
+            net_nodes = self._sched_nodes
+            adm_row = admissible[i]
+            if adm_row.any():
+                net_nodes = net_nodes[
+                    adm_row[hs.dom_of[net_nodes]]
+                ]
+            placed = _place_one(gang, self.snapshot, free, net_nodes)
+            if placed is None and net_nodes is not self._sched_nodes:
+                # restricted scan failed: pay the full-cluster scan once
+                # so the net's semantics stay exactly the flat path's
+                placed = _place_one(gang, self.snapshot, free,
+                                    self._sched_nodes)
+            if placed is not None:
+                placed_map[gang.name] = placed
+        result.stats.update(sub_stats)
+        result.stats["hierarchical"] = 1.0
+        result.stats["hier_level"] = float(level)
+        result.stats["hier_domains"] = float(hs.nd)
+        result.stats["hier_pruned_pairs"] = float(hs.last_pruned)
+        if sub_stats["hier_sub_incremental"]:
+            result.stats["incremental"] = 1.0
+        return placed_map, fallbacks
+
+    def _hier_dispatch(self, order, free, level, t0):
+        """Hierarchical pre_round dispatch: the two-level solve is
+        mostly host work with many small sub-launches, so 'overlap' here
+        means PRECOMPUTE — the whole solve runs now against a copy of
+        `free`, and the handle carries the placements plus the free-row
+        delta. Adoption (same order identity, same free content by the
+        epoch/content guard) replays the delta in O(changed rows); any
+        staleness falls back to a fresh solve, exactly like the flat
+        dispatch contract."""
+        with self.tracer.span(
+            "engine.hierarchical", gangs=len(order), level=level,
+            dispatch=True,
+        ) as hsp:
+            epoch = self._sync_free(free) if self.state_cache else 0
+            free_h = free.copy()
+            stub = SolveResult()
+            placed_map, fallbacks = self._hier_run(
+                order, free_h, stub, level
+            )
+            rows = np.flatnonzero((free_h != free).any(axis=1))
+            hsp.set(
+                fine_solves=int(stub.stats.get("hier_fine_solves", 0)),
+                domains=int(stub.stats.get("hier_domains", 0)),
+                encode_seconds=round(time.perf_counter() - t0, 6),
+            )
+        keep_free = not self.state_cache or self.state_verify
+        return SolveDispatch(
+            engine=self,
+            order=order,
+            free0=self._masked_free(free) if keep_free else None,
+            token=("hier", placed_map, fallbacks, rows, free_h[rows],
+                   dict(stub.stats)),
+            encode_seconds=time.perf_counter() - t0,
+            state_epoch=epoch,
+            path="hierarchical",
+            rows=int(rows.size),
+            level=level,
+        )
+
+    def _hier_middle(self, order, free, dispatch, result, level, span):
+        """solve()'s middle phase on the hierarchical path: adopt a
+        hierarchical dispatch (replay its recorded free-row delta — the
+        epoch guard proved the content basis unchanged) or run the
+        two-level solve fresh. Returns (placed_map, fallbacks)."""
+        # cache on: the parent sync keeps mirror/epoch current (the O(1)
+        # adoption guard + the changed-row custody chain the shards
+        # scope their own diffs by). The sync also keeps the PARENT
+        # device buffer warm even though the two-level solve never
+        # reads it — deliberate: a later backlog can hit any forced-
+        # flat trigger (an unconfined gang arriving), and that solve
+        # must find sound resident state, not a silent stale buffer.
+        # The steady-state cost is a hit (nothing ships) or a small
+        # row-delta scatter. Cache off: the sub-engines full-upload per
+        # solve anyway and the adoption guard is the content compare
+        # against dispatch.free0 — no parent device work needed.
+        epoch = self._sync_free(free) if self.state_cache else 0
+        if (
+            dispatch is not None
+            and dispatch.engine is self
+            and dispatch.path == "hierarchical"
+            and len(dispatch.order) == len(order)
+            and all(a is b for a, b in zip(dispatch.order, order))
+            and self._dispatch_current(dispatch, free, epoch)
+        ):
+            _tag, placed_map, fallbacks, rows, vals, stats = dispatch.token
+            free[rows] = vals
+            result.stats.update(stats)
+            result.stats["encode_seconds"] = dispatch.encode_seconds
+            result.stats["dispatch_overlap"] = 1.0
+            span.set(level=level, adopted=True,
+                     fine_solves=stats.get("hier_fine_solves"))
+            return placed_map, fallbacks
+        placed_map, fallbacks = self._hier_run(order, free, result, level)
+        span.set(
+            level=level, adopted=False,
+            domains=int(result.stats["hier_domains"]),
+            pruned_pairs=int(result.stats["hier_pruned_pairs"]),
+            fine_solves=int(result.stats["hier_fine_solves"]),
+            domain_reuse=int(result.stats["hier_domain_reuse"]),
+            fallbacks=fallbacks,
+        )
+        return placed_map, fallbacks
+
     def solve(
         self,
         gangs: list[SolverGang],
@@ -1202,6 +1637,23 @@ class PlacementEngine:
             return result
 
         order = sorted(solvable, key=gang_sort_key)
+        # Hierarchical two-level path (solver/hierarchy.py): coarse
+        # domain-level pruning/assignment + exact per-domain sub-solves,
+        # then the same shared tail (diagnosis, metrics, decisions) as
+        # the flat path. _hier_plan is deterministic over (order,
+        # config, static snapshot), so dispatch and solve always pick
+        # the same path.
+        hier_level = self._hier_plan(order)
+        if hier_level is not None:
+            with self.tracer.span(
+                "engine.hierarchical", gangs=len(order), level=hier_level
+            ) as hsp:
+                placed_map, fallbacks = self._hier_middle(
+                    order, free, dispatch, result, hier_level, hsp
+                )
+            return self._finish_solve(
+                result, order, placed_map, fallbacks, free, gangs, t0
+            )
         # Span shape: a FUSED engine's encode/device/repair are no longer
         # separate dispatches, so the three child spans collapse into ONE
         # engine.fused span carrying the sub-phase walls + path as
@@ -1228,6 +1680,7 @@ class PlacementEngine:
             if (
                 dispatch is not None
                 and dispatch.engine is self
+                and dispatch.path != "hierarchical"
                 and len(dispatch.order) == len(order)
                 and all(a is b for a, b in zip(dispatch.order, order))
                 and self._dispatch_current(dispatch, free, epoch)
@@ -1287,6 +1740,17 @@ class PlacementEngine:
                     fallbacks=fallbacks,
                     overlapped=bool(result.stats.get("dispatch_overlap")),
                 )
+        return self._finish_solve(
+            result, order, placed_map, fallbacks, free, gangs, t0
+        )
+
+    def _finish_solve(self, result, order, placed_map, fallbacks, free,
+                      gangs, t0):
+        """Shared solve tail of the flat and hierarchical paths:
+        declare the committed rows, attribute every gang placed or
+        unplaced (with the memoized structured diagnosis), and feed
+        metrics + the decision ring."""
+        snapshot = self.snapshot
         if self.state_cache and placed_map:
             # the repair phase committed demand into `free` in place: the
             # engine declares its OWN mutations so the next sync's diff is
@@ -1492,7 +1956,7 @@ class PlacementEngine:
             and np.array_equal(cached[0], io)
         ):
             return cached[1]
-        dev = jnp.asarray(io)
+        dev = self._to_device(io)
         self._io_cache = (io, dev)
         self._count_bytes("inputs", io.nbytes - discount)
         return dev
@@ -1509,7 +1973,7 @@ class PlacementEngine:
             and np.array_equal(cached[0], elig_masks)
         ):
             return cached[1]
-        dev = jnp.asarray(elig_masks)
+        dev = self._to_device(elig_masks)
         self._masks_cache = (elig_masks, dev)
         self._count_bytes("masks", elig_masks.nbytes)
         return dev
@@ -1517,11 +1981,11 @@ class PlacementEngine:
     def _ensure_statics(self):
         if self._dev_static is None:
             self._dev_static = (
-                jnp.asarray(self.space.gdom),
-                jnp.asarray(self.space.dom_level),
-                jnp.asarray(self.space.anc_ids),
-                jnp.asarray(self._cap_scale),
-                jnp.asarray(
+                self._to_device(self.space.gdom),
+                self._to_device(self.space.dom_level),
+                self._to_device(self.space.anc_ids),
+                self._to_device(self._cap_scale),
+                self._to_device(
                     np.ones((1, self.snapshot.num_nodes), np.float32)
                 ),
             )
@@ -1733,12 +2197,12 @@ class PlacementEngine:
         )
         for local, row in enumerate(d_mask_rows):
             d_masks[local] = elig_masks[row]
-        io_dev = jnp.asarray(io)
+        io_dev = self._to_device(io)
         self._count_bytes("inputs", io.nbytes)
         masks_dev = (
             self._dev_static[4]
             if m_padd == 1
-            else jnp.asarray(d_masks)
+            else self._to_device(d_masks)
         )
         if m_padd > 1:
             self._count_bytes("masks", d_masks.nbytes)
@@ -1906,6 +2370,29 @@ class PlacementEngine:
                 "value_cache_resident": (
                     self._inc is not None
                     and self._inc.value_dev is not None
+                ),
+            },
+            # hierarchical two-level solve accounting (solver/
+            # hierarchy.py): the coarse pass's pruning story + the
+            # shard population. Sub-engine dispatch/incremental counts
+            # are already mirrored into the dispatches block above.
+            "hierarchical": {
+                "enabled": self.hierarchical,
+                "prune_level": (
+                    None if self._hier is None else self._hier.level
+                ),
+                "coarse_domains": (
+                    None if self._hier is None else self._hier.nd
+                ),
+                "shards_built": (
+                    0 if self._hier is None else len(self._hier.shards)
+                ),
+                "last_pruned_pairs": (
+                    0 if self._hier is None else self._hier.last_pruned
+                ),
+                "last_admissible_pairs": (
+                    0 if self._hier is None
+                    else self._hier.last_admissible
                 ),
             },
         }

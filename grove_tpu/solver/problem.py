@@ -80,6 +80,50 @@ class SolverGang:
     def max_pod_demand(self) -> np.ndarray:
         return self.demand.max(axis=0) if self.num_pods else self.demand.sum(axis=0)
 
+    def elig_signatures(self) -> list:
+        """(max-pod demand, eligibility mask) pairs, one per distinct mask
+        class in the gang — the node-granularity fit proxy every
+        aggregate-level consumer shares: the device score
+        (engine._gang_signatures), the unsat-diagnosis funnel
+        (observability/explain.py) and the hierarchical pruner
+        (solver/hierarchy.py) must classify nodes with the SAME
+        signature set or their verdicts could disagree. Cached: demand
+        and pod_elig are frozen after construction, and the coarse pass
+        reads this once per gang per solve."""
+        sigs = getattr(self, "_elig_sigs", None)
+        if sigs is not None:
+            return sigs
+        if self.pod_elig is None:
+            sigs = [(self.max_pod_demand(), None)]
+        else:
+            by_mask: dict[int, tuple] = {}
+            for p in range(self.num_pods):
+                mask = self.pod_elig[p]
+                key = 0 if mask is None else id(mask)
+                cur = by_mask.get(key)
+                dem = self.demand[p]
+                by_mask[key] = (
+                    dem if cur is None else np.maximum(cur[0], dem),
+                    mask,
+                )
+            sigs = list(by_mask.values())
+        object.__setattr__(self, "_elig_sigs", sigs)
+        return sigs
+
+    def sig_max_demand(self) -> np.ndarray:
+        """Elementwise max over the signature demands — the fit upper
+        bound the hierarchical pruner compares against per-domain max
+        node free (a domain where some resource can't satisfy this on
+        any node fits no signature). Cached like total_demand: the
+        coarse pass reads it once per gang per solve."""
+        m = getattr(self, "_sig_max", None)
+        if m is None:
+            m = np.max(
+                [dem for dem, _mask in self.elig_signatures()], axis=0
+            )
+            object.__setattr__(self, "_sig_max", m)
+        return m
+
 
 def _resolve_level(
     tc: Optional[TopologyConstraint], snapshot: TopologySnapshot

@@ -40,12 +40,16 @@ from grove_tpu.cluster import make_nodes  # noqa: E402
 from grove_tpu.controller import Harness  # noqa: E402
 
 
-def sweep_workload(scaled: bool = False):
+def sweep_workload(scaled: bool = False, hierarchical: bool = False):
     """The reference chaos workload: startup ordering + a scaling group —
     every orchestration flow (gang create/defer, gates, scaled gangs,
     RBAC) is on the fault path. `scaled=True` (the --serving axis) adds
     an HPA scaleConfig on the scaling group so the traffic-driven scale
-    loop has a subresource to write."""
+    loop has a subresource to write. `hierarchical=True` (the
+    --hierarchical axis) adds a rack-level pack constraint so the
+    backlog is CONFINED — the two-level solve only engages on confined
+    backlogs, and node faults then land between its coarse assignments
+    and shard-local fine solves."""
     from grove_tpu.api.meta import ObjectMeta
     from grove_tpu.api.types import (
         AutoScalingConfig,
@@ -56,6 +60,8 @@ def sweep_workload(scaled: bool = False):
         PodCliqueSpec,
         PodCliqueTemplateSpec,
         PodSpec,
+        TopologyConstraintSpec,
+        TopologyPackConstraintSpec,
     )
 
     def _clique(name, replicas, starts_after=()):
@@ -77,6 +83,14 @@ def sweep_workload(scaled: bool = False):
         spec=PodCliqueSetSpec(
             replicas=2,
             template=PodCliqueSetTemplateSpec(
+                topology_constraint=(
+                    TopologyConstraintSpec(
+                        pack_constraint=TopologyPackConstraintSpec(
+                            required="rack"
+                        )
+                    )
+                    if hierarchical else None
+                ),
                 cliques=[
                     _clique("fe", 2),
                     _clique("be", 3, starts_after=["fe"]),
@@ -153,13 +167,20 @@ DURABILITY_CONFIG = {
 }
 
 
+#: solver config for --hierarchical sweeps: the min-nodes forced-flat
+#: threshold dropped to 0 so the two-level solve engages on the sweep's
+#: small clusters (the workload adds the rack confinement it needs)
+HIERARCHICAL_CONFIG = {"solver": {"hierarchical_min_nodes": 0}}
+
+
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
              explain_dir: Path | None = None,
              tenant_skew: bool = False,
              shards: int = 1,
              durability: bool = False,
-             serving: bool = False) -> dict:
+             serving: bool = False,
+             hierarchical: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
     if serving:
         # the elastic-serving fault axis: seeded traffic spikes onto the
@@ -203,6 +224,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     config = dict(TENANT_SKEW_CONFIG) if tenant_skew else {}
     if serving:
         config = {**config, **SERVING_CONFIG}
+    if hierarchical:
+        config = {**config, **HIERARCHICAL_CONFIG}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
     if wal_tmp is not None:
@@ -213,7 +236,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     try:
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
-            explain_dir, durability, serving,
+            explain_dir, durability, serving, hierarchical,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -224,7 +247,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 
 
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
-                    explain_dir, durability, serving=False) -> dict:
+                    explain_dir, durability, serving=False,
+                    hierarchical=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -242,7 +266,7 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
     t0 = time.perf_counter()
     error = None
     try:
-        ch.apply(sweep_workload(scaled=serving))
+        ch.apply(sweep_workload(scaled=serving, hierarchical=hierarchical))
         if serving:
             # reach the traffic-driven equilibrium BEFORE the storm, the
             # same way the baseline does — chaos then measures recovery
@@ -352,6 +376,18 @@ def main(argv=None) -> int:
                          "samples must never drive scale-down); "
                          "convergence is checked against the fault-free "
                          "traffic-driven equilibrium")
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="run the placement engine's HIERARCHICAL "
+                         "two-level solve under fire: the workload gains "
+                         "a rack-level pack constraint (confinement) and "
+                         "the solver's forced-flat min-nodes threshold "
+                         "drops to 0, so every solve takes the coarse "
+                         "domain-level pruning + per-domain sub-engine "
+                         "path — node faults/cordons land between dirty "
+                         "ticks and must ride the shard rebind path, "
+                         "never a stale re-score; convergence is checked "
+                         "against the fault-free fixpoint under the SAME "
+                         "config")
     ap.add_argument("--tenant-skew", dest="tenant_skew",
                     action="store_true",
                     help="enable tenant-skew load faults: tenancy "
@@ -379,11 +415,14 @@ def main(argv=None) -> int:
     baseline_config = dict(TENANT_SKEW_CONFIG) if args.tenant_skew else {}
     if args.serving:
         baseline_config = {**baseline_config, **SERVING_CONFIG}
+    if args.hierarchical:
+        baseline_config = {**baseline_config, **HIERARCHICAL_CONFIG}
     baseline_h = Harness(
         nodes=make_nodes(args.nodes),
         config=baseline_config or None,
     )
-    baseline_h.apply(sweep_workload(scaled=args.serving))
+    baseline_h.apply(sweep_workload(scaled=args.serving,
+                                    hierarchical=args.hierarchical))
     baseline_h.settle()
     if args.serving:
         # drive the HPA loop to its flat-trace equilibrium: the chaotic
@@ -401,7 +440,8 @@ def main(argv=None) -> int:
                           tenant_skew=args.tenant_skew,
                           shards=args.shards,
                           durability=args.durability,
-                          serving=args.serving)
+                          serving=args.serving,
+                          hierarchical=args.hierarchical)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -413,6 +453,7 @@ def main(argv=None) -> int:
         "shards": args.shards,
         "durability": args.durability,
         "serving": args.serving,
+        "hierarchical": args.hierarchical,
         "failed_seeds": failed,
         "ok": not failed,
     }

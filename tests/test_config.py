@@ -287,6 +287,9 @@ class TestConfigChangesBehavior:
             "state_verify": True,
             "fused": True,
             "incremental": True,
+            "hierarchical": True,
+            "hier_prune_level": None,
+            "hier_min_nodes": 4096,
         }
         assert all(p.node_name for p in h.store.list(Pod.KIND))
 

@@ -1,0 +1,573 @@
+"""Hierarchical two-level solve (solver/hierarchy.py + engine path).
+
+The load-bearing invariant is ADMISSIBILITY: the coarse domain-level
+pass works on aggregates, which may only OVER-admit — it must never
+prune a domain the exact (flat) solve would place into. The property
+sweep below brute-forces that against the exact placement primitive
+itself. Everything else rides on it: score-equality vs the flat engine,
+shard-local incrementality, sharded parity, dispatch adoption, and the
+forced-flat fallback triggers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from grove_tpu.api.config import ValidationError, load_operator_config
+from grove_tpu.api.meta import ObjectMeta
+from grove_tpu.api.types import Node, TopologyLevel
+from grove_tpu.observability.explain import UnsatCode, unsat_code
+from grove_tpu.solver import PlacementEngine, SolverGang
+from grove_tpu.solver.fit import place_gang_in_domain
+from grove_tpu.solver.hierarchy import (
+    coarse_admissible,
+    coarse_assign,
+    shift_level,
+    subset_snapshot,
+)
+from grove_tpu.topology import default_cluster_topology, encode_topology
+
+
+def make_cluster(num_nodes: int, cpu: float = 32.0):
+    """3-tier block/rack/host topology (16 hosts/rack, 4 racks/block)."""
+    nodes = []
+    for i in range(num_nodes):
+        b, rem = divmod(i, 64)
+        r = rem // 16
+        nodes.append(
+            Node(
+                metadata=ObjectMeta(
+                    name=f"n{i}",
+                    labels={"t/block": f"b{b}", "t/rack": f"b{b}r{r}"},
+                ),
+                allocatable={"cpu": cpu, "memory": 128.0, "tpu": 8.0},
+            )
+        )
+    ct = default_cluster_topology(
+        [
+            TopologyLevel(domain="block", key="t/block"),
+            TopologyLevel(domain="rack", key="t/rack"),
+        ]
+    )
+    return encode_topology(ct, nodes)
+
+
+def make_gang(name: str, pods: int = 4, cpu: float = 4.0,
+              required: int = 0, preferred: int = 1,
+              priority: float = 0.0, pod_elig=None) -> SolverGang:
+    demand = np.tile(
+        np.array([cpu, 8.0, 1.0], np.float32), (pods, 1)
+    )
+    return SolverGang(
+        name=name,
+        namespace="t",
+        demand=demand,
+        pod_names=[f"{name}-p{j}" for j in range(pods)],
+        group_ids=np.zeros(pods, np.int32),
+        group_names=["w"],
+        group_required_level=np.array([-1], np.int32),
+        group_preferred_level=np.array([-1], np.int32),
+        required_level=required,
+        preferred_level=preferred,
+        priority=priority,
+        pod_elig=pod_elig,
+    )
+
+
+def seeded_problem(seed: int, num_nodes: int = 192, num_gangs: int = 24):
+    """A seeded partially-loaded cluster + mixed backlog: varied
+    demands, priorities, pack levels, a few eligibility-masked pods —
+    the admissibility sweep's input distribution."""
+    rng = np.random.default_rng(seed)
+    snap = make_cluster(num_nodes)
+    free = snap.free.copy()
+    # pre-commit seeded load: some racks near-full, some untouched
+    rows = rng.choice(num_nodes, size=num_nodes // 2, replace=False)
+    frac = rng.uniform(0.1, 1.0, size=(rows.size, 1)).astype(np.float32)
+    free[rows] = (free[rows] * frac).astype(np.float32)
+    # and one block drained near-empty so the aggregate-capacity cut
+    # genuinely fires (a lightly loaded block is never cut — over-
+    # admission is the norm, the sweep needs real pruning to exercise)
+    drained = int(rng.integers(0, int(snap.num_domains[0])))
+    free[snap.domain_ids[0] == drained] *= np.float32(0.01)
+    gangs = []
+    for i in range(num_gangs):
+        pods = int(rng.integers(2, 8))
+        cpu = float(rng.choice([2.0, 4.0, 8.0, 16.0]))
+        required = int(rng.choice([0, 0, 1]))
+        pod_elig = None
+        if rng.random() < 0.25:
+            # one shared seeded mask over half the pods
+            mask = np.zeros(num_nodes, dtype=bool)
+            mask[rng.choice(num_nodes, size=num_nodes // 3,
+                            replace=False)] = True
+            pod_elig = [mask if p % 2 == 0 else None
+                        for p in range(pods)]
+        gangs.append(
+            make_gang(
+                f"g{seed:02d}-{i:03d}", pods=pods, cpu=cpu,
+                required=required,
+                preferred=int(rng.choice([1, 2, -1])),
+                priority=float(rng.integers(0, 3)),
+                pod_elig=pod_elig,
+            )
+        )
+    return snap, free, gangs
+
+
+class TestAdmissibility:
+    """Satellite: the domain-level aggregate must NEVER prune a domain
+    the flat solve could place into (over-admission allowed,
+    under-admission is the correctness bug)."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_never_prunes_a_placeable_domain(self, seed):
+        snap, free, gangs = seeded_problem(seed)
+        level = 0
+        fm = np.where(
+            snap.schedulable[:, None], free, 0.0
+        ).astype(np.float32)
+        order = sorted(gangs, key=lambda g: g.name)
+        admissible, _dom_free, stats, _cls = coarse_admissible(
+            order, snap, fm, level
+        )
+        ids = snap.domain_ids[level]
+        nd = int(snap.num_domains[level])
+        sched = np.flatnonzero(snap.schedulable)
+        for i, g in enumerate(order):
+            for d in range(nd):
+                if admissible[i, d]:
+                    continue
+                # pruned: the EXACT primitive must also fail here,
+                # against the same pre-solve free content
+                node_idx = sched[ids[sched] == d]
+                trial = free.copy()
+                assign = place_gang_in_domain(
+                    g, snap, trial, node_idx, level
+                )
+                assert assign is None, (
+                    f"seed {seed}: pruner cut domain {d} for {g.name} "
+                    "but exact placement succeeds there (under-"
+                    "admission)"
+                )
+        # the sweep must actually exercise pruning, not vacuously pass
+        assert stats["pruned"] > 0
+
+    def test_assignment_covers_admissible_only(self):
+        snap, free, gangs = seeded_problem(3)
+        fm = np.where(
+            snap.schedulable[:, None], free, 0.0
+        ).astype(np.float32)
+        order = sorted(gangs, key=lambda g: g.name)
+        admissible, dom_free, _, cls = coarse_admissible(order, snap, fm, 0)
+        cap_scale = np.maximum(snap.capacity.max(axis=0), 1e-9)
+        choices = coarse_assign(order, admissible, dom_free, cap_scale,
+                                class_ids=cls)
+        for i, alts in enumerate(choices):
+            assert len(alts) == len(set(alts))
+            for d in alts:
+                assert admissible[i, d]
+
+
+class TestScoreEquality:
+    """The pinned hierarchical-vs-flat contract: identical placed set,
+    identical per-gang placement scores, identical unplaced reason
+    codes. Bitwise node assignments may differ (cross-domain ties)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_seeded_score_equality(self, seed):
+        snap, free, gangs = seeded_problem(seed, num_gangs=16)
+        flat = PlacementEngine(snap)
+        hier = PlacementEngine(snap, hierarchical=True)
+        free_f, free_h = free.copy(), free.copy()
+        rf = flat.solve(gangs, free=free_f)
+        rh = hier.solve(gangs, free=free_h)
+        assert rh.stats.get("hierarchical") == 1.0
+        assert sorted(rf.placed) == sorted(rh.placed)
+        for name, pf in rf.placed.items():
+            assert rh.placed[name].placement_score == pf.placement_score
+        for name, reason in rf.unplaced.items():
+            assert unsat_code(rh.unplaced[name]) == unsat_code(reason)
+        np.testing.assert_allclose(
+            free_f.sum(axis=0), free_h.sum(axis=0), rtol=1e-5, atol=1e-3
+        )
+
+    def test_unplaceable_gang_same_code(self):
+        snap = make_cluster(128)
+        gangs = [make_gang("ok", cpu=4.0),
+                 make_gang("huge", cpu=64.0)]  # no 32-cpu node fits
+        rf = PlacementEngine(snap).solve(gangs, free=snap.free.copy())
+        rh = PlacementEngine(snap, hierarchical=True).solve(
+            gangs, free=snap.free.copy()
+        )
+        assert "huge" in rf.unplaced and "huge" in rh.unplaced
+        assert unsat_code(rh.unplaced["huge"]) == UnsatCode.CAPACITY
+        assert unsat_code(rh.unplaced["huge"]) == unsat_code(
+            rf.unplaced["huge"]
+        )
+
+
+class TestForcedFlatTriggers:
+    def _solve(self, eng, gangs):
+        return eng.solve(gangs, free=eng.snapshot.free.copy())
+
+    def test_unconfined_gang_forces_flat(self):
+        snap = make_cluster(128)
+        eng = PlacementEngine(snap, hierarchical=True)
+        confined = [make_gang("a"), make_gang("b")]
+        assert self._solve(eng, confined).stats.get("hierarchical")
+        mixed = [make_gang("a"), make_gang("root", required=-1)]
+        res = self._solve(eng, mixed)
+        assert "hierarchical" not in res.stats
+        assert res.num_placed == 2
+
+    def test_min_nodes_forces_flat(self):
+        snap = make_cluster(128)
+        eng = PlacementEngine(snap, hierarchical=True,
+                              hier_min_nodes=1000)
+        res = self._solve(eng, [make_gang("a")])
+        assert "hierarchical" not in res.stats
+
+    def test_single_domain_forces_flat(self):
+        snap = make_cluster(48)  # one block
+        assert int(snap.num_domains[0]) == 1
+        eng = PlacementEngine(snap, hierarchical=True)
+        res = self._solve(eng, [make_gang("a", required=0)])
+        assert "hierarchical" not in res.stats
+
+    def test_knob_off_is_flat(self):
+        snap = make_cluster(128)
+        res = PlacementEngine(snap).solve(
+            [make_gang("a")], free=snap.free.copy()
+        )
+        assert "hierarchical" not in res.stats
+
+    def test_prune_level_clamped_to_confinement(self):
+        snap = make_cluster(128)
+        # configured narrower (rack=1) than nothing; gangs require
+        # block(0) -> clamp to 0 so no gang spans its coarse domain
+        eng = PlacementEngine(snap, hierarchical=True,
+                              hier_prune_level=1)
+        res = self._solve(eng, [make_gang("a", required=0)])
+        assert res.stats["hier_level"] == 0.0
+        # rack-confined backlog may genuinely prune at rack
+        eng2 = PlacementEngine(snap, hierarchical=True)
+        res2 = self._solve(eng2, [make_gang("a", required=1)])
+        assert res2.stats["hier_level"] == 1.0
+
+
+class TestShardLocalIncrementality:
+    def test_domain_reuse_and_dirty_tick(self):
+        snap = make_cluster(256)
+        gangs = [make_gang(f"g{i:02d}") for i in range(12)]
+        eng = PlacementEngine(snap, hierarchical=True)
+        r1 = eng.solve(gangs, free=snap.free.copy())
+        assert r1.stats["hier_fine_solves"] >= 1
+        # identical repeat: every domain rides the reuse memo
+        r2 = eng.solve(gangs, free=snap.free.copy())
+        assert r2.stats["hier_fine_solves"] == 0
+        assert r2.stats["hier_domain_reuse"] >= 1
+        # dirty tick: one replaced gang -> its domain re-solves
+        # incrementally (O(1) dirty rows), others keep the memo
+        dirty = list(gangs)
+        dirty[2] = make_gang("fresh-0")
+        r3 = eng.solve(dirty, free=snap.free.copy())
+        assert r3.stats.get("incremental") == 1.0
+        assert r3.stats["hier_sub_incremental"] == 1
+        assert r3.stats["incremental_rows"] <= 2.0
+        ds = eng.debug_summary()["device_state"]
+        assert ds["dispatches"]["incremental"] >= 1
+
+    def test_incremental_off_disables_memo(self):
+        snap = make_cluster(256)
+        gangs = [make_gang(f"g{i:02d}") for i in range(8)]
+        eng = PlacementEngine(snap, hierarchical=True, incremental=False)
+        eng.solve(gangs, free=snap.free.copy())
+        r2 = eng.solve(gangs, free=snap.free.copy())
+        assert r2.stats["hier_domain_reuse"] == 0
+        assert r2.stats["hier_fine_solves"] >= 1
+
+    def test_counter_mirroring(self):
+        snap = make_cluster(256)
+        from grove_tpu.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        eng = PlacementEngine(snap, hierarchical=True, metrics=reg)
+        gangs = [make_gang(f"g{i:02d}") for i in range(8)]
+        eng.solve(gangs, free=snap.free.copy())
+        dirty = list(gangs)
+        dirty[0] = make_gang("fresh-0")
+        eng.solve(dirty, free=snap.free.copy())
+        counter = reg.counter("grove_solver_dispatches_total")
+        assert counter.value(kind="fused") >= 1
+        assert counter.value(kind="incremental") >= 1
+
+
+class TestRebindAndInvalidate:
+    def test_cordon_flip_rides_rebind(self):
+        snap = make_cluster(128)
+        gangs = [make_gang(f"g{i:02d}") for i in range(6)]
+        eng = PlacementEngine(snap, hierarchical=True)
+        flat = PlacementEngine(snap)
+        eng.solve(gangs, free=snap.free.copy())
+        sched = snap.schedulable.copy()
+        sched[5] = False
+        snap2 = dataclasses.replace(snap, schedulable=sched)
+        assert eng.rebind(snap2) and flat.rebind(snap2)
+        rh = eng.solve(gangs, free=snap.free.copy())
+        rf = flat.solve(gangs, free=snap.free.copy())
+        assert sorted(rh.placed) == sorted(rf.placed)
+        for name, pf in rf.placed.items():
+            assert rh.placed[name].placement_score == pf.placement_score
+        for p in rh.placed.values():
+            assert 5 not in p.node_indices.tolist()
+
+    def test_invalidate_drops_hier_state(self):
+        snap = make_cluster(128)
+        eng = PlacementEngine(snap, hierarchical=True)
+        eng.solve([make_gang("a")], free=snap.free.copy())
+        assert eng._hier is not None
+        eng.invalidate_device_state()
+        assert eng._hier is None
+        res = eng.solve([make_gang("a")], free=snap.free.copy())
+        assert res.num_placed == 1
+
+
+class TestShardedHierarchy:
+    def test_sharded_bitwise_matches_single(self):
+        from grove_tpu.parallel import (
+            ShardedPlacementEngine,
+            make_solver_mesh,
+        )
+
+        assert jax.device_count() == 8
+        mesh = make_solver_mesh()
+        snap = make_cluster(256)
+        gangs = [make_gang(f"g{i:02d}") for i in range(16)]
+        f1, f2 = snap.free.copy(), snap.free.copy()
+        r1 = ShardedPlacementEngine(
+            snap, mesh, hierarchical=True
+        ).solve(gangs, free=f1)
+        r2 = PlacementEngine(snap, hierarchical=True).solve(
+            gangs, free=f2
+        )
+        assert sorted(r1.placed) == sorted(r2.placed)
+        for name, p1 in r1.placed.items():
+            assert np.array_equal(
+                p1.node_indices, r2.placed[name].node_indices
+            )
+        assert np.array_equal(f1, f2)
+
+    def test_sharded_incremental_runs_shard_locally(self):
+        from grove_tpu.parallel import (
+            ShardedPlacementEngine,
+            make_solver_mesh,
+        )
+
+        mesh = make_solver_mesh()
+        snap = make_cluster(256)
+        gangs = [make_gang(f"g{i:02d}") for i in range(16)]
+        eng = ShardedPlacementEngine(snap, mesh, hierarchical=True)
+        # the flat sharded path keeps incremental forced off...
+        assert eng.incremental is False
+        eng.solve(gangs, free=snap.free.copy())
+        dirty = list(gangs)
+        dirty[1] = make_gang("fresh-0")
+        res = eng.solve(dirty, free=snap.free.copy())
+        # ...but the domain-sharded hierarchy runs it shard-locally
+        assert res.stats.get("incremental") == 1.0
+        assert (
+            eng.debug_summary()["device_state"]["dispatches"][
+                "incremental"
+            ]
+            >= 1
+        )
+
+    def test_sub_engines_round_robin_devices(self):
+        from grove_tpu.parallel import (
+            ShardedPlacementEngine,
+            make_solver_mesh,
+        )
+
+        mesh = make_solver_mesh()
+        snap = make_cluster(256)  # 4 blocks
+        # spread demand so several blocks are actually solved
+        gangs = [
+            make_gang(f"g{i:02d}", pods=8, cpu=16.0) for i in range(24)
+        ]
+        eng = ShardedPlacementEngine(snap, mesh, hierarchical=True)
+        eng.solve(gangs, free=snap.free.copy())
+        devs = {
+            str(s.engine._device)
+            for s in eng._hier.shards.values()
+            if s.engine is not None
+        }
+        assert len(eng._hier.shards) >= 2
+        assert len(devs) == len(
+            {
+                s.dom % len(mesh.local_devices)
+                for s in eng._hier.shards.values()
+                if s.engine is not None
+            }
+        )
+
+
+class TestDispatchAdoption:
+    def test_dispatch_carries_level_and_adopts(self):
+        snap = make_cluster(128)
+        gangs = [make_gang(f"g{i:02d}") for i in range(8)]
+        eng = PlacementEngine(snap, hierarchical=True)
+        h = eng.dispatch(gangs, free=snap.free.copy())
+        assert h.path == "hierarchical"
+        assert h.level == 0
+        free_c = snap.free.copy()
+        res = eng.solve(gangs, free=free_c, dispatch=h)
+        assert res.stats.get("dispatch_overlap") == 1.0
+        assert res.stats.get("hierarchical") == 1.0
+        free_f = snap.free.copy()
+        fresh = eng.solve(gangs, free=free_f)
+        assert sorted(res.placed) == sorted(fresh.placed)
+        assert np.array_equal(free_c, free_f)
+
+    def test_stale_dispatch_refused(self):
+        snap = make_cluster(128)
+        gangs = [make_gang(f"g{i:02d}") for i in range(8)]
+        eng = PlacementEngine(snap, hierarchical=True)
+        h = eng.dispatch(gangs, free=snap.free.copy())
+        stale = snap.free.copy()
+        stale[3] *= 0.5
+        eng.note_free_rows((3,))
+        res = eng.solve(gangs, free=stale, dispatch=h)
+        assert not res.stats.get("dispatch_overlap")
+        assert res.num_placed == len(gangs)
+
+    def test_changed_order_refused(self):
+        snap = make_cluster(128)
+        gangs = [make_gang(f"g{i:02d}") for i in range(8)]
+        eng = PlacementEngine(snap, hierarchical=True)
+        h = eng.dispatch(gangs, free=snap.free.copy())
+        other = list(gangs[:-1]) + [make_gang("new")]
+        res = eng.solve(other, free=snap.free.copy(), dispatch=h)
+        assert not res.stats.get("dispatch_overlap")
+        assert res.num_placed == len(other)
+
+
+class TestSubSnapshot:
+    def test_subset_snapshot_shape(self):
+        snap = make_cluster(128)
+        idx = np.flatnonzero(snap.domain_ids[0] == 1)
+        sub = subset_snapshot(snap, idx, 0)
+        assert sub.num_nodes == len(idx)
+        assert sub.level_keys == snap.level_keys[1:]
+        assert sub.num_levels == snap.num_levels - 1
+        # rack ids re-densified 0..3, host ids 0..63
+        assert int(sub.num_domains[0]) == 4
+        assert sub.node_names == [snap.node_names[i] for i in idx]
+
+    def test_shift_level(self):
+        assert shift_level(-1, 0) == -1
+        assert shift_level(0, 0) == -1   # at the prune level: sub-root
+        assert shift_level(1, 0) == 0
+        assert shift_level(2, 0) == 1
+        assert shift_level(1, 1) == -1
+        assert shift_level(2, 1) == 0
+
+
+class TestConfigAndScheduler:
+    def test_config_validation(self):
+        load_operator_config(
+            {"solver": {"hierarchical_solve": True,
+                        "hierarchical_prune_level": 1,
+                        "hierarchical_min_nodes": 0}}
+        )
+        with pytest.raises(ValidationError):
+            load_operator_config(
+                {"solver": {"hierarchical_solve": "yes"}}
+            )
+        with pytest.raises(ValidationError):
+            load_operator_config(
+                {"solver": {"hierarchical_prune_level": -2}}
+            )
+        with pytest.raises(ValidationError):
+            load_operator_config(
+                {"solver": {"hierarchical_min_nodes": -1}}
+            )
+
+    def test_scheduler_threads_hierarchy_e2e(self):
+        from grove_tpu.api.types import (
+            Container,
+            Pod,
+            PodCliqueSet,
+            PodCliqueSetSpec,
+            PodCliqueSetTemplateSpec,
+            PodCliqueSpec,
+            PodCliqueTemplateSpec,
+            PodSpec,
+            TopologyConstraintSpec,
+            TopologyPackConstraintSpec,
+        )
+        from grove_tpu.cluster import make_nodes
+        from grove_tpu.controller import Harness
+
+        h = Harness(
+            nodes=make_nodes(32),
+            config={"solver": {"hierarchical_min_nodes": 0}},
+        )
+        pcs = PodCliqueSet(
+            metadata=ObjectMeta(name="w"),
+            spec=PodCliqueSetSpec(
+                replicas=3,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[
+                        PodCliqueTemplateSpec(
+                            name="a",
+                            spec=PodCliqueSpec(
+                                replicas=4,
+                                pod_spec=PodSpec(
+                                    containers=[
+                                        Container(
+                                            name="m",
+                                            resources={"cpu": 2.0},
+                                        )
+                                    ]
+                                ),
+                            ),
+                        )
+                    ],
+                    topology_constraint=TopologyConstraintSpec(
+                        pack_constraint=TopologyPackConstraintSpec(
+                            required="rack"
+                        )
+                    ),
+                ),
+            ),
+        )
+        h.apply(pcs)
+        h.settle()
+        pods = h.store.scan(Pod.KIND)
+        assert pods and all(p.node_name for p in pods)
+        eng = (h.debug_dump().get("scheduler") or {}).get("engine") or {}
+        hier = eng.get("hierarchical") or {}
+        assert hier.get("enabled") is True
+        assert hier.get("shards_built", 0) >= 1
+
+    def test_debug_summary_block(self):
+        snap = make_cluster(128)
+        eng = PlacementEngine(snap, hierarchical=True)
+        block = eng.debug_summary()["hierarchical"]
+        assert block == {
+            "enabled": True,
+            "prune_level": None,
+            "coarse_domains": None,
+            "shards_built": 0,
+            "last_pruned_pairs": 0,
+            "last_admissible_pairs": 0,
+        }
+        eng.solve([make_gang("a")], free=snap.free.copy())
+        block = eng.debug_summary()["hierarchical"]
+        assert block["prune_level"] == 0
+        assert block["shards_built"] >= 1
