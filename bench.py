@@ -167,6 +167,40 @@ def make_gangs(num_gangs: int, grouped: bool = False) -> list[SolverGang]:
     return gangs
 
 
+def p50(walls: list[float]) -> float:
+    """Median by the bench's nearest-rank convention (upper median)."""
+    return sorted(walls)[len(walls) // 2]
+
+
+def wall_stats(walls: list[float], prefix: str = "",
+               suffix: str = "seconds", round_to: int = 4) -> dict:
+    """min/median/max summary of one interleaved-A/B side — the shared
+    bench-noise discipline: this host's throttling swings walls ~2x
+    run-to-run, so a single uninterleaved number misleads and every
+    probe reports the range."""
+    s = sorted(walls)
+    return {
+        f"{prefix}p50_{suffix}": round(s[len(s) // 2], round_to),
+        f"{prefix}min_{suffix}": round(s[0], round_to),
+        f"{prefix}max_{suffix}": round(s[-1], round_to),
+    }
+
+
+def interleaved_ab(measure_a, measure_b, repeats: int) -> tuple[list, list]:
+    """The interleaved A/B loop every comparative regime shares: each
+    repeat times side A then side B BACK-TO-BACK, so a host-load burst
+    lands on both sides of the pair — the reported speedup (a ratio of
+    p50s over interleaved samples) is far more stable than two
+    separately measured medians. The callables take the repeat index;
+    whatever they return is collected per side (None returns are the
+    caller's skip convention)."""
+    a_samples, b_samples = [], []
+    for i in range(repeats):
+        a_samples.append(measure_a(i))
+        b_samples.append(measure_b(i))
+    return a_samples, b_samples
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--small", action="store_true",
@@ -301,7 +335,28 @@ def main() -> int:
                     "(WAL + snapshots in a temp dir), kill the process "
                     "state at steady state, and report recovery_seconds "
                     "(disk replay + soft-state rebuild + re-settle to "
-                    "the same fixpoint)")
+                    "the same fixpoint), plus the same probe on the "
+                    "PARTITIONED store (--partitions K) reporting "
+                    "recovery_partitioned_seconds — the merged "
+                    "per-partition replay path")
+    ap.add_argument("--store-bench", action="store_true",
+                    help="durable-store write-path regime (ROADMAP item "
+                    "4a): committed-write throughput of the PARTITIONED "
+                    "write path (per-(namespace, kind) WAL chains, "
+                    "--partitions K) vs the classic single WAL, both "
+                    "under the --shards N fanned control-plane "
+                    "workload, interleaved A/B with min/median/max "
+                    "(this host's throttling swings walls ~2x "
+                    "run-to-run). The partitioned side reports the "
+                    "modeled parallel commit wall (max per-partition "
+                    "wall — partitions commit to independent files, so "
+                    "a real deployment overlaps them) next to the "
+                    "in-process sum; exits nonzero if the writes never "
+                    "actually spread past one partition")
+    ap.add_argument("--partitions", type=int, default=4,
+                    help="--store-bench / --recovery: durable write-path "
+                    "partition count for the partitioned side "
+                    "(DurabilityConfig.partitions; default 4)")
     ap.add_argument("--service", action="store_true",
                     help="benchmark the solve THROUGH the placement-service "
                     "gRPC boundary (server spawned as a subprocess on this "
@@ -315,6 +370,8 @@ def main() -> int:
     from grove_tpu.tuning import enable_compilation_cache
 
     enable_compilation_cache()
+    if args.store_bench:
+        return bench_store(args)
     if args.scale_tier:
         return bench_scale_tier(args)
     if args.diurnal:
@@ -514,11 +571,11 @@ def main() -> int:
     split["delta_uploads"] = ds["delta_uploads"]
     split["state_sync_hits"] = ds["hits"]
     split["state_cache_enabled"] = ds["cache_enabled"]
-    p50 = {k: sorted(v)[len(v) // 2] for k, v in phase_stats.items()}
+    phase_p50 = {k: p50(v) for k, v in phase_stats.items()}
     colocated_wall = (
-        p50["encode_seconds"]
+        phase_p50["encode_seconds"]
         + split["device_compute_seconds"]
-        + p50["repair_seconds"]
+        + phase_p50["repair_seconds"]
     )
     split["colocated_projection_gangs_per_sec"] = round(
         args.gangs / colocated_wall, 1
@@ -635,45 +692,32 @@ def main() -> int:
             )
             p_flat.solve(p_gangs)  # warm-up: new shapes compile
             p_hier.solve(p_gangs)
-            f_walls, h_walls = [], []
-            p_placed = h_placed = 0
-            for _ in range(3):
-                t0 = time.perf_counter()
-                h_placed = p_hier.solve(p_gangs).num_placed
-                h_walls.append(time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                p_placed = p_flat.solve(p_gangs).num_placed
-                f_walls.append(time.perf_counter() - t0)
-            f_walls.sort()
-            h_walls.sort()
+            placed = {}
+
+            def timed(engine, side, placed=placed):
+                def run(_i):
+                    t0 = time.perf_counter()
+                    placed[side] = engine.solve(p_gangs).num_placed
+                    return time.perf_counter() - t0
+                return run
+
+            h_walls, f_walls = interleaved_ab(
+                timed(p_hier, "hier"), timed(p_flat, "flat"), 3
+            )
             probe.update({
                 f"scale{factor}x_nodes": args.nodes * factor,
                 f"scale{factor}x_gangs": args.gangs * factor,
-                f"scale{factor}x_placed": p_placed,
-                f"scale{factor}x_p50_backlog_bind_seconds": round(
-                    f_walls[1], 4
-                ),
-                f"scale{factor}x_min_backlog_bind_seconds": round(
-                    f_walls[0], 4
-                ),
-                f"scale{factor}x_max_backlog_bind_seconds": round(
-                    f_walls[-1], 4
-                ),
+                f"scale{factor}x_placed": placed["flat"],
+                **wall_stats(f_walls, f"scale{factor}x_",
+                             suffix="backlog_bind_seconds"),
                 f"scale{factor}x_gangs_per_sec": round(
-                    args.gangs * factor / f_walls[1], 1
+                    args.gangs * factor / p50(f_walls), 1
                 ),
-                f"scale{factor}x_hier_placed": h_placed,
-                f"scale{factor}x_hier_p50_backlog_bind_seconds": round(
-                    h_walls[1], 4
-                ),
-                f"scale{factor}x_hier_min_backlog_bind_seconds": round(
-                    h_walls[0], 4
-                ),
-                f"scale{factor}x_hier_max_backlog_bind_seconds": round(
-                    h_walls[-1], 4
-                ),
+                f"scale{factor}x_hier_placed": placed["hier"],
+                **wall_stats(h_walls, f"scale{factor}x_hier_",
+                             suffix="backlog_bind_seconds"),
                 f"scale{factor}x_hier_vs_flat_speedup": round(
-                    f_walls[1] / h_walls[1], 2
+                    p50(f_walls) / p50(h_walls), 2
                 ),
             })
 
@@ -715,7 +759,10 @@ def main() -> int:
             )
         )
         if args.recovery:
-            cp.update(bench_recovery(args.nodes, args.cp_replicas))
+            cp.update(bench_recovery(
+                args.nodes, args.cp_replicas,
+                partitions=args.partitions,
+            ))
 
     # Headline basis (r7, recorded so BENCH files stay self-describing,
     # like the r3 p99->p50 change): the fused regime's headline is the
@@ -1332,19 +1379,28 @@ def bench_scale_tier(args) -> int:
     if flat is not None:
         flat.solve(backlog, free=snapshot.free.copy())
 
-    h_walls, f_walls = [], []
-    placed = 0
-    for rep in range(max(args.tier_repeats, 3)):
-        backlog = dirty_tick(backlog, rep)
-        # interleaved A/B: host throttling noise lands on both sides
+    state = {"backlog": backlog, "placed": 0}
+
+    def run_hier(rep):
+        state["backlog"] = dirty_tick(state["backlog"], rep)
         t0 = time.perf_counter()
-        placed = hier.solve(backlog, free=snapshot.free.copy()).num_placed
-        h_walls.append(time.perf_counter() - t0)
-        if flat is not None:
-            t0 = time.perf_counter()
-            flat.solve(backlog, free=snapshot.free.copy())
-            f_walls.append(time.perf_counter() - t0)
-    h_walls.sort()
+        state["placed"] = hier.solve(
+            state["backlog"], free=snapshot.free.copy()
+        ).num_placed
+        return time.perf_counter() - t0
+
+    def run_flat(_rep):
+        if flat is None:
+            return None
+        t0 = time.perf_counter()
+        flat.solve(state["backlog"], free=snapshot.free.copy())
+        return time.perf_counter() - t0
+
+    h_walls, f_walls = interleaved_ab(
+        run_hier, run_flat, max(args.tier_repeats, 3)
+    )
+    f_walls = [w for w in f_walls if w is not None]
+    placed = state["placed"]
     ds = hier.debug_summary()
     disp = ds["device_state"]["dispatches"]
     hier_block = ds["hierarchical"]
@@ -1360,21 +1416,19 @@ def bench_scale_tier(args) -> int:
             "coverage: the coarse level neither pruned nor partitioned "
             "anything — the tier ran effectively flat"
         )
-    p50 = h_walls[len(h_walls) // 2]
+    tier_p50 = p50(h_walls)
     out = {
         "metric": f"hierarchical scale tier ({num_gangs} x 8-pod gangs, "
         f"{num_nodes} nodes, 4-level topology)",
-        "value": round(num_gangs / p50, 1),
+        "value": round(num_gangs / tier_p50, 1),
         "unit": "gangs/sec",
         "vs_baseline": round(
-            (sorted(f_walls)[len(f_walls) // 2] / p50), 2
+            (p50(f_walls) / tier_p50), 2
         ) if f_walls else 0.0,
         "tier": args.scale_tier,
         "placed": placed,
-        "tier_p50_backlog_bind_seconds": round(p50, 4),
-        "tier_min_backlog_bind_seconds": round(h_walls[0], 4),
-        "tier_max_backlog_bind_seconds": round(h_walls[-1], 4),
-        "tier_sub_second_p50": p50 < 1.0,
+        **wall_stats(h_walls, "tier_", suffix="backlog_bind_seconds"),
+        "tier_sub_second_p50": tier_p50 < 1.0,
         "tier_repeats": len(h_walls),
         "tier_dirty_gangs_per_tick": DIRTY,
         "dispatches_by_kind": dict(disp),
@@ -1386,11 +1440,7 @@ def bench_scale_tier(args) -> int:
         "hier_last_pruned_pairs": hier_block["last_pruned_pairs"],
         "flat_ab": (
             {
-                "flat_p50_seconds": round(
-                    sorted(f_walls)[len(f_walls) // 2], 4
-                ),
-                "flat_min_seconds": round(min(f_walls), 4),
-                "flat_max_seconds": round(max(f_walls), 4),
+                **wall_stats(f_walls, "flat_"),
                 "interleaved": True,
             }
             if f_walls
@@ -1594,7 +1644,254 @@ def bench_controlplane(
     }
 
 
-def bench_recovery(num_nodes: int, replicas: int) -> dict:
+def _fanned_workload(fan: int, per_pcs: int, tag: str,
+                     namespaces: int = 1) -> list:
+    """The sharded/store regimes' fanned workload: `fan` PodCliqueSets
+    of `per_pcs` replicas each (a PCS is one reconcile key, so a single
+    mega-PCS would pin all parent-controller work — and all its durable
+    writes — to one shard no matter how wide the plane runs).
+    `namespaces` > 1 spreads the sets over that many namespaces, which
+    is what spreads a partitioned store's (namespace, kind) write
+    routing across partitions — the multi-namespace fleet shape."""
+    from grove_tpu.api.meta import ObjectMeta as Meta
+    from grove_tpu.api.types import (
+        Container,
+        PodCliqueSet,
+        PodCliqueSetSpec,
+        PodCliqueSetTemplateSpec,
+        PodCliqueSpec,
+        PodCliqueTemplateSpec,
+        PodSpec,
+    )
+
+    return [
+        PodCliqueSet(
+            metadata=Meta(
+                name=f"{tag}-{j}",
+                namespace=(
+                    f"bench-ns{j % namespaces}" if namespaces > 1
+                    else "default"
+                ),
+            ),
+            spec=PodCliqueSetSpec(
+                replicas=per_pcs,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[
+                        PodCliqueTemplateSpec(
+                            name="w",
+                            spec=PodCliqueSpec(
+                                replicas=8,
+                                pod_spec=PodSpec(
+                                    containers=[
+                                        Container(
+                                            name="m",
+                                            resources={"cpu": 1.0},
+                                        )
+                                    ]
+                                ),
+                            ),
+                        )
+                    ]
+                ),
+            ),
+        )
+        for j in range(fan)
+    ]
+
+
+def bench_store(args) -> int:
+    """Durable-store write-path regime (`--store-bench`, ROADMAP item
+    4a): committed-write throughput of the PARTITIONED write path
+    (cluster/durability.PartitionedLog, `--partitions K`) vs the classic
+    single WAL, both driving the same fanned workload through the full
+    control plane under `--shards N`.
+
+    Throughput is computed from each side's WAL COMMIT WALL
+    (DurableLog.wall_seconds deltas: append + cadence-snapshot work),
+    not the whole settle — the probe measures the durable write path,
+    with the control plane as the load generator. The partitioned side
+    reports two numbers:
+
+      modeled    records / max(per-partition commit wall) — partitions
+                 append and fsync to independent files, so a real
+                 deployment overlaps them (one appender per partition;
+                 the same parallel model as the sharded control-plane
+                 bench's N-process fleet)
+      in-process records / sum(per-partition walls) — what this
+                 single-threaded sim actually pays (per-partition
+                 snapshot cuts pickle only the partition's slice, so
+                 even the same-thread number can win)
+
+    Interleaved A/B with min/median/max per the shared bench-noise
+    discipline (this host's throttling swings walls ~2x run-to-run).
+    Exits nonzero when the writes never spread past one partition
+    (vacuous coverage) or the modeled median fails to beat the single
+    WAL."""
+    import os
+    import tempfile
+
+    from grove_tpu.api.types import Pod
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+    from grove_tpu.tuning import tune_gc
+
+    shards = max(args.shards, 1)
+    partitions = max(args.partitions, 2)
+    repeats = 3 if args.small else 5
+    num_nodes = 64 if args.small else min(args.nodes, 512)
+    fan = max(8, shards * 8)
+    per_pcs = 2 if args.small else 6
+    namespaces = min(fan, 8)
+
+    def durable_harness(wal_dir: str, parts: int) -> Harness:
+        cfg: dict = {
+            "durability": {
+                "wal_dir": wal_dir,
+                # fsync "never": the sim never kills the interpreter, so
+                # physical durability is not what this probe measures —
+                # the commit wall is serialization + append + snapshot
+                # work; with fsync on, the per-partition overlap the
+                # parallel model captures only widens
+                "fsync": "never",
+                "snapshot_interval_seconds": 120.0,
+                "wal_max_bytes": 1 << 20,
+                **({"partitions": parts} if parts > 1 else {}),
+            }
+        }
+        if shards > 1:
+            cfg["controllers"] = {"shards": shards}
+        return Harness(
+            nodes=make_nodes(
+                num_nodes,
+                allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+            ),
+            config=cfg,
+        )
+
+    def measure_cycle(h: Harness, tag: str) -> dict:
+        """One apply+settle cycle (the committed-write burst), deltas
+        read from the durable layer; the teardown settles outside the
+        measured window so the store population is constant run to
+        run."""
+        dur = h.cluster.durability
+        walls0 = (
+            dur.partition_walls() if hasattr(dur, "partition_walls")
+            else None
+        )
+        wall0 = dur.wall_seconds
+        rec0 = dur.wal_records_total
+        workload = _fanned_workload(fan, per_pcs, tag, namespaces)
+        t0 = time.perf_counter()
+        for pcs in workload:
+            h.apply(pcs)
+        h.settle()
+        settle_wall = time.perf_counter() - t0
+        bound = sum(1 for p in h.store.scan(Pod.KIND) if p.node_name)
+        if bound != fan * per_pcs * 8:
+            raise RuntimeError(
+                f"store bench invalid: {bound} pods bound, expected "
+                f"{fan * per_pcs * 8}"
+            )
+        out = {
+            "records": dur.wal_records_total - rec0,
+            "commit_wall": dur.wall_seconds - wall0,
+            "settle_wall": settle_wall,
+        }
+        if walls0 is not None:
+            per = [b - a for a, b in zip(walls0, dur.partition_walls())]
+            out["partition_walls"] = per
+            out["modeled_wall"] = max(per)
+        for pcs in workload:
+            h.store.delete(
+                "PodCliqueSet", pcs.metadata.namespace, pcs.metadata.name
+            )
+        h.settle()
+        return out
+
+    with tempfile.TemporaryDirectory(prefix="grove-store-bench-") as td:
+        single = durable_harness(os.path.join(td, "single"), 1)
+        part = durable_harness(os.path.join(td, "part"), partitions)
+        # warm-up cycle per side: jit compiles + store shapes land
+        # outside the measured window
+        measure_cycle(single, "warm-s")
+        measure_cycle(part, "warm-p")
+        tune_gc()
+        s_runs, p_runs = interleaved_ab(
+            lambda i: measure_cycle(single, f"sbs{i}"),
+            lambda i: measure_cycle(part, f"sbp{i}"),
+            repeats,
+        )
+        active = sum(
+            1 for p in part.cluster.durability.partitions
+            if p.wal_records_total > 0
+        )
+
+    s_tp = [r["records"] / r["commit_wall"] for r in s_runs]
+    p_tp_model = [r["records"] / r["modeled_wall"] for r in p_runs]
+    p_tp_inproc = [r["records"] / r["commit_wall"] for r in p_runs]
+    speedup = p50(p_tp_model) / p50(s_tp)
+    failures = []
+    if active <= 1:
+        failures.append(
+            "coverage: committed writes never spread past one partition "
+            "— the fanned workload should hash (namespace, kind) keys "
+            "across the layout"
+        )
+    if speedup <= 1.0:
+        failures.append(
+            f"partitioned commit did not beat the single WAL at the "
+            f"median (modeled speedup {speedup:.2f})"
+        )
+    out = {
+        "metric": (
+            f"durable committed-write throughput ({partitions} "
+            f"partitions vs single WAL, {fan}x{per_pcs}-replica fanned "
+            f"workload, shards={shards})"
+        ),
+        "value": round(p50(p_tp_model), 1),
+        "unit": "committed-writes/sec",
+        "vs_baseline": round(speedup, 2),
+        "store_bench_shards": shards,
+        "store_bench_partitions": partitions,
+        "store_bench_active_partitions": active,
+        "store_bench_namespaces": namespaces,
+        "store_bench_records_per_cycle": s_runs[-1]["records"],
+        "store_bench_repeats": repeats,
+        "store_bench_interleaved": True,
+        "store_bench_model": "records_over_max_partition_commit_wall",
+        **wall_stats(s_tp, "store_single_",
+                     suffix="writes_per_sec", round_to=1),
+        **wall_stats(p_tp_model, "store_partitioned_",
+                     suffix="writes_per_sec", round_to=1),
+        "store_partitioned_inprocess_p50_writes_per_sec": round(
+            p50(p_tp_inproc), 1
+        ),
+        "store_partitioned_inprocess_speedup": round(
+            p50(p_tp_inproc) / p50(s_tp), 2
+        ),
+        **wall_stats([r["commit_wall"] for r in s_runs],
+                     "store_single_commit_wall_"),
+        **wall_stats([r["modeled_wall"] for r in p_runs],
+                     "store_partitioned_commit_wall_"),
+        **wall_stats([r["commit_wall"] for r in p_runs],
+                     "store_partitioned_inprocess_wall_"),
+        "store_partition_commit_walls": [
+            round(w, 4) for w in p_runs[-1]["partition_walls"]
+        ],
+        **wall_stats([r["settle_wall"] for r in s_runs],
+                     "store_single_settle_"),
+        **wall_stats([r["settle_wall"] for r in p_runs],
+                     "store_partitioned_settle_"),
+        "backend": __import__("jax").default_backend(),
+    }
+    for f in failures:
+        print(f"STORE BENCH FAILURE: {f}", file=sys.stderr)
+    print(json.dumps(out))
+    return 1 if failures else 0
+
+
+def bench_recovery(num_nodes: int, replicas: int,
+                   partitions: int = 1) -> dict:
     """Cold-restart recovery probe (`--recovery`): settle the standard
     control-plane workload on a DURABLE store (WAL + snapshots in a temp
     dir, fsync per commit — the honest production posture), then model a
@@ -1609,7 +1906,13 @@ def bench_recovery(num_nodes: int, replicas: int) -> dict:
     alone). Durable write-path overhead is visible by comparing
     recovery_durable_cold_settle_seconds (this harness's first settle,
     WAL armed, jit-cold) against controlplane_cold_settle_seconds from
-    the same run."""
+    the same run.
+
+    partitions > 1 runs the same probe a second time on the PARTITIONED
+    store (per-(namespace, kind) WAL chains; recovery heap-merges the
+    partition replay streams by global seq) and reports the
+    recovery_partitioned_* fields alongside."""
+    import os
     import tempfile
 
     from grove_tpu.api.meta import ObjectMeta as Meta
@@ -1650,16 +1953,19 @@ def bench_recovery(num_nodes: int, replicas: int) -> dict:
             ),
         ),
     )
-    with tempfile.TemporaryDirectory(prefix="grove-bench-wal-") as wal_dir:
+    def probe(wal_dir: str, parts: int) -> dict:
         h = Harness(
             nodes=make_nodes(
                 num_nodes,
                 allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
             ),
-            config={"durability": {"wal_dir": wal_dir}},
+            config={"durability": {
+                "wal_dir": wal_dir,
+                **({"partitions": parts} if parts > 1 else {}),
+            }},
         )
         t0 = time.perf_counter()
-        h.apply(workload)
+        h.apply(workload)  # create() clones its input; reuse is safe
         h.settle()
         durable_settle = time.perf_counter() - t0
         fixpoint = settled_fingerprint(h.store)
@@ -1673,16 +1979,44 @@ def bench_recovery(num_nodes: int, replicas: int) -> dict:
             raise RuntimeError(
                 "recovery bench invalid: post-recovery fixpoint diverged"
             )
-    return {
-        "recovery_replicas": replicas,
-        "recovery_seconds": round(wall, 3),
-        "recovery_replay_seconds": round(replay, 3),
-        "recovery_durable_cold_settle_seconds": round(durable_settle, 2),
-        "recovery_wal_records": wal["wal_records_total"],
-        "recovery_wal_bytes": wal["wal_bytes_total"],
-        "recovery_outcome": stats["outcome"],
-        "recovery_records_replayed": stats["wal_records_replayed"],
-    }
+        return {
+            "seconds": round(wall, 3),
+            "replay_seconds": round(replay, 3),
+            "durable_cold_settle_seconds": round(durable_settle, 2),
+            "wal": wal,
+            "stats": stats,
+        }
+
+    with tempfile.TemporaryDirectory(prefix="grove-bench-wal-") as td:
+        single = probe(os.path.join(td, "single"), 1)
+        out = {
+            "recovery_replicas": replicas,
+            "recovery_seconds": single["seconds"],
+            "recovery_replay_seconds": single["replay_seconds"],
+            "recovery_durable_cold_settle_seconds": single[
+                "durable_cold_settle_seconds"
+            ],
+            "recovery_wal_records": single["wal"]["wal_records_total"],
+            "recovery_wal_bytes": single["wal"]["wal_bytes_total"],
+            "recovery_outcome": single["stats"]["outcome"],
+            "recovery_records_replayed": single["stats"][
+                "wal_records_replayed"
+            ],
+        }
+        if partitions > 1:
+            part = probe(os.path.join(td, "part"), partitions)
+            out.update({
+                "recovery_partitions": partitions,
+                "recovery_partitioned_seconds": part["seconds"],
+                "recovery_partitioned_replay_seconds": part[
+                    "replay_seconds"
+                ],
+                "recovery_partitioned_outcome": part["stats"]["outcome"],
+                "recovery_partitioned_records_replayed": part["stats"][
+                    "wal_records_replayed"
+                ],
+            })
+    return out
 
 
 def bench_controlplane_sharded(
@@ -1711,57 +2045,23 @@ def bench_controlplane_sharded(
     measure VIRTUAL seconds to full re-convergence — the protocol
     bounds it by one shard lease duration (orphaned-lease detection)
     plus one coordination round."""
-    from grove_tpu.api.meta import ObjectMeta as Meta
-    from grove_tpu.api.types import (
-        Container,
-        Pod,
-        PodCliqueSet,
-        PodCliqueSetSpec,
-        PodCliqueSetTemplateSpec,
-        PodCliqueSpec,
-        PodCliqueTemplateSpec,
-        PodSpec,
-    )
+    from grove_tpu.api.types import Pod
     from grove_tpu.cluster import make_nodes
     from grove_tpu.controller import Harness
     from grove_tpu.tuning import tune_gc
 
-    # The workload FANS OUT across PodCliqueSets (8 per worker replica):
-    # a PCS is one reconcile key, so a single mega-PCS would pin all
-    # parent-controller work to one shard no matter how many workers run
-    # — the sharded regime models the many-workload fleet the plane
-    # actually scales for. The single-replica reference below measures
-    # the SAME fanned workload, so the speedup is workload-for-workload.
+    # The workload FANS OUT across PodCliqueSets (8 per worker replica,
+    # see _fanned_workload): the sharded regime models the
+    # many-workload fleet the plane actually scales for. The
+    # single-replica reference below measures the SAME fanned workload,
+    # so the speedup is workload-for-workload.
     fan = max(1, shards * 8)
     per_pcs = max(1, replicas // fan)
     total_gangs = fan * per_pcs
 
     def apply_workload(h, tag: str) -> None:
-        for j in range(fan):
-            h.apply(PodCliqueSet(
-                metadata=Meta(name=f"{tag}-{j}"),
-                spec=PodCliqueSetSpec(
-                    replicas=per_pcs,
-                    template=PodCliqueSetTemplateSpec(
-                        cliques=[
-                            PodCliqueTemplateSpec(
-                                name="w",
-                                spec=PodCliqueSpec(
-                                    replicas=8,
-                                    pod_spec=PodSpec(
-                                        containers=[
-                                            Container(
-                                                name="m",
-                                                resources={"cpu": 1.0},
-                                            )
-                                        ]
-                                    ),
-                                ),
-                            )
-                        ]
-                    ),
-                ),
-            ))
+        for pcs in _fanned_workload(fan, per_pcs, tag):
+            h.apply(pcs)
 
     def delete_workload(h, tag: str) -> None:
         for j in range(fan):
@@ -1821,13 +2121,12 @@ def bench_controlplane_sharded(
         h.settle()
         delete_workload(h, f"cpshwarm{i}")
         h.settle()
-    ref_walls: list[float] = []
-    runs: list[tuple[float, dict]] = []
-    for i in range(5):
-        ref_walls.append(measure_once(ref, f"cpsr{i}")[0])
-        runs.append(measure_once(h, f"cpsh{i}"))
-    ref_walls.sort()
-    single_gangs_per_sec = total_gangs / ref_walls[len(ref_walls) // 2]
+    ref_walls, runs = interleaved_ab(
+        lambda i: measure_once(ref, f"cpsr{i}")[0],
+        lambda i: measure_once(h, f"cpsh{i}"),
+        5,
+    )
+    single_gangs_per_sec = total_gangs / p50(ref_walls)
     out["controlplane_sharded_baseline_gangs_per_sec"] = round(
         single_gangs_per_sec, 1
     )
@@ -2432,7 +2731,7 @@ def bench_diurnal(args) -> int:
         scale_ctr = h.cluster.metrics.counter(
             "grove_autoscaler_scale_events_total"
         )
-        walls = sorted(st["walls"])
+        walls = st["walls"]
         scores = st["scores"]
         return {
             "scaleup_events": len(episodes),
@@ -2458,7 +2757,7 @@ def bench_diurnal(args) -> int:
                 ).total()
             ),
             "settle_wall_p50_seconds": (
-                round(walls[len(walls) // 2], 4) if walls else 0.0
+                round(p50(walls), 4) if walls else 0.0
             ),
         }
 

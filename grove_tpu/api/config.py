@@ -373,6 +373,25 @@ class DurabilityConfig:
                                  (WAL segments are pruned only once every
                                  record is covered by the OLDEST retained
                                  snapshot)
+      partitions                 1 (default) = the classic single WAL;
+                                 K > 1 partitions the durable write path
+                                 by (namespace, kind) into K independent
+                                 WAL segment chains + snapshot
+                                 generations under wal_dir/pNNN
+                                 (cluster/durability.PartitionedLog) —
+                                 commits, fsyncs and snapshot cuts run
+                                 per partition, recovery merges the
+                                 partition streams by global seq back to
+                                 a bit-identical store. The layout is
+                                 recorded on disk; resuming a wal_dir
+                                 with a different partition layout is
+                                 refused (docs/operations.md
+                                 "Partitioned WAL layout")
+      partition_map              explicit partition pinning on top of the
+                                 default hash routing: "Kind" or
+                                 "namespace/Kind" -> partition index in
+                                 [0, partitions). The qualified form
+                                 wins; unlisted keys hash
     """
 
     wal_dir: str | None = None
@@ -380,6 +399,8 @@ class DurabilityConfig:
     snapshot_interval_seconds: float = 300.0
     wal_max_bytes: int = 64 * 1024 * 1024
     keep_snapshots: int = 2
+    partitions: int = 1
+    partition_map: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -725,6 +746,38 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
             "recovery from a corrupted newest snapshot needs at least "
             "one older generation to fall back to"
         )
+    if not _int(du.partitions) or not 1 <= du.partitions <= 256:
+        errs.append(
+            "config.durability.partitions: must be an int in [1, 256] "
+            "(1 = the classic single WAL)"
+        )
+    if not isinstance(du.partition_map, dict):
+        errs.append(
+            "config.durability.partition_map: must be a mapping of "
+            '"Kind" or "namespace/Kind" to a partition index'
+        )
+    else:
+        for mk, mv in du.partition_map.items():
+            if not isinstance(mk, str) or not mk:
+                errs.append(
+                    "config.durability.partition_map: keys must be "
+                    'non-empty "Kind" or "namespace/Kind" strings'
+                )
+                break
+            if not _int(mv) or not (
+                _int(du.partitions) and 0 <= mv < max(du.partitions, 1)
+            ):
+                errs.append(
+                    f"config.durability.partition_map[{mk!r}]: must be "
+                    "a partition index in [0, config.durability."
+                    "partitions)"
+                )
+        if du.partition_map and _int(du.partitions) and du.partitions < 2:
+            errs.append(
+                "config.durability.partition_map: requires "
+                "config.durability.partitions > 1 (a single-partition "
+                "log has nothing to pin)"
+            )
     return errs
 
 

@@ -506,7 +506,8 @@ class ChaosHarness:
         return self.harness.cluster.durability
 
     def process_crash(self, tear_tail: bool = False,
-                      corrupt_snapshot: bool = False) -> dict:
+                      corrupt_snapshot: bool = False,
+                      tear_partition: int | None = None) -> dict:
         """The whole-process crash: optionally tear the WAL tail / corrupt
         the newest snapshot first (what a dying disk leaves behind), then
         drop the live store and recover from disk mid-plan —
@@ -514,7 +515,21 @@ class ChaosHarness:
         chaos proxy is disarmed for the recovery sequence itself (a store
         being REBUILT has no flaky-apiserver view to model; faults resume
         with the next step) and its stale-read memory is cleared: the
-        informer caches died with the process."""
+        informer caches died with the process.
+
+        tear_partition (partitioned durability only) tears ONE specific
+        partition's tail — the partition_wal_divergence fault: that
+        partition rewinds its unacknowledged record while the others
+        keep their possibly-later committed history, and recovery must
+        merge the diverged streams back consistently."""
+        if tear_partition is not None:
+            if getattr(self._durable, "num_partitions", 1) <= 1:
+                raise ValueError(
+                    "tear_partition requires a partitioned durable log "
+                    "(config.durability.partitions > 1)"
+                )
+            self._record("partition_wal_divergence")
+            self._durable.tear_partition(tear_partition)
         if tear_tail:
             self._record("wal_torn_write")
             self._durable.tear_tail()
@@ -559,6 +574,26 @@ class ChaosHarness:
         if plan.disk_stall_rate > 0 and plan.flip(plan.disk_stall_rate):
             self._record("disk_stall")
             self._durable.stall(2 + plan.pick(4))
+        # partition-scoped faults: rate-guarded AND capability-guarded
+        # on the log actually being partitioned, so pre-existing seeds
+        # (and single-WAL durability runs) keep their exact sequences
+        num_parts = getattr(self._durable, "num_partitions", 1)
+        if (
+            plan.partition_divergence_rate > 0 and num_parts > 1
+            and plan.flip(plan.partition_divergence_rate)
+        ):
+            # the crash IS the fault: divergence only matters when the
+            # process dies with one partition's tail torn (recorded
+            # inside process_crash)
+            self.process_crash(tear_partition=plan.pick(num_parts))
+        if (
+            plan.partition_stall_rate > 0 and num_parts > 1
+            and plan.flip(plan.partition_stall_rate)
+        ):
+            self._record("partition_disk_stall")
+            self._durable.stall_partition(
+                plan.pick(num_parts), 2 + plan.pick(4)
+            )
 
     def _repair_shards(self) -> None:
         """Disarm-time repair: crashed workers revive (fresh process,

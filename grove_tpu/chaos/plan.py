@@ -135,6 +135,25 @@ class FaultPlan:
     snapshot_corruption_rate: float = 0.0
     disk_stall_rate: float = 0.0
 
+    # partitioned-WAL faults (per chaos step; meaningful only when the
+    # durable store runs config.durability.partitions > 1 — skipped
+    # entirely otherwise, and DEFAULT 0 with runtime draws guarded on
+    # rate > 0, so every pre-existing seed's draw sequence — and its
+    # verified convergence — is bit-identical).
+    #   partition_wal_divergence — the process crashes with ONE seeded
+    #                              partition's WAL tail torn while the
+    #                              other partitions keep their (possibly
+    #                              later) committed records: recovery
+    #                              must rewind only the unacknowledged
+    #                              record and merge the diverged streams
+    #                              back to a consistent store
+    #   partition_disk_stall     — ONE seeded partition's disk stalls
+    #                              for a few steps: its snapshot cuts
+    #                              defer (its replay grows) while every
+    #                              other partition keeps its cadence
+    partition_divergence_rate: float = 0.0
+    partition_stall_rate: float = 0.0
+
     # elastic-serving faults (per chaos step; meaningful only when the
     # harness runs with config.serving.enabled — skipped entirely
     # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the

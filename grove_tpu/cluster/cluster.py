@@ -80,9 +80,17 @@ class Cluster:
         # generation before any append.
         self.durability = None
         if self.config.durability.wal_dir:
-            from .durability import DurableLog
+            from .durability import DurableLog, PartitionedLog
 
-            self.durability = DurableLog(
+            # partitions > 1: the write path splits by (namespace, kind)
+            # into K independent WAL/snapshot chains behind the same
+            # facade (cluster/durability.PartitionedLog)
+            log_cls = (
+                PartitionedLog
+                if self.config.durability.partitions > 1
+                else DurableLog
+            )
+            self.durability = log_cls(
                 self.config.durability, clock=self.clock,
                 metrics=self.metrics,
                 resume=recovered_store is not None,

@@ -82,6 +82,17 @@ class WriteBatch:
                                   once (event-count compaction rides
                                   this).
 
+    Both take an optional `partition_key=(namespace, kind)` naming the
+    store object the task will write. When the store's durable write
+    path is partitioned (cluster/durability.PartitionedLog), the flush
+    keeps ONE global write order but tracks slow-start state PER
+    PARTITION: a failing write halts only its own partition's remainder
+    (failed + skipped re-queue as before), while every other partition's
+    tasks keep flushing in their original slots — partitions fail
+    independently, the way their WALs commit independently, and the
+    success-path write order (and therefore the journaled seq history)
+    is IDENTICAL to the unpartitioned plane's.
+
     Ordering: first-enqueue order per key (a replaced put keeps its
     original slot), so flush-time write order is deterministic.
     """
@@ -89,24 +100,28 @@ class WriteBatch:
     __slots__ = ("_tasks",)
 
     def __init__(self) -> None:
-        #: key -> [name, fn, items-or-None]; dict insertion order is the
-        #: flush order
+        #: key -> [name, fn, items-or-None, partition_key-or-None]; dict
+        #: insertion order is the flush order (within a partition group)
         self._tasks: dict = {}
 
     def __len__(self) -> int:
         return len(self._tasks)
 
-    def put(self, key, name: str, fn: Callable[[], None]) -> bool:
+    def put(self, key, name: str, fn: Callable[[], None],
+            partition_key: tuple[str, str] | None = None) -> bool:
         """Enqueue a last-wins write task. Returns True when it coalesced
         over (replaced) an earlier task for the same key."""
         existed = key in self._tasks
         if existed:
-            self._tasks[key][1] = fn
+            entry = self._tasks[key]
+            entry[1] = fn
+            entry[3] = partition_key  # last-wins covers the routing too
         else:
-            self._tasks[key] = [name, fn, None]
+            self._tasks[key] = [name, fn, None, partition_key]
         return existed
 
-    def append(self, key, name: str, fn, item) -> bool:
+    def append(self, key, name: str, fn, item,
+               partition_key: tuple[str, str] | None = None) -> bool:
         """Enqueue an accumulating task: at flush, `fn(items)` runs once
         with every item appended for this key. Returns True when the item
         joined an existing task (coalesced)."""
@@ -114,30 +129,82 @@ class WriteBatch:
         if entry is not None:
             entry[2].append(item)
             return True
-        self._tasks[key] = [name, fn, [item]]
+        self._tasks[key] = [name, fn, [item], partition_key]
         return False
 
-    def flush(self) -> RunResult:
+    def flush(self, partition_of: Callable[[str, str], int] | None = None,
+              ) -> RunResult:
         """Run every pending task through the slow-start batcher and
         clear. Tasks enqueued DURING the flush (a write handler recording
         a follow-on event) land in the next round's batch. Failed and
         slow-start-skipped tasks are RE-QUEUED for the next flush (their
         fns re-derive from live state, so a late retry stays correct) —
         a transient store fault costs one probe write and a round of
-        latency, never a lost status."""
+        latency, never a lost status.
+
+        `partition_of(namespace, kind) -> int` (the durable layer's
+        router) runs the slow-start pacing PER write-path partition
+        while keeping the single global enqueue order: one partition's
+        failure halts only that partition's remaining tasks, and the
+        writes that do land commit in exactly the order the
+        unpartitioned plane would have used (bit-identical journaled
+        history). Tasks without a partition_key share one residual
+        pacing group."""
         tasks, self._tasks = self._tasks, {}
         if not tasks:
             return RunResult()
-        result = run_with_slow_start([
-            (name, fn if items is None else (lambda f=fn, it=items: f(it)))
-            for name, fn, items in tasks.values()
-        ])
+        if partition_of is None:
+            result = run_with_slow_start([
+                (name, fn if items is None else (lambda f=fn, it=items: f(it)))
+                for name, fn, items, _pk in tasks.values()
+            ])
+        else:
+            result = self._flush_partitioned(tasks, partition_of)
         if result.errors or result.skipped:
             retry = {n for n, _ in result.errors}
             retry.update(result.skipped)
             for key, entry in tasks.items():
                 if entry[0] in retry and key not in self._tasks:
                     self._tasks[key] = entry
+        return result
+
+    @staticmethod
+    def _flush_partitioned(tasks: dict, partition_of) -> RunResult:
+        """Global enqueue order, per-partition slow start: each
+        partition grows its own exponential batch window (1 -> 2 -> 4);
+        a batch containing an error finishes, then that partition alone
+        halts — a failing store sees one probe write per partition, and
+        healthy partitions' writes land in their original slots."""
+        result = RunResult()
+        state: dict = {}
+        for name, fn, items, pk in tasks.values():
+            part = partition_of(*pk) if pk is not None else None
+            st = state.get(part)
+            if st is None:
+                st = state[part] = {
+                    "batch": max(1, INITIAL_BATCH_SIZE),
+                    "run": 0, "failed": False, "halted": False,
+                }
+            if st["halted"]:
+                result.skipped.append(name)
+                continue
+            try:
+                if items is None:
+                    fn()
+                else:
+                    fn(items)
+            except Exception as err:  # collected, the batch finishes
+                result.errors.append((name, err))
+                st["failed"] = True
+            else:
+                result.succeeded.append(name)
+            st["run"] += 1
+            if st["run"] >= st["batch"]:
+                if st["failed"]:
+                    st["halted"] = True
+                else:
+                    st["batch"] *= 2
+                    st["run"] = 0
         return result
 
 

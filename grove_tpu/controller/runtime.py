@@ -719,12 +719,19 @@ class ControllerManager:
         batch = self.round_batch
         if batch is None or not len(batch):
             return
+        # partitioned durable write path: group the flush by WAL
+        # partition so one partition's failure never halts another's
+        # writes (cluster/durability.PartitionedLog.partition_of; None
+        # on the classic single-WAL or memory-only store)
+        partition_of = getattr(
+            getattr(self.store, "durability", None), "partition_of", None
+        )
         try:
             if self.identity is not None:
                 with self.store.impersonate(self.identity):
-                    result = batch.flush()
+                    result = batch.flush(partition_of=partition_of)
             else:
-                result = batch.flush()
+                result = batch.flush(partition_of=partition_of)
         except Exception as exc:  # defensive: flush itself must not kill
             self._record_error_entry(
                 "round-writes", Request("", "flush"), str(exc)

@@ -841,6 +841,9 @@ class GangScheduler:
                     (PodGang.KIND, "phase", key),
                     f"gang-phase/{key[0]}/{key[1]}",
                     lambda key=key: self._flush_phase(key),
+                    # the flush patches the PodGang's status: partition
+                    # key for the partitioned durable write path
+                    partition_key=(key[0], PodGang.KIND),
                 )
             return
         for key in sorted(keys):

@@ -121,6 +121,9 @@ class EventRecorder:
                     ns, name, records
                 ),
                 record,
+                # the flush writes a ClusterEvent in ns: the partition
+                # key a partitioned durable store groups the flush by
+                partition_key=(ns, ClusterEvent.KIND),
             )
             return
         self._commit(ns, name, [record])

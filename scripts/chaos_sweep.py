@@ -179,6 +179,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              tenant_skew: bool = False,
              shards: int = 1,
              durability: bool = False,
+             partitions: int = 1,
              serving: bool = False,
              hierarchical: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
@@ -203,6 +204,15 @@ def run_seed(seed: int, nodes: int, baseline: dict,
             snapshot_corruption_rate=0.3,
             disk_stall_rate=0.1,
         )
+        if partitions > 1:
+            # the partitioned-WAL fault axis on top: crashes with ONE
+            # partition's tail torn (divergent streams merged back at
+            # recovery) and per-partition disk stalls (one partition's
+            # snapshot cadence defers while the others keep theirs)
+            overrides.update(
+                partition_divergence_rate=0.2,
+                partition_stall_rate=0.15,
+            )
         import tempfile
 
         wal_tmp = tempfile.TemporaryDirectory(prefix=f"grove-wal-{seed}-")
@@ -231,7 +241,11 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     if wal_tmp is not None:
         config = {
             **config,
-            "durability": {**DURABILITY_CONFIG, "wal_dir": wal_tmp.name},
+            "durability": {
+                **DURABILITY_CONFIG,
+                "wal_dir": wal_tmp.name,
+                "partitions": max(partitions, 1),
+            },
         }
     try:
         return _run_seed_inner(
@@ -364,7 +378,19 @@ def main(argv=None) -> int:
                          "corrupted snapshots (recovery falls back to "
                          "the previous retained generation), and disk "
                          "stalls; convergence is checked against the "
-                         "same fault-free fixpoint")
+                         "same fault-free fixpoint. Composable with "
+                         "--shards N (whole-fleet process crashes "
+                         "recover the sharded control plane from disk "
+                         "mid-plan) and --partitions K")
+    ap.add_argument("--partitions", type=int, default=1,
+                    help="with --durability: run the durable store "
+                         "PARTITIONED into K per-(namespace, kind) WAL/"
+                         "snapshot chains (cluster/durability."
+                         "PartitionedLog) and add the partition-scoped "
+                         "fault axis — crashes with one partition's "
+                         "tail torn (divergent streams merged back at "
+                         "recovery) and per-partition disk stalls; "
+                         "1 = the classic single WAL")
     ap.add_argument("--serving", action="store_true",
                     help="arm the elastic-serving fault axis: serving is "
                          "configured with a FLAT traffic trace feeding "
@@ -398,6 +424,9 @@ def main(argv=None) -> int:
                          "skew leaves at disarm, so convergence is "
                          "checked against the same fault-free fixpoint")
     args = ap.parse_args(argv)
+    if args.partitions > 1 and not args.durability:
+        ap.error("--partitions requires --durability (there is no WAL "
+                 "to partition without it)")
     trace_dir = None
     if args.trace_dir:
         trace_dir = Path(args.trace_dir)
@@ -440,6 +469,7 @@ def main(argv=None) -> int:
                           tenant_skew=args.tenant_skew,
                           shards=args.shards,
                           durability=args.durability,
+                          partitions=args.partitions,
                           serving=args.serving,
                           hierarchical=args.hierarchical)
         print(json.dumps(result), flush=True)
@@ -452,6 +482,7 @@ def main(argv=None) -> int:
         "nodes": args.nodes,
         "shards": args.shards,
         "durability": args.durability,
+        "partitions": args.partitions,
         "serving": args.serving,
         "hierarchical": args.hierarchical,
         "failed_seeds": failed,

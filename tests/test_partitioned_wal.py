@@ -1,0 +1,524 @@
+"""Partitioned durable write path: per-partition WALs behind one store.
+
+The contract (cluster/durability.PartitionedLog): with
+`DurabilityConfig.partitions` = K every committed mutation routes by
+(namespace, kind) to one of K independent WAL segment chains + snapshot
+generations, the store keeps its single logical seq/event-log for watch
+semantics, and recovery — per-partition snapshot selection with the
+classic corruption fallback + quarantine, then ONE globally seq-ordered
+merged replay — rebuilds a store BIT-IDENTICAL to what a single WAL of
+the same write history recovers, including torn tails and corrupt
+snapshots on individual partitions. The round-scoped WriteBatch groups
+its flush by partition so one partition's failure never blocks or
+reorders another's writes.
+"""
+
+import io
+import random
+
+import pytest
+
+from grove_tpu.api.config import load_operator_config
+from grove_tpu.api.types import PodCliqueSet
+from grove_tpu.chaos import (
+    ChaosHarness,
+    FaultPlan,
+    check_invariants,
+    settled_fingerprint,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.cluster.clock import SimClock
+from grove_tpu.cluster.durability import DurabilityError, PartitionedLog
+from grove_tpu.cluster.store import ObjectStore
+from grove_tpu.controller import Harness
+from grove_tpu.controller.concurrency import WriteBatch
+from grove_tpu.observability import MetricsRegistry
+
+from test_durability import DUR, assert_bit_identical
+from test_e2e_basic import clique, simple_pcs
+
+NODES = 16
+
+
+def part_config(wal_dir, partitions=4, **overrides):
+    return {
+        "durability": {
+            **DUR, "wal_dir": str(wal_dir), "partitions": partitions,
+            **overrides,
+        }
+    }
+
+
+def part_harness(tmp_path, partitions=4, nodes=NODES, **config):
+    cfg = part_config(tmp_path / "wal", partitions)
+    cfg.update(config)
+    return Harness(nodes=make_nodes(nodes), config=cfg)
+
+
+def durability_cfg(wal_dir, partitions=1, **overrides):
+    """A validated DurabilityConfig (the PartitionedLog constructor's
+    input)."""
+    return load_operator_config({
+        "durability": {
+            **DUR, "wal_dir": str(wal_dir), "partitions": partitions,
+            **overrides,
+        }
+    }).durability
+
+
+def seeded_history(h: Harness, seed: int) -> None:
+    """Drive a seeded multi-namespace write history: applies, spec
+    updates, deletes and clock advances — the same op sequence lands on
+    any harness given the same seed, which is what lets a partitioned
+    and a single-WAL store journal the IDENTICAL history."""
+    rng = random.Random(f"part-hist-{seed}")
+    names = []
+    for i in range(3 + rng.randrange(3)):
+        ns = f"ns{rng.randrange(4)}"
+        name = f"w{seed}-{i}"
+        pcs = simple_pcs(
+            cliques=[clique("w", replicas=1 + rng.randrange(3))],
+            name=name,
+        )
+        pcs.metadata.namespace = ns
+        h.apply(pcs)
+        names.append((ns, name))
+        if rng.random() < 0.5:
+            h.settle()
+    h.settle()
+    if names and rng.random() < 0.7:
+        ns, name = names[rng.randrange(len(names))]
+        pcs = h.store.get(PodCliqueSet.KIND, ns, name)
+        pcs.spec.replicas = 1 + rng.randrange(2)
+        h.store.update(pcs)
+        h.settle()
+    if len(names) > 1 and rng.random() < 0.7:
+        ns, name = names.pop(rng.randrange(len(names)))
+        h.store.delete(PodCliqueSet.KIND, ns, name)
+        h.settle()
+    h.advance(35.0)  # at least one snapshot cadence boundary
+
+
+class TestPartitionedRoundTrip:
+    def test_recover_is_bit_identical_and_merged(self, tmp_path):
+        h = part_harness(tmp_path)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        h.settle()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        stats = recovered.recovery_stats
+        assert stats["outcome"] == "clean"
+        assert set(stats["partitions"]) == {
+            "p000", "p001", "p002", "p003"
+        }
+        assert_bit_identical(recovered, h.store)
+
+    def test_writes_actually_spread_across_partitions(self, tmp_path):
+        h = part_harness(tmp_path)
+        seeded_history(h, 0)
+        per = [
+            p.wal_records_total
+            for p in h.cluster.durability.partitions
+        ]
+        assert sum(1 for n in per if n > 0) >= 2, per
+        assert sum(per) == h.cluster.durability.wal_records_total
+
+    def test_cold_restart_settles_to_identical_fixpoint(self, tmp_path):
+        h = part_harness(tmp_path)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        h.settle()
+        fixpoint = settled_fingerprint(h.store)
+        stats = h.cold_restart()
+        assert stats["outcome"] == "clean"
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+        assert check_invariants(h.store) == []
+
+    def test_new_process_boot_resumes_partitioned_journal(self, tmp_path):
+        cfg = part_config(tmp_path / "wal")
+        old = Harness(nodes=make_nodes(NODES), config=cfg)
+        old.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        old.settle()
+        fixpoint = settled_fingerprint(old.store)
+        old.cluster.durability.close()
+        del old
+        h = Harness.recover(cfg)
+        h.settle()
+        assert settled_fingerprint(h.store) == fixpoint
+        # journaling resumed into the same partition layout
+        h.apply(simple_pcs(cliques=[clique("z", replicas=1)],
+                           name="after-boot"))
+        h.settle()
+        again = ObjectStore.recover(str(tmp_path / "wal"))
+        assert_bit_identical(again, h.store)
+
+
+class TestRecoveryEquivalenceGate:
+    """The acceptance gate: for 10 seeds, cold recovery from
+    partitioned WALs is bit-identical to the single-WAL recovery of the
+    SAME write history — objects, retained event log, compaction
+    horizon, kind serials, seq/uid counters — including torn-tail and
+    corrupt-snapshot cases on individual partitions."""
+
+    SEEDS = tuple(range(10))
+
+    def _pair(self, tmp_path, seed):
+        hp = Harness(
+            nodes=make_nodes(NODES),
+            config=part_config(tmp_path / f"p{seed}"),
+        )
+        hs = Harness(
+            nodes=make_nodes(NODES),
+            config={"durability": {
+                **DUR, "wal_dir": str(tmp_path / f"s{seed}")
+            }},
+        )
+        for h in (hp, hs):
+            seeded_history(h, seed)
+        assert hp.store.last_seq == hs.store.last_seq  # same history
+        return hp, hs
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_partitioned_recovery_matches_single_wal(
+        self, seed, tmp_path
+    ):
+        hp, hs = self._pair(tmp_path, seed)
+        rng = random.Random(f"part-fault-{seed}")
+        dur = hp.cluster.durability
+        case = rng.randrange(3)
+        if case == 1:
+            # torn tail on ONE partition: the in-flight garbage is
+            # unacknowledged, so recovery still yields the full
+            # committed history the single WAL recovers
+            dur.tear_partition(rng.randrange(dur.num_partitions))
+        elif case == 2 and dur.snapshot_seqs():
+            # corrupt one partition's newest snapshot: that partition
+            # falls back a generation (quarantining the image) and
+            # replays the longer suffix — same final store
+            dur.corrupt_partition_snapshot(
+                rng.randrange(dur.num_partitions)
+            )
+        rp = ObjectStore.recover(str(tmp_path / f"p{seed}"))
+        rs = ObjectStore.recover(str(tmp_path / f"s{seed}"))
+        assert_bit_identical(rp, rs)
+        assert_bit_identical(rp, hs.store)
+        assert settled_fingerprint(rp) == settled_fingerprint(rs)
+
+    def test_every_fault_case_appeared(self, tmp_path):
+        """The seeded case draw must actually cover clean, torn and
+        corrupt across the matrix (a vacuous gate must not read as
+        coverage)."""
+        cases = {
+            random.Random(f"part-fault-{seed}").randrange(3)
+            for seed in self.SEEDS
+        }
+        assert cases == {0, 1, 2}
+
+    def test_compaction_merges_identically(self, tmp_path):
+        hp, hs = self._pair(tmp_path, 99)
+        for h in (hp, hs):
+            h.compact_events()
+            h.apply(simple_pcs(cliques=[clique("after", replicas=1)],
+                               name="post-compact"))
+            h.settle()
+        rp = ObjectStore.recover(str(tmp_path / "p99"))
+        rs = ObjectStore.recover(str(tmp_path / "s99"))
+        assert rp.compaction_horizon > 0
+        assert_bit_identical(rp, rs)
+
+
+class TestPartitionRouting:
+    def test_partition_map_pins_kinds(self, tmp_path):
+        cfg = durability_cfg(
+            tmp_path / "w", partitions=4,
+            partition_map={"Pod": 3, "ns1/Pod": 1},
+        )
+        log = PartitionedLog(cfg, SimClock())
+        assert log.partition_of("default", "Pod") == 3
+        assert log.partition_of("anywhere", "Pod") == 3
+        # the namespace-qualified pin wins over the bare kind
+        assert log.partition_of("ns1", "Pod") == 1
+
+    def test_unpinned_kinds_hash_stably(self, tmp_path):
+        cfg = durability_cfg(tmp_path / "w", partitions=4)
+        log = PartitionedLog(cfg, SimClock())
+        seen = {
+            log.partition_of(f"ns{i}", "Pod") for i in range(16)
+        }
+        assert len(seen) > 1  # namespaces actually spread
+        assert log.partition_of("ns0", "Pod") == log.partition_of(
+            "ns0", "Pod"
+        )
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="partitions"):
+            load_operator_config(
+                {"durability": {"partitions": 0}}
+            )
+        with pytest.raises(ValueError, match="partition_map"):
+            load_operator_config(
+                {"durability": {"partitions": 4,
+                                "partition_map": {"Pod": 9}}}
+            )
+        with pytest.raises(ValueError, match="partition_map"):
+            load_operator_config(
+                {"durability": {"partition_map": {"Pod": 0}}}
+            )
+
+
+class TestLayoutGuards:
+    def test_fresh_partitioned_refuses_populated_dir(self, tmp_path):
+        part_harness(tmp_path)
+        with pytest.raises(DurabilityError, match="already holds"):
+            part_harness(tmp_path)
+
+    def test_resume_refuses_changed_partition_count(self, tmp_path):
+        cfg = part_config(tmp_path / "wal", partitions=4)
+        h = Harness(nodes=make_nodes(4), config=cfg)
+        h.cluster.durability.close()
+        del h
+        with pytest.raises(DurabilityError, match="layout"):
+            Harness.recover(part_config(tmp_path / "wal", partitions=2))
+
+    def test_classic_log_refuses_partitioned_dir(self, tmp_path):
+        cfg = part_config(tmp_path / "wal", partitions=4)
+        h = Harness(nodes=make_nodes(4), config=cfg)
+        h.cluster.durability.close()
+        del h
+        with pytest.raises(DurabilityError, match="partitioned"):
+            Harness.recover(
+                {"durability": {**DUR, "wal_dir": str(tmp_path / "wal")}}
+            )
+
+    def test_partitioned_log_refuses_single_wal_dir(self, tmp_path):
+        h = Harness(
+            nodes=make_nodes(4),
+            config={"durability": {**DUR,
+                                   "wal_dir": str(tmp_path / "wal")}},
+        )
+        h.cluster.durability.close()
+        del h
+        with pytest.raises(DurabilityError, match="single-WAL"):
+            PartitionedLog(
+                durability_cfg(tmp_path / "wal", partitions=2),
+                SimClock(),
+            )
+
+    def test_recovery_refuses_a_vanished_partition_dir(self, tmp_path):
+        """A missing pNNN directory is LOST HISTORY, not a smaller
+        deployment — recovery must refuse the incomplete set instead of
+        handing back a silently holey store."""
+        import shutil
+
+        h = part_harness(tmp_path)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        h.cluster.durability.close()
+        shutil.rmtree(tmp_path / "wal" / "p002")
+        with pytest.raises(DurabilityError, match="incomplete"):
+            ObjectStore.recover(str(tmp_path / "wal"))
+
+    def test_ambiguous_dir_fails_loud(self, tmp_path):
+        h = part_harness(tmp_path)
+        h.cluster.durability.close()
+        # drop a classic segment next to the partition dirs
+        (tmp_path / "wal" / f"wal-{0:020d}.log").write_bytes(b"GRVWAL1\n")
+        with pytest.raises(DurabilityError, match="BOTH"):
+            ObjectStore.recover(str(tmp_path / "wal"))
+
+
+class TestPartitionMetrics:
+    def test_partition_labeled_series_and_totals(self, tmp_path):
+        h = part_harness(tmp_path)
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        m = h.cluster.metrics
+        ctr = m.counter("grove_store_wal_records_total")
+        series = {
+            s["partition"] for s in ctr.label_sets() if "partition" in s
+        }
+        assert len(series) >= 2
+        dump = h.debug_dump()["store"]["durability"]
+        assert ctr.total() == dump["wal_records_total"]
+        assert dump["partitions"] == 4
+        assert set(dump["per_partition"]) == {
+            "p000", "p001", "p002", "p003"
+        }
+        assert m.gauge("grove_store_partitions").value() == 4.0
+
+    def test_stale_partition_series_leave_metrics(self, tmp_path):
+        """The hygiene regression (the PR 8 shard-series shape): a
+        registry that outlives a wider layout must not export dead pNNN
+        series forever — PartitionedLog reconciles its families at
+        construction."""
+        reg = MetricsRegistry()
+        for fam in PartitionedLog.METRIC_FAMILIES:
+            ctr = reg.counter(fam, "x")
+            ctr.inc()  # the unlabeled classic series must survive
+            for p in range(4):
+                ctr.inc(partition=str(p))
+        PartitionedLog(
+            durability_cfg(tmp_path / "w", partitions=2), SimClock(),
+            metrics=reg,
+        )
+        for fam in PartitionedLog.METRIC_FAMILIES:
+            parts = {
+                s.get("partition")
+                for s in reg.counter(fam).label_sets()
+            }
+            assert parts == {None, "0", "1"}, fam
+
+
+class TestPartitionAwareWriteBatch:
+    def test_partition_failure_requeues_without_blocking_others(self):
+        """The satellite contract: a failed task on partition A requeues
+        (with its slow-start-skipped remainder) while partition B's
+        flush lands whole, in enqueue order."""
+        done = []
+
+        def ok(name):
+            return lambda: done.append(name)
+
+        def boom():
+            raise RuntimeError("store down")
+
+        wb = WriteBatch()
+        wb.put("a1", "a1", boom, partition_key=("nsa", "Pod"))
+        wb.put("a2", "a2", ok("a2"), partition_key=("nsa", "Pod"))
+        wb.put("b1", "b1", ok("b1"), partition_key=("nsb", "Pod"))
+        wb.put("b2", "b2", ok("b2"), partition_key=("nsb", "Pod"))
+        result = wb.flush(
+            partition_of=lambda ns, kind: 0 if ns == "nsa" else 1
+        )
+        assert done == ["b1", "b2"]  # B flushed whole, in order
+        assert [n for n, _ in result.errors] == ["a1"]
+        assert result.skipped == ["a2"]  # A's remainder slow-start-skips
+        assert len(wb) == 2  # a1 + a2 requeued, b tasks are NOT
+        # the retry flush (fault cleared) lands the requeued partition
+        wb._tasks["a1"][1] = ok("a1")
+        retry = wb.flush(
+            partition_of=lambda ns, kind: 0 if ns == "nsa" else 1
+        )
+        assert not retry.has_errors and done == ["b1", "b2", "a1", "a2"]
+
+    def test_unkeyed_tasks_share_the_residual_group(self):
+        done = []
+        wb = WriteBatch()
+        wb.put("a", "a", lambda: done.append("a"),
+               partition_key=("ns", "Pod"))
+        wb.put("x", "x", lambda: done.append("x"))  # no partition key
+        result = wb.flush(partition_of=lambda ns, kind: 7)
+        assert not result.has_errors
+        assert done == ["a", "x"]  # global enqueue order preserved
+
+    def test_without_partitioner_failure_halts_the_round(self):
+        """The classic single-WAL behavior is unchanged: no partitioner
+        means one slow-start run over everything."""
+        done = []
+        wb = WriteBatch()
+        wb.put("a1", "a1", lambda: (_ for _ in ()).throw(RuntimeError()),
+               partition_key=("nsa", "Pod"))
+        wb.put("b1", "b1", lambda: done.append("b1"),
+               partition_key=("nsb", "Pod"))
+        result = wb.flush()
+        assert done == []
+        assert result.skipped == ["b1"]
+        assert len(wb) == 2
+
+    def test_manager_flush_routes_by_store_partition(self, tmp_path):
+        """e2e: a partitioned durable harness with round batching wires
+        the durable router into the flush (the settle exercising it
+        must land partition-labeled WAL series from batched writes)."""
+        h = part_harness(tmp_path)
+        assert h.config.controllers.round_write_batching
+        assert h.store.durability.partition_of("a", "Pod") is not None
+        h.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        h.settle()
+        recovered = ObjectStore.recover(str(tmp_path / "wal"))
+        assert_bit_identical(recovered, h.store)
+
+
+@pytest.mark.chaos
+class TestPartitionedChaos:
+    """Partition-scoped faults (partition_wal_divergence: a crash with
+    one partition's tail torn while the others keep later committed
+    records; partition_disk_stall: one partition's snapshot cadence
+    defers) — convergent to the fault-free fixpoint, draw-guarded so
+    every pre-existing seed replays bit-identically."""
+
+    SEEDS = (0, 1, 2)
+
+    @pytest.fixture(scope="class")
+    def baseline(self):
+        h = Harness(nodes=make_nodes(NODES))
+        h.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        h.settle()
+        return settled_fingerprint(h.store)
+
+    def _run(self, seed, tmp_path, partitions=4):
+        plan = FaultPlan.from_seed(
+            seed,
+            process_crash_rate=0.12,
+            wal_torn_write_rate=0.3,
+            snapshot_corruption_rate=0.25,
+            partition_divergence_rate=0.25,
+            partition_stall_rate=0.2,
+        )
+        ch = ChaosHarness(
+            plan, nodes=make_nodes(NODES),
+            config=part_config(tmp_path / f"wal{seed}", partitions),
+        )
+        quiet = io.StringIO()
+        ch.harness.cluster.logger.stream = quiet
+        ch.harness.manager.logger.stream = quiet
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=3)]))
+        ch.run_chaos()
+        return ch
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_partition_fault_seeds_converge(self, seed, tmp_path, baseline):
+        ch = self._run(seed, tmp_path)
+        assert settled_fingerprint(ch.raw_store) == baseline, (
+            f"seed {seed} diverged (faults: {ch.plan.counts}, "
+            f"recoveries: {ch.recovery_stats})"
+        )
+        assert check_invariants(ch.raw_store) == []
+
+    def test_matrix_fired_partition_faults(self, tmp_path, baseline):
+        counts: dict = {}
+        for seed in self.SEEDS:
+            ch = self._run(seed, tmp_path)
+            for k, v in ch.plan.counts.items():
+                counts[k] = counts.get(k, 0) + v
+        assert counts.get("partition_wal_divergence", 0) > 0
+        assert counts.get("partition_disk_stall", 0) > 0
+
+    def test_partition_draws_skipped_on_single_wal(self, tmp_path):
+        """Capability guard: the same plan over UNPARTITIONED durability
+        must never fire a partition fault (and the draws are skipped
+        entirely, keeping single-WAL seeds' sequences intact)."""
+        plan = FaultPlan.from_seed(
+            0,
+            partition_divergence_rate=0.9,
+            partition_stall_rate=0.9,
+        )
+        ch = ChaosHarness(
+            plan, nodes=make_nodes(NODES),
+            config={"durability": {
+                **DUR, "wal_dir": str(tmp_path / "wal")
+            }},
+        )
+        quiet = io.StringIO()
+        ch.harness.cluster.logger.stream = quiet
+        ch.harness.manager.logger.stream = quiet
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.run_chaos()
+        assert "partition_wal_divergence" not in ch.plan.counts
+        assert "partition_disk_stall" not in ch.plan.counts
+
+    def test_seed_is_bit_reproducible(self, tmp_path):
+        a = self._run(1, tmp_path / "a")
+        b = self._run(1, tmp_path / "b")
+        assert a.plan.counts == b.plan.counts
+        assert settled_fingerprint(a.raw_store) == settled_fingerprint(
+            b.raw_store
+        )
