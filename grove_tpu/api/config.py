@@ -222,6 +222,14 @@ class TenancyConfig:
     #: PodGang/PodCliqueSet label naming the owning tenant; namespace ==
     #: tenant name is the fallback attribution
     tenant_label: str = "grove.io/tenant"
+    #: rolling virtual-time window over which a tenant's
+    #: disruption_budget is shared across EVERY disruption consumer
+    #: (preemption and the defragmenter draw from one ledger — see
+    #: tenancy.DisruptionLedger): evictions charged within the window
+    #: count against the budget no matter who spent them, so a
+    #: preemption round followed by a defrag sweep can never
+    #: double-spend it
+    disruption_budget_window_seconds: float = 60.0
     #: tenant for gangs that match no configured tenant ("" = exempt:
     #: admitted untracked with zero fairness weight)
     default_tenant: str = ""
@@ -238,6 +246,47 @@ class TenancyConfig:
         default_factory=lambda: [dict(t) for t in DEFAULT_TENANCY_TIERS]
     )
     tenants: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class DefragConfig:
+    """Continuous defragmentation (controller/defrag.py): a background
+    re-pack optimizer that closes the gap between the live placement and
+    a fresh solve. Each sweep scores candidate gangs (worst placement
+    score first) as dirty-row WHAT-IFs against the solver's
+    device-resident state (PlacementEngine.whatif_scores — never a full
+    re-encode), admits moves whose score gain net of migration cost
+    clears `min_score_gain`, and executes them make-before-break through
+    the drain/eviction path: the destination is verified to fit in
+    CURRENTLY-free capacity and held as a migration ticket before the
+    source is evicted, so a migration can never strand a gang unplaced.
+    Every admitted AND rejected candidate lands in the DecisionLog as a
+    migration audit (gain, cost, budget state, verdict).
+
+      enabled                   off by default — defrag evicts running
+                                gangs; opting in is deliberate
+      sync_interval_seconds     sweep cadence (Harness.maybe_defrag)
+      min_score_gain            a move's NET gain (new score - current
+                                score - migration_cost_score) must clear
+                                this threshold to be admitted
+      migration_cost_score      flat score-unit cost charged per move
+                                (models the disruption of restarting the
+                                gang's pods)
+      max_moves_per_sweep       admitted moves per sweep (bounds burst
+                                disruption)
+      max_evictions_per_hour    rolling virtual-hour ceiling on defrag
+                                evictions fleet-wide (the migration-cost
+                                bound the long-churn bench gates on)
+      candidates_per_sweep      worst-scored gangs examined per sweep
+    """
+
+    enabled: bool = False
+    sync_interval_seconds: float = 120.0
+    min_score_gain: float = 0.05
+    migration_cost_score: float = 0.02
+    max_moves_per_sweep: int = 4
+    max_evictions_per_hour: float = 60.0
+    candidates_per_sweep: int = 32
 
 
 @dataclass
@@ -414,6 +463,7 @@ class OperatorConfig:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     solver: SolverConfig = field(default_factory=SolverConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
+    defrag: DefragConfig = field(default_factory=DefragConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
@@ -459,6 +509,7 @@ _TYPES = {
     "ClusterConfig": ClusterConfig,
     "SolverConfig": SolverConfig,
     "TenancyConfig": TenancyConfig,
+    "DefragConfig": DefragConfig,
     "AutoscalerConfig": AutoscalerConfig,
     "ServingConfig": ServingConfig,
     "AuthorizationConfig": AuthorizationConfig,
@@ -621,6 +672,7 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         )
 
     errs += _validate_tenancy(cfg.tenancy)
+    errs += _validate_defrag(cfg.defrag)
 
     le = cfg.leader_election
     if not isinstance(le.enabled, bool):
@@ -902,6 +954,30 @@ def _validate_serving(sv: ServingConfig) -> list[str]:
     return errs
 
 
+def _validate_defrag(df: DefragConfig) -> list[str]:
+    """Aggregated semantic validation of the defrag block."""
+    errs: list[str] = []
+    if not isinstance(df.enabled, bool):
+        errs.append("config.defrag.enabled: must be a bool")
+    if not _num(df.sync_interval_seconds) or df.sync_interval_seconds <= 0:
+        errs.append("config.defrag.sync_interval_seconds: must be > 0")
+    if not _num(df.min_score_gain) or df.min_score_gain <= 0:
+        # a zero threshold would admit churn-for-nothing moves: every
+        # tie would evict a running gang for an equal-score placement
+        errs.append("config.defrag.min_score_gain: must be > 0")
+    if not _num(df.migration_cost_score) or df.migration_cost_score < 0:
+        errs.append("config.defrag.migration_cost_score: must be >= 0")
+    if not _int(df.max_moves_per_sweep) or df.max_moves_per_sweep < 1:
+        errs.append("config.defrag.max_moves_per_sweep: must be an int >= 1")
+    if not _num(df.max_evictions_per_hour) or df.max_evictions_per_hour <= 0:
+        errs.append("config.defrag.max_evictions_per_hour: must be > 0")
+    if not _int(df.candidates_per_sweep) or df.candidates_per_sweep < 1:
+        errs.append(
+            "config.defrag.candidates_per_sweep: must be an int >= 1"
+        )
+    return errs
+
+
 def _validate_tenancy(tn: TenancyConfig) -> list[str]:
     """Aggregated semantic validation of the tenancy block. Structural
     problems (a malformed tier/tenant entry) short-circuit per entry so
@@ -913,6 +989,13 @@ def _validate_tenancy(tn: TenancyConfig) -> list[str]:
         errs.append("config.tenancy.tenant_label: must be a non-empty string")
     if not _num(tn.fairness_weight) or tn.fairness_weight < 0:
         errs.append("config.tenancy.fairness_weight: must be a number >= 0")
+    if (
+        not _num(tn.disruption_budget_window_seconds)
+        or tn.disruption_budget_window_seconds <= 0
+    ):
+        errs.append(
+            "config.tenancy.disruption_budget_window_seconds: must be > 0"
+        )
 
     tier_names: set[str] = set()
     if not isinstance(tn.tiers, list):

@@ -170,6 +170,9 @@ class ChaosHarness:
             # reconciled by two live workers in one round fails the seed
             # loudly instead of converging by luck
             sharded.audit = True
+        # defrag's disruption-budget audit rides every chaos sweep the
+        # same way: an overspent tenant budget fails the seed loudly
+        self._arm_defrag_audit()
 
     #: drain storms are capped per run: an unbounded storm could cordon
     #: the whole inventory out from under the workload, and a drained
@@ -243,6 +246,7 @@ class ChaosHarness:
         if sharded is not None:
             sharded.audit = True
             self._crashed_workers.clear()  # the rebuild revived everyone
+        self._arm_defrag_audit()  # the rebuilt controller starts unarmed
 
     # -- node-lifecycle faults ---------------------------------------------
     def _live_node_names(self) -> list[str]:
@@ -458,6 +462,87 @@ class ChaosHarness:
             self._record("metrics_dropout")
             pm = self.harness.cluster.pod_metrics
             pm.dropout_steps += 2 + plan.pick(4)
+
+    # -- continuous-defragmentation faults ------------------------------------
+    @property
+    def _defrag(self):
+        """The harness's DefragController when config.defrag.enabled,
+        else None (defrag faults and the chaotic sweep cadence are
+        skipped entirely — rate-guarded AND capability-guarded, so
+        pre-existing seeds replay identically either way)."""
+        h = self.harness
+        return h.defrag if h.config.defrag.enabled else None
+
+    def _arm_defrag_audit(self) -> None:
+        """Arm the defragmenter's disruption-budget audit (the PR 8
+        ownership-audit shape): a sweep that overspends any tenant's
+        budget raises instead of passing. Re-armed after every manager
+        restart — the rebuilt controller starts with the flag off."""
+        d = self._defrag
+        if d is not None:
+            d.audit = True
+
+    def _inject_defrag_faults(self) -> None:
+        """Per-step defrag fault draws (see FaultPlan): a forced
+        migration storm, composed with a crash mid-migration (tickets
+        are soft state) and/or a destination-node fault before the
+        re-bind. Every draw is guarded on rate > 0 AND on defrag being
+        configured."""
+        from ..cluster.inventory import RACK_KEY
+
+        plan = self.plan
+        d = self._defrag
+        if d is None:
+            return
+        if plan.migration_storm_rate > 0 and plan.flip(
+            plan.migration_storm_rate
+        ):
+            self._record("migration_storm")
+            try:
+                self.harness.defrag_sweep(storm=True)
+            except ManagerCrash:
+                self.restart_manager()
+            if plan.migration_crash_rate > 0 and plan.flip(
+                plan.migration_crash_rate
+            ):
+                # crash mid-migration: the staged tickets die with the
+                # scheduler's soft state; the evicted gangs re-place
+                # through the general solve (at worst onto their own
+                # just-vacated capacity)
+                self._record("migration_crash")
+                self.restart_manager()
+            dests = sorted(set(d.last_move_destinations))
+            if dests and plan.migration_node_fault_rate > 0 and plan.flip(
+                plan.migration_node_fault_rate
+            ):
+                # node fault during a move: a held destination dies
+                # before the re-bind. Same standing-fault guard as
+                # _inject_node_faults: never re-fail a node already
+                # under a heartbeat-level fault.
+                standing = set(self._flapping) | self._hb_lost
+                if self._outage_domains:
+                    outage = set(self._outage_domains)
+                    standing |= {
+                        n.metadata.name
+                        for n in self.raw_store.scan(Node.KIND)
+                        if n.metadata.labels.get(RACK_KEY) in outage
+                    }
+                name = dests[plan.pick(len(dests))]
+                if name not in standing and name in set(
+                    self._live_node_names()
+                ):
+                    self._record("migration_node_fault")
+                    self.harness.cluster.fail_node(name)
+                    self._flapping[name] = 1 + plan.pick(3)
+
+    def _chaos_defrag(self) -> None:
+        """The defrag sync loop keeps its config cadence THROUGH the
+        storm (defrag-enabled runs only): maybe_defrag without settling
+        — convergence is the interleaved manager rounds' job."""
+        try:
+            self.harness.maybe_defrag(settle=False)
+        except ManagerCrash:
+            self.restart_manager()
 
     def _chaos_autoscale(self) -> None:
         """The HPA sync loop keeps its config cadence THROUGH the storm
@@ -680,6 +765,7 @@ class ChaosHarness:
                 self._inject_shard_faults()
                 self._inject_durability_faults()
                 self._inject_serving_faults()
+                self._inject_defrag_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")
@@ -694,6 +780,10 @@ class ChaosHarness:
                     # config cadence (no-op without serving, so
                     # pre-existing seeds' sequences are untouched)
                     self._chaos_autoscale()
+                if self._defrag is not None:
+                    # the defrag sync loop likewise keeps its cadence
+                    # through the storm (no-op without defrag)
+                    self._chaos_defrag()
                 self._tick_node_faults()
                 if self._durable is not None:
                     self._durable.tick_stall()

@@ -175,6 +175,34 @@ class FaultPlan:
     traffic_spike_multiplier: float = 4.0
     metrics_dropout_rate: float = 0.0
 
+    # continuous-defragmentation faults (per chaos step; meaningful only
+    # when the harness runs config.defrag.enabled — skipped entirely
+    # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the
+    # tenant_skew/shard/durability/serving contract), so every
+    # pre-existing seed's draw sequence — and its verified convergence —
+    # is bit-identical.
+    #   migration_storm      — a forced defrag sweep mid-storm with the
+    #                          gain threshold relaxed to "any strict
+    #                          improvement": a wave of admitted moves
+    #                          (stage + evict) lands between faulted
+    #                          manager rounds, under full budget/rate
+    #                          arming and the budget audit
+    #   migration_crash      — conditional on a storm: the manager
+    #                          crash-restarts right after the sweep —
+    #                          migration tickets are soft state and die
+    #                          with it, and the evicted gangs must still
+    #                          re-place through the general solve (the
+    #                          make-before-break fallback contract)
+    #   migration_node_fault — conditional on a storm: one of the
+    #                          sweep's held DESTINATION nodes fails
+    #                          before the re-bind; the ticket trial must
+    #                          skip the dead node and the gang re-places
+    #                          elsewhere (its own vacated capacity at
+    #                          worst)
+    migration_storm_rate: float = 0.0
+    migration_crash_rate: float = 0.0
+    migration_node_fault_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

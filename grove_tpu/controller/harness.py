@@ -121,6 +121,14 @@ class Harness:
 
         autoscaler = Autoscaler(self.cluster)
         manager.register(autoscaler)
+        # the defragmenter is timer-driven (Harness.maybe_defrag), not
+        # watch-driven, so it is not registered with the manager; it is
+        # built next to the scheduler because the what-ifs ride that
+        # scheduler's engine (device-resident state) and migrations
+        # execute through its ticket/eviction machinery
+        from .defrag import DefragController
+
+        defrag = DefragController(self.cluster, scheduler)
         # node lifecycle last: its writes (Ready flips, eviction sweeps,
         # drain evictions) land as events for the next round's workload
         # controllers, and a crash-restart rebuilds its stabilization
@@ -134,6 +142,7 @@ class Harness:
         return manager, {
             "scheduler": scheduler,
             "autoscaler": autoscaler,
+            "defrag": defrag,
             "node_monitor": node_monitor,
         }
 
@@ -160,6 +169,7 @@ class Harness:
             )
             self.scheduler = comps["scheduler"]
             self.autoscaler = comps["autoscaler"]
+            self.defrag = comps["defrag"]
             self.node_monitor = comps["node_monitor"]
             return
         from .sharding import ShardedManager
@@ -196,6 +206,8 @@ class Harness:
         )
         self.scheduler = owner.components["scheduler"]
         self.autoscaler = owner.components["autoscaler"]
+        # the defragmenter rides the scheduler-owning worker's engine
+        self.defrag = owner.components["defrag"]
         self.node_monitor = owner.components["node_monitor"]
 
     @classmethod
@@ -293,6 +305,46 @@ class Harness:
             return False
         if not self.autoscale_sweep():
             return False  # standing by: the leader sweeps
+        if settle:
+            self.settle()
+        return True
+
+    def defrag_sweep(self, storm: bool = False):
+        """One defragmentation sweep, no settle (the chaos driver
+        interleaves it with faulted manager rounds). Runs as the
+        operator identity like any reconcile and, under HA, only on the
+        leader. Returns the sweep stats dict, or None when defrag is
+        disabled or this replica is standing by."""
+        if not self.config.defrag.enabled:
+            return None
+        if self.elector is not None:
+            with self.store.impersonate(
+                self.manager.identity or self.store.actor
+            ):
+                if not self.elector.try_acquire():
+                    return None  # standing by: the leader sweeps
+        with self.store.impersonate(
+            self.manager.identity or self.store.actor
+        ):
+            return self.defrag.sweep(storm=storm)
+
+    def maybe_defrag(self, settle: bool = True) -> bool:
+        """The periodic defrag sync: sweep (+ settle, which re-places
+        evicted gangs onto their held destinations) when at least
+        `defrag.sync_interval_seconds` of virtual time passed since the
+        last sweep. Long-run drivers (bench.py --defrag, the chaos
+        loop) call this every step so the cadence is governed by the
+        validated config, not the driver's step size."""
+        cfg = self.config.defrag
+        if not cfg.enabled:
+            return False
+        if (
+            self.clock.now() - self.defrag.last_sync
+            < cfg.sync_interval_seconds
+        ):
+            return False
+        if self.defrag_sweep() is None:
+            return False
         if settle:
             self.settle()
         return True

@@ -180,6 +180,29 @@ class GangScheduler:
         #: replacement binds back onto the node its predecessor vacated
         #: when it still fits (pod-level reservation reuse)
         self._vacated: dict[tuple[str, str], str] = {}
+        #: migration tickets staged by the defragmenter
+        #: (controller/defrag.py): (namespace, gang name) -> destination
+        #: node names HELD for the gang before its source was evicted
+        #: (make-before-break). Consumed — hit or miss — by the gang's
+        #: next backlog solve; a miss falls through to the general solve,
+        #: which can always re-place the gang (the eviction freed at
+        #: least its own former capacity).
+        self._migrations: dict[tuple[str, str], tuple[str, ...]] = {}
+        #: reservation tombstones for defrag-migrated gangs: the old
+        #: reservation (pointing at the vacated source) is PURGED at
+        #: stage time, and a successor naming the gang in
+        #: reuse_reservation_ref before it re-binds counts miss-migrated
+        #: instead of silently re-placing onto the source slot. Cleared
+        #: when the gang re-binds (fresh reservation at the destination).
+        #: A dict used as an ORDERED set (insertion order = staging
+        #: order) so the overflow valve evicts the OLDEST entries, never
+        #: the in-flight ones — the _vacated/_reservations LRU pattern.
+        self._migrated: dict[tuple[str, str], None] = {}
+        #: (namespace, pod name) keys whose upcoming deletion is part of
+        #: a migration: their Deleted events must NOT seed vacated hints
+        #: (a hole-filled replacement name would otherwise pull the gang
+        #: back onto the source node). Ordered like _migrated.
+        self._migration_suppress: dict[tuple[str, str], None] = {}
         self.preemption_enabled = cfg.solver.preemption_enabled
         #: gang-level reservation-reuse pre-pass enable (the diurnal
         #: bench's A/B knob); pod-level vacated hints stay on either way
@@ -267,20 +290,37 @@ class GangScheduler:
                 if gang:
                     dirty.add((event.namespace, gang))
                 if event.type == "Deleted" and event.obj.node_name:
+                    key = (event.namespace, event.name)
+                    if key in self._migration_suppress:
+                        # this deletion is a defrag move's source
+                        # eviction: the vacated slot must NOT become a
+                        # hint, or a hole-filled replacement name would
+                        # pull the gang straight back onto the node the
+                        # migration just freed
+                        self._migration_suppress.pop(key, None)
+                        vacated.pop(key, None)
                     # only live nodes make useful hints: the node-loss
                     # sweep deletes pods still "bound" to a vanished
                     # node, and recording those would re-point the hint
                     # map at dead capacity right after the purge below
-                    if self.store.peek(
+                    elif self.store.peek(
                         Node.KIND, "default", event.obj.node_name
                     ) is not None:
-                        key = (event.namespace, event.name)
                         vacated.pop(key, None)
                         if len(vacated) >= self.VACATED_LRU_MAX:
                             vacated.pop(next(iter(vacated)))
                         vacated[key] = event.obj.node_name
                 queued = True
             elif kind == PodGang.KIND:
+                if event.type == "Deleted":
+                    # a deleted gang's migration ticket can never be
+                    # consumed — drop it (the tombstone stays: like
+                    # reservations, it outlives deletion so a same-named
+                    # successor still sees miss-migrated, not the
+                    # vacated source)
+                    self._migrations.pop(
+                        (event.namespace, event.name), None
+                    )
                 if event.seq in own:
                     own.discard(event.seq)
                 else:
@@ -309,6 +349,15 @@ class GangScheduler:
                         if gone in nodes
                     ]:
                         del self._reservations[k]
+                    for k in [
+                        k
+                        for k, nodes in self._migrations.items()
+                        if gone in nodes
+                    ]:
+                        # a held destination on a vanished node is dead:
+                        # drop the ticket so the gang takes the general
+                        # solve instead of trialing dead capacity
+                        del self._migrations[k]
                 queued = True
             elif kind == ClusterTopology.KIND:
                 queued = True
@@ -551,12 +600,24 @@ class GangScheduler:
                     getattr(engine, "_dev_static", None) is not None
                 ),
             }
+        # per-gang placement scores (satellite: drift must be observable
+        # outside the diurnal bench)
+        scores = self.placement_scores()
         return {
             "dirty_gangs": len(self._dirty),
             "starved_gangs": len(self._starved),
             "gang_reservations": len(self._reservations),
             "vacated_pod_reservations": len(self._vacated),
             "preemption_attempted_for": len(self._preempted_for),
+            "pending_migrations": len(self._migrations),
+            "migrated_tombstones": len(self._migrated),
+            "placement": {
+                "mean_score": (
+                    round(sum(scores.values()) / len(scores), 4)
+                    if scores else None
+                ),
+                "gangs": scores,
+            },
             "engine": summary,
         }
 
@@ -565,6 +626,30 @@ class GangScheduler:
             "grove_scheduler_solve_dispatch_total",
             "pre_round solve dispatches by outcome at consume time",
         ).inc(outcome=outcome)
+
+    # -- fleet placement quality (one definition, three consumers:
+    # the reconcile gauge export, debug_state, the defrag sweep) -------------
+    def placement_scores(self) -> dict[str, float]:
+        """Per-gang placement scores of live (non-deleting) gangs whose
+        status carries one — exact while a gang stays placed, since its
+        own nodes never move under it. Read-only kind-bucket walk."""
+        scores: dict[str, float] = {}
+        for (ns, name), gang in self.store.kind_bucket(
+            PodGang.KIND
+        ).items():
+            s = gang.status.placement_score
+            if s is not None and gang.metadata.deletion_timestamp is None:
+                scores[f"{ns}/{name}"] = round(float(s), 4)
+        return scores
+
+    def export_placement_score(self, mean: float) -> None:
+        """The standing fleet-quality gauge (what the defrag threshold
+        and the long-churn drift gate read outside any bench)."""
+        self.metrics.gauge(
+            "grove_scheduler_placement_score",
+            "mean placement score over scheduled gangs (1.0 = every "
+            "gang packed into its narrowest domain)",
+        ).set(round(mean, 6))
 
     def _reconcile(self, dirty: set[tuple[str, str]]) -> Result:
         # No-copy scan: backlog membership is re-derived every round (it is
@@ -577,12 +662,19 @@ class GangScheduler:
         backlog_keys: list[tuple[str, str]] = []
         dirty_scheduled: list[PodGang] = []
         blocked_pending = False
+        score_sum, score_n = 0.0, 0
         pod_bucket = self.store.kind_bucket(Pod.KIND)
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
                 continue
             key = (gang.metadata.namespace, gang.metadata.name)
             if _cond_true(gang, PodGangConditionType.SCHEDULED.value):
+                if gang.status.placement_score is not None:
+                    # fleet placement quality as a STANDING series (the
+                    # diurnal bench used to be the only observer): the
+                    # scan already walks every gang, so the mean is free
+                    score_sum += gang.status.placement_score
+                    score_n += 1
                 if examine_all or key in examine:
                     dirty_scheduled.append(gang)
                     if examine_all:
@@ -605,6 +697,13 @@ class GangScheduler:
                 # was consumed) — waiting on events alone starves, so a
                 # blocked pending gang always arms the retry timer
                 blocked_pending = True
+        # fleet placement-score gauge, accumulated in the scan above so
+        # the standing series costs nothing extra per reconcile. With
+        # ZERO scored gangs nothing is exported: scores live in (0, 1],
+        # so a 0.0 would read as catastrophic packing where there is
+        # simply no data (debug_state reports None for the same state)
+        if score_n:
+            self.export_placement_score(score_sum / score_n)
         # one preemption attempt per BACKLOG STAY: a gang that left the
         # backlog (deleted, or scheduled elsewhere, or pods gone) gets a
         # fresh attempt on return — and the set cannot leak across gang
@@ -711,7 +810,9 @@ class GangScheduler:
         by_name = {g.metadata.name: g for g in backlog}
         solver_gangs = (
             self._try_reserved(encoded, by_name, snapshot, free, engine)
-            if self.reservation_reuse
+            # migration tickets ride the same pre-pass and must be
+            # consumed even when the reservation-reuse A/B knob is off
+            if self.reservation_reuse or self._migrations
             else encoded
         )
         kw = (
@@ -1030,12 +1131,34 @@ class GangScheduler:
         remaining: list = []
         for sg in order:
             pg = by_name.get(sg.name)
-            ref = pg.spec.reuse_reservation_ref if pg is not None else None
-            reserved = (
-                self._reservations.get((ref.namespace, ref.name))
-                if ref is not None and not sg.unschedulable_reason
-                else None
-            )
+            count = self._count_reuse
+            reserved = None
+            if pg is not None and not sg.unschedulable_reason:
+                # a migration ticket (defrag make-before-break hold)
+                # outranks reservation reuse and is CONSUMED here — one
+                # attempt per ticket; a miss falls through to the
+                # general solve, which can always re-place the gang
+                # (its eviction freed at least its own former capacity)
+                ticket = self._migrations.pop(
+                    (pg.metadata.namespace, sg.name), None
+                )
+                if ticket is not None:
+                    reserved = ticket
+                    count = self._count_migration
+                elif self.reservation_reuse:
+                    ref = pg.spec.reuse_reservation_ref
+                    if ref is not None:
+                        rkey = (ref.namespace, ref.name)
+                        if rkey in self._migrated:
+                            # the predecessor was defrag-migrated and
+                            # its old reservation purged: the successor
+                            # must NOT re-place onto the vacated source
+                            # slot — distinct outcome so the diurnal
+                            # bench's hit rate stays honest
+                            self._count_reuse("miss-migrated")
+                            remaining.append(sg)
+                            continue
+                        reserved = self._reservations.get(rkey)
             if not reserved:
                 remaining.append(sg)
                 continue
@@ -1055,14 +1178,14 @@ class GangScheduler:
             if level >= 0 and len(idx):
                 ids = snapshot.domain_ids[level, idx]
                 if not (ids == ids[0]).all():
-                    self._count_reuse("miss-scattered")
+                    count("miss-scattered")
                     remaining.append(sg)
                     continue
             higher = [
                 g for g in remaining if g.priority > sg.priority
             ]
             if higher and len(higher) > TRIAL_CAP:
-                self._count_reuse("miss-unverifiable")
+                count("miss-unverifiable")
                 remaining.append(sg)  # unverifiable cheaply: general
                 continue
             assign = (
@@ -1072,7 +1195,7 @@ class GangScheduler:
             )
             if assign is None:
                 # reservation gone/too small: general solve handles it
-                self._count_reuse("miss-unplaceable")
+                count("miss-unplaceable")
                 remaining.append(sg)
                 continue
             # declare the committed rows to the device-state cache NOW,
@@ -1093,10 +1216,10 @@ class GangScheduler:
                     for g in higher
                 ):
                     np.add.at(free, assign, sg.demand)
-                    self._count_reuse("miss-inversion")
+                    count("miss-inversion")
                     remaining.append(sg)
                     continue
-            self._count_reuse("hit")
+            count("hit")
             self._bind(
                 pg,
                 GangPlacement(
@@ -1119,6 +1242,87 @@ class GangScheduler:
             "grove_scheduler_reservation_reuse_total",
             "gang-level reservation-reuse attempts by outcome",
         ).inc(outcome=outcome)
+
+    def _count_migration(self, outcome: str) -> None:
+        """Migration-ticket bind accounting (the defrag bench's
+        make-before-break hit rate reads this): one attempt per consumed
+        ticket — hit means the migrated gang landed on exactly the
+        destination the defragmenter held for it."""
+        self.metrics.counter(
+            "grove_scheduler_migration_bind_total",
+            "defrag migration-ticket bind attempts by outcome "
+            "(make-before-break destinations)",
+        ).inc(outcome=outcome)
+
+    # -- continuous defragmentation (controller/defrag.py) -------------------
+    def stage_migration(self, namespace: str, name: str, dest_nodes,
+                        pod_keys) -> None:
+        """Defragmenter hook: hold `dest_nodes` as a migration ticket for
+        gang (namespace, name) BEFORE its source is evicted — the
+        make-before-break half of a move — and purge every piece of
+        placement memory still pointing at the soon-vacated source:
+
+          - the gang's old reservation is dropped and the key tombstoned
+            (a successor naming it in reuse_reservation_ref before the
+            re-bind counts miss-migrated instead of re-placing onto the
+            vacated source slot);
+          - `pod_keys` ((namespace, pod name) of the gang's bound pods)
+            are marked so their Deleted events never seed vacated hints.
+
+        The ticket is consumed — hit or miss — by the gang's next
+        backlog solve; the tombstone clears when the gang re-binds."""
+        key = (namespace, name)
+        self._reservations.pop(key, None)
+        self._migrated.pop(key, None)
+        self._migrated[key] = None
+        # overflow valves evict the OLDEST entries (stale tombstones of
+        # never-recreated gangs, suppressions of never-deleted pods) —
+        # clearing wholesale would wipe the IN-FLIGHT moves' entries and
+        # let their deletions seed hints at the just-freed source
+        while len(self._migrated) > 100_000:
+            self._migrated.pop(next(iter(self._migrated)))
+        self._migrations[key] = tuple(dest_nodes)
+        for pk in pod_keys:
+            self._migration_suppress.pop(pk, None)
+            self._migration_suppress[pk] = None
+        while len(self._migration_suppress) > 100_000:
+            self._migration_suppress.pop(
+                next(iter(self._migration_suppress))
+            )
+
+    def unstage_migration(self, namespace: str, name: str,
+                          pod_keys) -> None:
+        """Roll back a staged move whose eviction failed before (fully)
+        happening: drop the ticket and the not-yet-consumed vacated-hint
+        suppressions so the gang is a normal defrag candidate again next
+        sweep. The reservation tombstone STAYS — the old reservation was
+        already purged, and successors must keep seeing miss-migrated
+        rather than a resurrected stale entry. Safe against partial
+        evictions: a gang that DID lose its Scheduled condition simply
+        re-places through the general solve (make-before-break is an
+        optimization, never a correctness dependency)."""
+        self._migrations.pop((namespace, name), None)
+        for pk in pod_keys:
+            self._migration_suppress.pop(pk, None)
+
+    def evict_for_migration(self, gang: PodGang, dest_nodes) -> None:
+        """Execute an admitted defrag move's disruption half: mark the
+        gang a DisruptionTarget (the reference's scheduler-side "this
+        gang should move" vocabulary, podgang.go:156-169), drop its
+        Scheduled condition so it re-queues whole, and delete its bound
+        pods — the same drain/eviction path preemption rides. Callers
+        must stage_migration() FIRST so the destination is already held
+        when the capacity frees."""
+        msg = (
+            "defragmented: re-packing onto "
+            + ",".join(sorted(dest_nodes))
+        )
+        self._evict_gang(gang, reason="Defragmenting", message=msg)
+        self.metrics.counter(
+            "grove_defrag_evictions_total",
+            "gangs evicted by the defragmenter for admitted moves",
+        ).inc()
+        self.recorder.normal(gang, "Defragmenting", msg)
 
     # -- priority preemption (the reclaim the reference outsources to KAI;
     # SURVEY §2: Grove hands PodGangs to an external scheduler that owns
@@ -1183,9 +1387,12 @@ class GangScheduler:
             if self.tenancy is not None and self.tenancy.enabled
             else None
         )
-        #: gangs evicted per victim tenant across THIS preemption round —
-        #: what the per-tenant disruption budget bounds
-        evicted_by_tenant: dict[str, int] = {}
+        #: the SHARED disruption ledger (tenancy.DisruptionLedger): a
+        #: tenant's budget bounds evictions across every consumer in the
+        #: rolling window — this round's preemption spends count next to
+        #: the defragmenter's, so the pair can never double-spend
+        ledger = tenancy.ledger if tenancy is not None else None
+        now = self.store.clock.now()
         node_index = snapshot.node_index
         sched_free = np.where(snapshot.schedulable[:, None], free, 0.0)
         evicted_gangs = 0
@@ -1261,13 +1468,21 @@ class GangScheduler:
                 if vtenant is not None:
                     budget = tenancy.disruption_budget(vtenant)
                     if budget is not None and (
-                        evicted_by_tenant.get(vtenant, 0)
+                        ledger.spent(vtenant, now)
                         + chosen_tenants.get(vtenant, 0)
                     ) >= budget:
-                        # the tenant's per-round disruption budget is
-                        # spent: this victim is off the table no matter
-                        # how useful its capacity would be
+                        # the tenant's disruption budget is spent —
+                        # by earlier preemptors this round OR by a
+                        # defrag sweep in the same window: this victim
+                        # is off the table no matter how useful its
+                        # capacity would be. The audit names who spent
+                        # what (satellite: budget sharing must be
+                        # attributable).
                         entry["outcome"] = "disruption-budget-exhausted"
+                        entry["budget"] = {
+                            "limit": budget,
+                            "spent_by": ledger.breakdown(vtenant, now),
+                        }
                         budget_blocked = True
                         continue
                 contrib: dict[int, np.ndarray] = {}
@@ -1361,9 +1576,7 @@ class GangScheduler:
                 if tenancy is not None:
                     vt = tenancy.tenant_of_gang(victim)
                     if vt is not None:
-                        evicted_by_tenant[vt] = (
-                            evicted_by_tenant.get(vt, 0) + 1
-                        )
+                        ledger.charge(vt, "preemption", now)
                         self.metrics.counter(
                             "grove_tenant_preemption_evictions_total",
                             "gangs evicted by preemption per victim "
@@ -1431,15 +1644,16 @@ class GangScheduler:
         sched_nodes = np.flatnonzero(snapshot.schedulable)
         return _place_one(sg, snapshot, trial_free, sched_nodes) is not None
 
-    def _evict(self, gang: PodGang, preemptor: str) -> None:
-        """Preemption eviction: mark DisruptionTarget (the same signal the
-        gang-termination path raises before disruption, podgang.go:156-169),
-        drop the Scheduled condition so the gang re-queues as a whole at
-        its own priority, and delete its bound pods to release capacity
-        (the owning clique recreates them)."""
+    def _evict_gang(self, gang: PodGang, reason: str,
+                    message: str) -> None:
+        """Shared eviction body of preemption AND defrag migration: mark
+        DisruptionTarget (the same signal the gang-termination path
+        raises before disruption, podgang.go:156-169), drop the
+        Scheduled condition so the gang re-queues as a whole at its own
+        priority, and delete its bound pods to release capacity (the
+        owning clique recreates them)."""
         ns = gang.metadata.namespace
         now = self.store.clock.now()
-        msg = f"preempted by higher-priority gang {preemptor}"
 
         def mutate(status):
             status.phase = PodGangPhase.PENDING
@@ -1448,16 +1662,16 @@ class GangScheduler:
                 status.conditions,
                 PodGangConditionType.DISRUPTION_TARGET.value,
                 "True",
-                reason="Preempted",
-                message=msg,
+                reason=reason,
+                message=message,
                 now=now,
             )
             set_condition(
                 status.conditions,
                 PodGangConditionType.SCHEDULED.value,
                 "False",
-                reason="Preempted",
-                message=msg,
+                reason=reason,
+                message=message,
                 now=now,
             )
 
@@ -1470,9 +1684,15 @@ class GangScheduler:
                 pod = self.store.peek(Pod.KIND, ref.namespace, ref.name)
                 if pod is not None and pod.metadata.deletion_timestamp is None:
                     self.store.delete(Pod.KIND, ref.namespace, ref.name)
-        # the victim must re-queue through the general solve, not snipe its
-        # old nodes back from the preemptor via reservation reuse
+        # the victim must re-queue through the general solve, not snipe
+        # its old nodes back via reservation reuse (a defrag move
+        # replaces the reservation with its migration ticket instead)
         self._reservations.pop((ns, gang.metadata.name), None)
+
+    def _evict(self, gang: PodGang, preemptor: str) -> None:
+        """Preemption eviction (see _evict_gang for the shared body)."""
+        msg = f"preempted by higher-priority gang {preemptor}"
+        self._evict_gang(gang, reason="Preempted", message=msg)
         self.metrics.counter(
             "grove_scheduler_preemptions_total",
             "scaled gangs evicted for higher-priority gangs",
@@ -1492,6 +1712,10 @@ class GangScheduler:
         self._reservations[rkey] = tuple(
             sorted(set(placement.pod_to_node.values()))
         )
+        # a defrag-migrated gang just re-bound: its fresh reservation
+        # (the destination) supersedes the tombstone, and successors may
+        # reuse it again
+        self._migrated.pop(rkey, None)
         self._preempted_for.discard((ns, gang.metadata.name))
         now = self.store.clock.now()
 

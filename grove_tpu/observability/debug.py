@@ -121,6 +121,12 @@ def harness_dump(harness) -> dict[str, Any]:
     monitor = getattr(harness, "node_monitor", None)
     if monitor is not None:
         out["node_lifecycle"] = monitor.debug_state()
+    defrag = getattr(harness, "defrag", None)
+    if defrag is not None:
+        # the continuous defragmenter (controller/defrag.py): sweep/
+        # move totals, eviction-rate window, pending migration tickets,
+        # and the engine-launch attribution behind the what-if contract
+        out["defrag"] = defrag.debug_state()
     out["tracing"] = tracing_dump(harness.cluster)
     out["explain"] = explain_dump(harness.cluster)
     tenancy = getattr(harness.cluster, "tenancy", None)

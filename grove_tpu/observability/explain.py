@@ -601,6 +601,23 @@ class DecisionLog:
             return
         ring[-1].preemption = info
 
+    def attach_migration(self, namespace: str, gang: str,
+                         info: dict) -> None:
+        """Record one defragmentation candidate verdict — admitted OR
+        rejected — as a migration audit record. Unlike attach_preemption
+        (which annotates the latest solve record), a migration decision
+        is its own event: the gang was PLACED when the defragmenter
+        examined it, and the audit must survive the re-solve records the
+        executed move generates. `info` carries the defragmenter's full
+        arithmetic: current/candidate score, gain, migration cost,
+        budget state (which consumer spent what), and the verdict."""
+        self.record(
+            DecisionRecord(
+                namespace=namespace, gang=gang, outcome="migration",
+                wall_time=time.time(), detail=info,
+            )
+        )
+
     def explain(self, namespace: str, gang: str) -> Optional[dict]:
         """The full decision history of one gang (newest last), or None
         when the ring never saw it (or already evicted it)."""
@@ -662,6 +679,28 @@ def render_verdict(entry: dict) -> str:
                     f"  - {term['lost']:.3f}  {term['term']} unsatisfied "
                     f"(spans {term['domains_spanned']} domains)"
                 )
+    elif rec.get("outcome") == "migration":
+        # a defragmentation audit record (controller/defrag.py): the
+        # gang was PLACED when examined; the verdict is the story
+        lines.append(
+            f"gang {name}: MIGRATION {detail.get('verdict', '?')}  "
+            f"score {detail.get('current_score', '?')} -> "
+            f"{detail.get('candidate_score', '?')}  "
+            f"net_gain={detail.get('net_gain', '?')} "
+            f"(threshold {detail.get('threshold', '?')})"
+        )
+        if detail.get("from"):
+            lines.append(f"  from {','.join(detail['from'])}")
+        if detail.get("to"):
+            lines.append(f"  to   {','.join(detail['to'])}")
+        if detail.get("budget"):
+            b = detail["budget"]
+            lines.append(
+                f"  budget: limit {b.get('limit')} "
+                f"spent_by {b.get('spent_by')}"
+            )
+        if detail.get("note"):
+            lines.append(f"  {detail['note']}")
     else:
         code = detail.get("code") or "Unknown"
         lines.append(f"gang {name}: UNPLACED  [{code}]")

@@ -197,6 +197,14 @@ class ShardedPlacementEngine(PlacementEngine):
         #: collective the multi-process CPU backend cannot run.
         self._free_sharding = NamedSharding(mesh, P("nodes", None))
 
+    def whatif_scores(self, gangs, free=None, free_rows=None):
+        """The what-if program is single-device (it reads the resident
+        buffer directly, and the mesh-resident state would need the
+        shard_map wrapper + padding discipline for a diagnostic-grade
+        call): the defragmenter falls back to exact host-side scoring on
+        mesh-sharded engines (docs/scheduling.md)."""
+        return None
+
     def _sub_device(self, dom: int):
         """Domain-sharded hierarchy: coarse domain `dom`'s sub-engine is
         pinned to one of THIS PROCESS's mesh devices, round-robin by

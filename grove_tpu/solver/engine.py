@@ -765,7 +765,9 @@ class PlacementEngine:
         self._last_begin: dict = {}
         #: device-program launch counters by path kind, mirrored to the
         #: grove_solver_dispatches_total metric and debug_summary
-        self._dispatches = {"fused": 0, "split": 0, "incremental": 0}
+        self._dispatches = {
+            "fused": 0, "split": 0, "incremental": 0, "whatif": 0,
+        }
         self._inc_rows_total = 0
         self._inc_reuse_hits = 0
         #: hierarchical two-level solve (solver/hierarchy.py): a coarse
@@ -1242,6 +1244,108 @@ class PlacementEngine:
             )
         return fresh
 
+    # -- defragmentation what-if (controller/defrag.py) ----------------------
+    def dispatch_counts(self) -> dict:
+        """Cumulative device-launch counts by path kind plus the
+        state-upload split — the attribution surface the defragmenter
+        samples around its engine calls, so "zero full re-encodes
+        attributable to defrag sweeps" is a measured counter delta, not
+        a claim (bench.py --defrag gates on it)."""
+        st = self._state
+        out = dict(self._dispatches)
+        out["state_full_uploads"] = st.full_uploads
+        out["state_delta_uploads"] = st.delta_uploads
+        return out
+
+    def whatif_scores(self, gangs: list[SolverGang],
+                      free: np.ndarray | None = None,
+                      free_rows: dict | None = None):
+        """Rank candidate domains for `gangs` against the DEVICE-RESIDENT
+        free state — the defragmenter's what-if entry point. The program
+        is the fused scorer run NON-donated with its free'/value/demand
+        outputs DISCARDED: the resident buffer, host mirror, state epoch,
+        incremental cache and staged rows are all untouched, so a what-if
+        can never stale the real solve path, and the launch is counted
+        under its own dispatch kind ("whatif") — a defrag sweep is
+        provably never a full backlog re-encode.
+
+        `free` (the current cluster free matrix) is synced first through
+        the normal STAGED delta path (changed rows ride this what-if's
+        update block and stay staged for the next real solve — no extra
+        launch, no full upload while the mirror is warm). `free_rows`
+        ({node row -> hypothetical row values}) overlays a hypothetical
+        delta on top — O(dirty rows), exactly the incremental tier's
+        transport discipline.
+
+        Returns (top_val [G, K], top_dom [G, K], order) with `order` the
+        gangs in solve order, or None when the engine cannot serve a
+        resident what-if (fused/state-cache off, nothing synced yet) —
+        callers fall back to host-side scoring."""
+        if not (self.fused and self.state_cache):
+            return None
+        if free is not None:
+            # a no-op when content is unchanged; small drifts stage
+            # (deferred) and ride this call's update block below
+            self._sync_free(free, defer=True)
+        st = self._state
+        if st.dev is None or st.mirror is None:
+            return None
+        solvable = [g for g in gangs if not g.unschedulable_reason]
+        if not solvable:
+            return None
+        order = sorted(solvable, key=gang_sort_key)
+        with self.tracer.span("engine.whatif", gangs=len(order)) as sp:
+            enc = self._encode_arrays(order)
+            # overlay = staged-but-unshipped rows (committed content the
+            # resident buffer only receives at the next fused dispatch;
+            # PEEKED, not consumed) + the caller's hypothetical rows
+            overlay: dict[int, np.ndarray] = dict(self._staged or {})
+            if free_rows:
+                sched = self.snapshot.schedulable
+                for i, row in free_rows.items():
+                    i = int(i)
+                    masked = np.asarray(row, np.float32)
+                    if not sched[i]:
+                        masked = np.zeros_like(masked)
+                    overlay[i] = masked
+            upd = None
+            if overlay:
+                n = self.snapshot.num_nodes
+                r_ = len(self.snapshot.resource_names)
+                k_pad = _bucket(len(overlay), minimum=16)
+                upd = np.zeros((k_pad, 1 + r_), np.float32)
+                upd[:, 0] = float(n)  # padding rows scatter out of range
+                for j, (i, row) in enumerate(sorted(overlay.items())):
+                    upd[j, 0] = i
+                    upd[j, 1:] = row
+            io = self._build_io(enc, upd)
+            u_sig_demand, u_sig_mask, elig_masks, sig_idx = enc.sig
+            gdom_d, dom_level_d, anc_ids_d, cap_scale_d, _ = (
+                self._ensure_statics()
+            )
+            g_pad, r = enc.total_demand.shape
+            _, packed, _, _ = _fused_score(
+                st.dev, gdom_d, dom_level_d, anc_ids_d,
+                self._to_device(io),
+                self._masks_to_device(elig_masks),
+                cap_scale_d,
+                num_domains=self.space.num_domains,
+                top_k=min(self.top_k, self.space.num_domains),
+                chunk=self.commit_chunk,
+                num_res=r,
+                num_gangs=g_pad,
+                num_sigs=u_sig_demand.shape[0],
+                sig_width=sig_idx.shape[1],
+                num_upd=0 if upd is None else upd.shape[0],
+            )
+            self._count_dispatch_kind("whatif")
+            self._count_bytes("whatif", io.nbytes)
+            packed = np.asarray(packed)
+            self._count_bytes("results", packed.nbytes)
+            k = packed.shape[1] // 2
+            sp.set(overlay_rows=len(overlay))
+            return packed[:, :k], packed[:, k:].astype(np.int32), order
+
     # -- hierarchical two-level solve (solver/hierarchy.py) ------------------
     def _hier_plan(self, order: list[SolverGang]) -> int | None:
         """The prune level this backlog solves hierarchically at, or
@@ -1372,7 +1476,7 @@ class PlacementEngine:
         sub_stats["hier_fine_solves"] += 1
         disp = shard.engine._dispatches
         for kind, total in disp.items():
-            for _ in range(total - shard.disp_seen[kind]):
+            for _ in range(total - shard.disp_seen.get(kind, 0)):
                 self._count_dispatch_kind(kind)
             shard.disp_seen[kind] = total
         rows_total = shard.engine._inc_rows_total

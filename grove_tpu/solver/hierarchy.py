@@ -147,7 +147,9 @@ class DomainShard:
         self.last_placed: list | None = None
         #: sub-engine counter watermarks, mirrored into the parent's
         #: dispatch/incremental accounting after every sub-solve
-        self.disp_seen = {"fused": 0, "split": 0, "incremental": 0}
+        self.disp_seen = {
+            "fused": 0, "split": 0, "incremental": 0, "whatif": 0,
+        }
         self.inc_rows_seen = 0
         self.reuse_seen = 0
 

@@ -4,12 +4,20 @@ See queues.py for the model; api.config.TenancyConfig for the knobs;
 docs/scheduling.md "Multi-tenancy" for the user story.
 """
 
-from .queues import ADMIT, QUEUE, SHED, TenancyManager, TenantQueue
+from .queues import (
+    ADMIT,
+    QUEUE,
+    SHED,
+    DisruptionLedger,
+    TenancyManager,
+    TenantQueue,
+)
 
 __all__ = [
     "ADMIT",
     "QUEUE",
     "SHED",
+    "DisruptionLedger",
     "TenancyManager",
     "TenantQueue",
 ]

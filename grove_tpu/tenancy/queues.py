@@ -111,6 +111,63 @@ class TenantQueue:
         self.active: bool = False
 
 
+class DisruptionLedger:
+    """Shared per-tenant disruption spend across EVERY consumer.
+
+    A tenant's `disruption_budget` used to bound one preemption pass in
+    isolation; with the defragmenter also evicting gangs, the budget
+    must bound the SUM — a preemption round followed by a defrag sweep
+    (or vice versa) can never double-spend it. Charges are
+    (virtual timestamp, consumer) entries in a rolling window
+    (`tenancy.disruption_budget_window_seconds`); `spent()` counts the
+    live window and `breakdown()` attributes it per consumer, so every
+    budget audit names WHO spent WHAT. Virtual-clock timestamps keep
+    the ledger deterministic under the chaos replayer.
+
+    Owned by the TenancyManager (cluster-owned), so spends survive
+    manager crash-restarts within the window — a restart cannot be used
+    to launder a fresh budget."""
+
+    def __init__(self, window_seconds: float = 60.0):
+        self.window = float(window_seconds)
+        #: tenant -> list[(virtual ts, consumer)] — pruned on access
+        self._spends: dict[str, list[tuple[float, str]]] = {}
+
+    def _live(self, tenant: str, now: float) -> list[tuple[float, str]]:
+        entries = self._spends.get(tenant)
+        if not entries:
+            return []
+        horizon = now - self.window
+        live = [e for e in entries if e[0] > horizon]
+        if live:
+            self._spends[tenant] = live
+        else:
+            del self._spends[tenant]
+        return live
+
+    def charge(self, tenant: str, consumer: str, now: float,
+               n: int = 1) -> None:
+        # prune on WRITE too: tenants without a configured budget are
+        # charged (preemption charges every victim tenant) but never
+        # read, and read-side-only pruning would grow their entry lists
+        # without bound across weeks of eviction churn
+        entries = self._spends.setdefault(tenant, [])
+        horizon = now - self.window
+        if entries and entries[0][0] <= horizon:
+            entries[:] = [e for e in entries if e[0] > horizon]
+        entries.extend((now, consumer) for _ in range(n))
+
+    def spent(self, tenant: str, now: float) -> int:
+        return len(self._live(tenant, now))
+
+    def breakdown(self, tenant: str, now: float) -> dict[str, int]:
+        """Window spend per consumer — the audit payload."""
+        out: dict[str, int] = {}
+        for _, consumer in self._live(tenant, now):
+            out[consumer] = out.get(consumer, 0) + 1
+        return out
+
+
 class TenancyManager:
     """Runtime tenant arbitration bound to one validated TenancyConfig.
 
@@ -128,6 +185,9 @@ class TenancyManager:
         self.tier_values: dict[str, float] = {}
         #: resource axis of the last refresh (usage vectors align to it)
         self._last_resource_names: Optional[list[str]] = None
+        #: the shared disruption-budget ledger (preemption + defrag draw
+        #: from it); created once so spends survive configure() reloads
+        self.ledger = DisruptionLedger(cfg.disruption_budget_window_seconds)
         self.configure(cfg)
 
     # -- configuration -------------------------------------------------------
@@ -141,6 +201,7 @@ class TenancyManager:
         next export (see _export_metrics — the Gauge.label_sets/remove
         pattern the per-node lifecycle gauges use)."""
         self.cfg = cfg
+        self.ledger.window = float(cfg.disruption_budget_window_seconds)
         self.queues = {
             t["name"]: TenantQueue(t, cfg.default_tier) for t in cfg.tenants
         }

@@ -173,6 +173,22 @@ DURABILITY_CONFIG = {
 HIERARCHICAL_CONFIG = {"solver": {"hierarchical_min_nodes": 0}}
 
 
+#: defrag config for --defrag sweeps: a tight sweep cadence so the
+#: chaotic maybe_defrag loop actually fires between fault steps, a
+#: small per-sweep move cap (bounded disruption mid-storm), and a rate
+#: ceiling generous enough that storms are bounded by budgets/gain, not
+#: silently by the rate limiter
+DEFRAG_CONFIG = {
+    "defrag": {
+        "enabled": True,
+        "sync_interval_seconds": 20.0,
+        "min_score_gain": 0.05,
+        "max_moves_per_sweep": 2,
+        "max_evictions_per_hour": 240.0,
+    }
+}
+
+
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
              explain_dir: Path | None = None,
@@ -181,8 +197,20 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              durability: bool = False,
              partitions: int = 1,
              serving: bool = False,
-             hierarchical: bool = False) -> dict:
+             hierarchical: bool = False,
+             defrag: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    if defrag:
+        # the continuous-defragmentation fault axis: forced migration
+        # storms (stage + evict waves mid-chaos), crashes right after a
+        # storm (tickets are soft state; evicted gangs must still
+        # re-place), and destination-node faults before the re-bind —
+        # with the disruption-budget audit armed throughout
+        overrides.update(
+            migration_storm_rate=0.3,
+            migration_crash_rate=0.25,
+            migration_node_fault_rate=0.3,
+        )
     if serving:
         # the elastic-serving fault axis: seeded traffic spikes onto the
         # flat trace (the HPA loop scales up mid-storm and must
@@ -236,6 +264,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         config = {**config, **SERVING_CONFIG}
     if hierarchical:
         config = {**config, **HIERARCHICAL_CONFIG}
+    if defrag:
+        config = {**config, **DEFRAG_CONFIG}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
     if wal_tmp is not None:
@@ -250,7 +280,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
     try:
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
-            explain_dir, durability, serving, hierarchical,
+            explain_dir, durability, serving, hierarchical, defrag,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -262,7 +292,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
                     explain_dir, durability, serving=False,
-                    hierarchical=False) -> dict:
+                    hierarchical=False, defrag=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -274,9 +304,11 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
     ch.harness.cluster.logger.stream = quiet
     ch.harness.manager.logger.stream = quiet
     ch.harness.scheduler.log.stream = quiet
+    ch.harness.defrag.log.stream = quiet
     for w in getattr(ch.harness.manager, "workers", ()):
         w.manager.logger.stream = quiet
         w.components["scheduler"].log.stream = quiet
+        w.components["defrag"].log.stream = quiet
     t0 = time.perf_counter()
     error = None
     try:
@@ -414,6 +446,20 @@ def main(argv=None) -> int:
                          "never a stale re-score; convergence is checked "
                          "against the fault-free fixpoint under the SAME "
                          "config")
+    ap.add_argument("--defrag", action="store_true",
+                    help="arm the continuous-defragmentation fault axis: "
+                         "defrag is enabled on a tight sweep cadence and "
+                         "the plan adds seeded migration storms (forced "
+                         "relaxed-threshold sweeps: stage + evict waves "
+                         "mid-chaos), crashes right after a storm "
+                         "(migration tickets are soft state; evicted "
+                         "gangs must still re-place through the general "
+                         "solve), and destination-node faults before the "
+                         "re-bind — with the disruption-budget audit "
+                         "armed; convergence is checked against the "
+                         "fault-free fixpoint (migrations move gangs, "
+                         "and node assignment is outside the "
+                         "fingerprint by contract)")
     ap.add_argument("--tenant-skew", dest="tenant_skew",
                     action="store_true",
                     help="enable tenant-skew load faults: tenancy "
@@ -446,6 +492,8 @@ def main(argv=None) -> int:
         baseline_config = {**baseline_config, **SERVING_CONFIG}
     if args.hierarchical:
         baseline_config = {**baseline_config, **HIERARCHICAL_CONFIG}
+    if args.defrag:
+        baseline_config = {**baseline_config, **DEFRAG_CONFIG}
     baseline_h = Harness(
         nodes=make_nodes(args.nodes),
         config=baseline_config or None,
@@ -471,7 +519,8 @@ def main(argv=None) -> int:
                           durability=args.durability,
                           partitions=args.partitions,
                           serving=args.serving,
-                          hierarchical=args.hierarchical)
+                          hierarchical=args.hierarchical,
+                          defrag=args.defrag)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -485,6 +534,7 @@ def main(argv=None) -> int:
         "partitions": args.partitions,
         "serving": args.serving,
         "hierarchical": args.hierarchical,
+        "defrag": args.defrag,
         "failed_seeds": failed,
         "ok": not failed,
     }

@@ -1,0 +1,726 @@
+"""Continuous defragmentation (controller/defrag.py): DefragConfig
+validation, the solver what-if API (device path, state isolation,
+dispatch attribution), the shared DisruptionLedger, the scheduler's
+migration machinery (tickets, make-before-break binds, reservation
+staleness on migration), the end-to-end sweep (admission arithmetic,
+audits, rate bound, budget sharing with preemption), and defrag chaos
+(migration storms, crash mid-migration, destination node faults)."""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.config import load_operator_config
+from grove_tpu.api.meta import ObjectMeta, get_condition
+from grove_tpu.api.podgang import PodGang, PodGangConditionType
+from grove_tpu.api.types import (
+    Container,
+    Pod,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.api.validation import ValidationError
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.solver import PlacementEngine
+from grove_tpu.tenancy import DisruptionLedger
+
+from test_solver import cluster, gang
+
+DEFRAG = {
+    "enabled": True,
+    "sync_interval_seconds": 60.0,
+    "min_score_gain": 0.05,
+    "migration_cost_score": 0.02,
+    "max_moves_per_sweep": 4,
+    "max_evictions_per_hour": 120.0,
+}
+
+
+def pcs(name, pods, cpu=1.0):
+    return PodCliqueSet(
+        metadata=ObjectMeta(name=name),
+        spec=PodCliqueSetSpec(
+            replicas=1,
+            template=PodCliqueSetTemplateSpec(cliques=[
+                PodCliqueTemplateSpec(
+                    name="w",
+                    spec=PodCliqueSpec(
+                        replicas=pods,
+                        pod_spec=PodSpec(containers=[
+                            Container(name="m", resources={"cpu": cpu})
+                        ]),
+                    ),
+                )
+            ]),
+        ),
+    )
+
+
+def gang_nodes(h, name):
+    g = next(
+        x for x in h.store.scan(PodGang.KIND)
+        if x.metadata.name.startswith(name)
+    )
+    nodes = [
+        h.store.peek(Pod.KIND, r.namespace, r.name).node_name
+        for gr in g.spec.pod_groups for r in gr.pod_references
+    ]
+    return g, nodes
+
+
+def frag_harness(config=None, tenants=None):
+    """Deterministic fragmentation: 8 nodes (2 blocks x 2 racks x
+    2 hosts, 2 cpu each) filled by 16 one-cpu singles that stack node
+    by node; freeing ONE cpu on two different BLOCKS forces the 2-pod
+    target gang to span the cluster root (score 0.25); freeing a whole
+    node in one rack then gives the defragmenter a host-level (1.0)
+    destination. Returns (harness, {single gang name -> node})."""
+    cfg = {"defrag": dict(DEFRAG)}
+    if config:
+        cfg.update(config)
+    if tenants is not None:
+        cfg["tenancy"] = {"enabled": True, "tenants": tenants}
+    h = Harness(
+        nodes=make_nodes(
+            8, racks_per_block=2, hosts_per_rack=2,
+            allocatable={"cpu": 2.0, "memory": 16.0, "tpu": 0.0},
+        ),
+        config=cfg,
+    )
+    for i in range(16):
+        h.apply(pcs(f"s{i}", 1, 1.0))
+        h.settle()
+    node_of = {}
+    for g in h.store.scan(PodGang.KIND):
+        ref = g.spec.pod_groups[0].pod_references[0]
+        node_of[g.metadata.name.split("-")[0]] = h.store.peek(
+            Pod.KIND, ref.namespace, ref.name
+        ).node_name
+    return h, node_of
+
+
+def free_one_on(h, node_of, node):
+    """Delete one filler single bound to `node` (cascade via its PCS)."""
+    for name, n in sorted(node_of.items()):
+        if n == node:
+            h.store.delete(PodCliqueSet.KIND, "default", name)
+            del node_of[name]
+            return name
+    raise AssertionError(f"no filler on {node}")
+
+
+def spanning_target(h, node_of):
+    """Free 1 cpu on two different blocks, place the 2-pod target gang
+    across them, and return (gang, its nodes)."""
+    nodes = sorted(set(node_of.values()))
+    free_one_on(h, node_of, nodes[0])   # block 0
+    free_one_on(h, node_of, nodes[4])   # block 1
+    h.settle()
+    h.apply(pcs("target", 2, 1.0))
+    h.settle()
+    g, placed = gang_nodes(h, "target")
+    assert g.status.placement_score == 0.25  # spans the cluster root
+    return g, placed
+
+
+# -- config validation --------------------------------------------------------
+
+class TestDefragConfig:
+    def test_disabled_by_default(self):
+        cfg = load_operator_config(None)
+        assert cfg.defrag.enabled is False
+
+    def test_valid_config_loads(self):
+        cfg = load_operator_config({"defrag": dict(DEFRAG)})
+        assert cfg.defrag.enabled and cfg.defrag.min_score_gain == 0.05
+
+    @pytest.mark.parametrize("field,value", [
+        ("sync_interval_seconds", 0),
+        ("min_score_gain", 0),
+        ("migration_cost_score", -0.1),
+        ("max_moves_per_sweep", 0),
+        ("max_evictions_per_hour", 0),
+        ("candidates_per_sweep", 0),
+        ("enabled", "yes"),
+    ])
+    def test_invalid_configs_rejected(self, field, value):
+        with pytest.raises(ValidationError) as err:
+            load_operator_config({"defrag": {field: value}})
+        assert f"defrag.{field}" in str(err.value)
+
+    def test_budget_window_validated(self):
+        with pytest.raises(ValidationError) as err:
+            load_operator_config(
+                {"tenancy": {"disruption_budget_window_seconds": 0}}
+            )
+        assert "disruption_budget_window_seconds" in str(err.value)
+
+
+# -- the solver what-if API ---------------------------------------------------
+
+class TestWhatIf:
+    def setup_engine(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_verify=True)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
+        free = snap.free.copy()
+        eng.solve(gangs, free=free)
+        return snap, eng, gangs, free
+
+    def test_whatif_counts_its_own_kind_and_mutates_nothing(self):
+        snap, eng, gangs, free = self.setup_engine()
+        # the FIRST what-if may legitimately stage the previous solve's
+        # repair commits (free was mutated in place — a real content
+        # change, delta-staged like any sync)
+        res = eng.whatif_scores(
+            [gang("w0", pods=2, cpu=2.0)], free=free
+        )
+        assert res is not None
+        top_val, top_dom, order = res
+        assert top_val.shape == top_dom.shape
+        assert [g.name for g in order] == ["w0"]
+        assert eng._dispatches["whatif"] == 1
+        # from here the content is synced: a second what-if mutates
+        # NOTHING resident — epoch, incremental cache, staged rows are
+        # all untouched (staged is peeked, never consumed)
+        epoch = eng._state.epoch
+        inc = eng._inc
+        staged = None if eng._staged is None else dict(eng._staged)
+        res2 = eng.whatif_scores(
+            [gang("w1", pods=2, cpu=2.0)], free=free
+        )
+        assert res2 is not None
+        assert eng._dispatches["whatif"] == 2
+        assert eng._state.epoch == epoch
+        assert eng._inc is inc
+        assert (eng._staged or None) == (staged or None)
+        # and a real solve afterwards passes the armed state_verify
+        # tripwire — the what-ifs corrupted nothing resident
+        res3 = eng.solve(
+            [gang(f"h{i}", pods=2, cpu=2.0) for i in range(3)],
+            free=free,
+        )
+        assert res3.num_placed == 3
+
+    def test_whatif_rankings_match_a_real_solve(self):
+        snap, eng, gangs, free = self.setup_engine()
+        probe = gang("w0", pods=2, cpu=2.0)
+        top_val, top_dom, order = eng.whatif_scores([probe], free=free)
+        # the top-ranked domain admits an exact placement (the engine's
+        # own repair discipline)
+        from grove_tpu.solver.fit import place_gang_in_domain
+
+        node_idx, level = eng.space.nodes_of(
+            int(top_dom[0, 0]), np.flatnonzero(snap.schedulable)
+        )
+        trial = free.copy()
+        assert place_gang_in_domain(
+            probe, snap, trial, node_idx, level
+        ) is not None
+
+    def test_free_rows_overlay_changes_the_ranking(self):
+        snap = cluster(blocks=1, racks=2, hosts=2, cpu=4.0)
+        eng = PlacementEngine(snap)
+        filler = [gang(f"f{i}", pods=1, cpu=4.0) for i in range(2)]
+        free = snap.free.copy()
+        res = eng.solve(filler, free=free)  # fills rack 0 (2 nodes)
+        assert res.num_placed == 2
+        probe = gang("w0", pods=1, cpu=4.0)
+        committed = sorted(
+            i for p in res.placed.values() for i in p.node_indices
+        )
+        # hypothetically return a committed node's capacity: the
+        # what-if against the overlay must score strictly better
+        # somewhere than against the residual state
+        base_val, _, _ = eng.whatif_scores([probe], free=free)
+        over_val, _, _ = eng.whatif_scores(
+            [probe], free=free,
+            free_rows={committed[0]: snap.capacity[committed[0]]},
+        )
+        assert over_val.max() > base_val.max()
+
+    def test_cache_off_returns_none(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap, state_cache=False)
+        eng.solve([gang("g0", pods=2, cpu=2.0)], free=snap.free.copy())
+        assert eng.whatif_scores(
+            [gang("w0", pods=2, cpu=2.0)], free=snap.free.copy()
+        ) is None
+
+    def test_unsynced_engine_returns_none(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
+        eng = PlacementEngine(snap)
+        assert eng.whatif_scores([gang("w0", pods=2, cpu=2.0)]) is None
+
+    def test_dispatch_counts_surface(self):
+        snap, eng, gangs, free = self.setup_engine()
+        counts = eng.dispatch_counts()
+        assert counts["fused"] == 1 and counts["whatif"] == 0
+        assert counts["state_full_uploads"] == 1
+
+
+# -- the shared disruption ledger ---------------------------------------------
+
+class TestDisruptionLedger:
+    def test_charge_spent_breakdown(self):
+        led = DisruptionLedger(window_seconds=60.0)
+        led.charge("a", "preemption", now=0.0)
+        led.charge("a", "defrag", now=10.0, n=2)
+        assert led.spent("a", now=10.0) == 3
+        assert led.breakdown("a", now=10.0) == {
+            "preemption": 1, "defrag": 2,
+        }
+        assert led.spent("b", now=10.0) == 0
+
+    def test_window_expiry(self):
+        led = DisruptionLedger(window_seconds=60.0)
+        led.charge("a", "defrag", now=0.0)
+        assert led.spent("a", now=59.0) == 1
+        assert led.spent("a", now=61.0) == 0
+        assert led.breakdown("a", now=61.0) == {}
+
+    def test_charge_prunes_expired_entries_for_unread_tenants(self):
+        """Review regression: tenants without a configured budget are
+        charged (preemption charges every victim tenant) but never
+        read — pruning must happen on write too, or the ledger grows
+        without bound across weeks of eviction churn."""
+        led = DisruptionLedger(window_seconds=60.0)
+        for i in range(100):
+            led.charge("unread", "preemption", now=float(i * 61))
+        assert len(led._spends["unread"]) == 1
+
+    def test_manager_owns_one_ledger_across_configure(self):
+        from grove_tpu.tenancy import TenancyManager
+
+        cfg = load_operator_config({"tenancy": {
+            "enabled": True, "tenants": [{"name": "a"}],
+            "disruption_budget_window_seconds": 30.0,
+        }}).tenancy
+        m = TenancyManager(cfg)
+        led = m.ledger
+        assert led.window == 30.0
+        m.configure(cfg)
+        assert m.ledger is led  # spends survive reconfiguration
+
+
+# -- migration machinery (scheduler) ------------------------------------------
+
+class TestMigrationMachinery:
+    def test_stage_purges_reservation_and_tombstones(self):
+        h, node_of = frag_harness()
+        sched = h.scheduler
+        key = ("default", "s0-0")
+        assert key in sched._reservations
+        sched.stage_migration(
+            "default", "s0-0", ("node-9",), [("default", "p")]
+        )
+        assert key not in sched._reservations
+        assert key in sched._migrated
+        assert sched._migrations[key] == ("node-9",)
+
+    def test_migration_bind_hit_and_tombstone_cleared(self):
+        h, node_of = frag_harness()
+        g, placed = spanning_target(h, node_of)
+        # free a whole node in one rack and sweep: the move must land
+        # exactly on the held destination (make-before-break hit)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 1
+        dest = tuple(h.scheduler._migrations[("default", g.metadata.name)])
+        h.settle()
+        g2, placed2 = gang_nodes(h, "target")
+        assert sorted(set(placed2)) == sorted(set(dest))
+        assert g2.status.placement_score == 1.0
+        ctr = h.cluster.metrics.counter(
+            "grove_scheduler_migration_bind_total"
+        )
+        assert ctr.value(outcome="hit") == 1
+        key = ("default", g.metadata.name)
+        assert key not in sched_migrated(h)
+        # the fresh reservation points at the DESTINATION
+        assert set(h.scheduler._reservations[key]) == set(dest)
+        # DisruptionTarget cleared at re-bind (reference vocabulary)
+        cond = get_condition(
+            g2.status.conditions,
+            PodGangConditionType.DISRUPTION_TARGET.value,
+        )
+        assert cond is not None and cond.status == "False"
+
+    def test_miss_migrated_blocks_vacated_source_reuse(self):
+        """Satellite regression: a same-named successor of a migrated
+        gang must NOT re-place onto the vacated source slot while the
+        move is in flight — today's staleness bug."""
+        h, node_of = frag_harness()
+        g, placed = spanning_target(h, node_of)
+        source = sorted(set(placed))
+        sched = h.scheduler
+        key = ("default", g.metadata.name)
+        old_reservation = sched._reservations[key]
+        assert sorted(old_reservation) == source
+        # stage the move but DELETE the gang's PCS before it re-binds
+        # (the scale-down-mid-migration window), then recreate the
+        # same-named workload: reuse must count miss-migrated, not
+        # silently re-place onto the source
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 1
+        h.store.delete(PodCliqueSet.KIND, "default", "target")
+        h.settle()
+        # the successor names its predecessor (same gang name)
+        h.apply(pcs("target", 2, 1.0))
+        h.settle()
+        ctr = h.cluster.metrics.counter(
+            "grove_scheduler_reservation_reuse_total"
+        )
+        assert ctr.value(outcome="miss-migrated") >= 1
+        g2, placed2 = gang_nodes(h, "target")
+        # it re-placed (general solve), not necessarily on the source
+        assert all(placed2)
+
+    def test_vacated_hints_suppressed_for_migrated_pods(self):
+        h, node_of = frag_harness()
+        g, placed = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        pod_keys = [
+            (r.namespace, r.name)
+            for gr in g.spec.pod_groups for r in gr.pod_references
+        ]
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 1
+        h.settle()
+        for key in pod_keys:
+            assert key not in h.scheduler._vacated
+        assert not h.scheduler._migration_suppress
+
+    def test_overflow_valve_evicts_oldest_not_in_flight(self):
+        """Review regression: the suppress/tombstone overflow valves
+        must evict the OLDEST entries — a wholesale clear would wipe
+        the move being staged right now, letting its deletions seed
+        vacated hints at the just-freed source."""
+        h, _ = frag_harness()
+        sched = h.scheduler
+        sched._migration_suppress = {
+            ("stale", f"p{i}"): None for i in range(100_000)
+        }
+        sched._migrated = {
+            ("stale", f"g{i}"): None for i in range(100_000)
+        }
+        fresh = [("default", "fresh-0"), ("default", "fresh-1")]
+        sched.stage_migration("default", "fresh", ("node-1",), fresh)
+        assert all(k in sched._migration_suppress for k in fresh)
+        assert ("default", "fresh") in sched._migrated
+        assert ("stale", "p0") not in sched._migration_suppress
+        assert len(sched._migration_suppress) == 100_000
+
+    def test_unstage_rolls_back_ticket_and_suppressions(self):
+        """A failed eviction after staging must not strand the ticket:
+        the gang would never re-enter the backlog to consume it, and a
+        pending ticket excludes it from future sweeps forever."""
+        h, node_of = frag_harness()
+        sched = h.scheduler
+        pod_keys = [("default", "p0"), ("default", "p1")]
+        sched.stage_migration("default", "s0-0", ("node-9",), pod_keys)
+        sched.unstage_migration("default", "s0-0", pod_keys)
+        assert ("default", "s0-0") not in sched._migrations
+        assert not sched._migration_suppress
+        # the tombstone stays: the old reservation was already purged
+        assert ("default", "s0-0") in sched._migrated
+
+    def test_eviction_rate_window_survives_manager_restart(self):
+        """The rolling evictions/hour window is cluster-owned (like the
+        disruption ledger): a crash-restart cannot launder a fresh
+        hourly allowance."""
+        h, _ = frag_harness()
+        h.defrag._evictions.append(h.clock.now())
+        h._build_manager()  # the chaos crash-restart path
+        assert len(h.defrag._evictions) == 1
+        assert h.defrag._evictions is h.cluster.defrag_evictions
+
+    def test_node_delete_purges_tickets(self):
+        h, node_of = frag_harness()
+        sched = h.scheduler
+        sched.stage_migration(
+            "default", "ghost", ("node-1", "node-2"), []
+        )
+        from grove_tpu.api.types import Node
+
+        h.store.delete(Node.KIND, "default", "node-1")
+        h.settle()
+        assert ("default", "ghost") not in sched._migrations
+
+
+def sched_migrated(h):
+    return h.scheduler._migrated
+
+
+# -- the end-to-end sweep -----------------------------------------------------
+
+class TestDefragSweep:
+    def test_admitted_move_improves_score_via_device_whatif(self):
+        h, node_of = frag_harness()
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 1
+        assert stats["whatif"] == "device"
+        h.settle()
+        g2, _ = gang_nodes(h, "target")
+        assert g2.status.placement_score == 1.0
+        # the fleet gauge follows
+        assert h.cluster.metrics.gauge(
+            "grove_scheduler_placement_score"
+        ).value() == 1.0
+        # per-gang scores surface in the debug dump (satellite)
+        dump = h.debug_dump()
+        assert dump["scheduler"]["placement"]["gangs"][
+            f"default/{g2.metadata.name}"
+        ] == 1.0
+        assert dump["defrag"]["moves_total"] == 1
+
+    def test_whatif_attribution_has_no_full_reencode(self):
+        h, node_of = frag_harness()
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        h.defrag_sweep()
+        kinds = h.defrag.dispatch_kinds
+        assert kinds.get("whatif", 0) == 1
+        # the acceptance contract: no full re-encode attributable to
+        # the sweep — the what-if rode the resident state + row deltas
+        assert kinds.get("fused", 0) == 0
+        assert kinds.get("split", 0) == 0
+        assert kinds.get("state_full_uploads", 0) == 0
+
+    def test_migration_audit_records_gain_cost_and_verdict(self):
+        h, node_of = frag_harness()
+        g, placed = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        h.defrag_sweep()
+        ex = h.cluster.decisions.explain("default", g.metadata.name)
+        rec = next(
+            r for r in reversed(ex["records"])
+            if r["outcome"] == "migration"
+        )
+        d = rec["detail"]
+        assert d["verdict"] == "admitted"
+        assert d["consumer"] == "defrag"
+        assert d["current_score"] == 0.25
+        assert d["candidate_score"] == 1.0
+        assert d["gain"] == 0.75
+        assert d["migration_cost"] == 0.02
+        assert d["net_gain"] == 0.73
+        assert sorted(d["from"]) == sorted(set(placed))
+        assert d["to"]
+
+    def test_rejected_gain_audited(self):
+        h, node_of = frag_harness(
+            config={"defrag": {**DEFRAG, "min_score_gain": 0.9}}
+        )
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 0
+        assert stats["rejected"] == {"rejected-gain": 1}
+        ex = h.cluster.decisions.explain("default", g.metadata.name)
+        rec = next(
+            r for r in reversed(ex["records"])
+            if r["outcome"] == "migration"
+        )
+        assert rec["detail"]["verdict"] == "rejected-gain"
+        # nothing was disturbed
+        g2, _ = gang_nodes(h, "target")
+        assert g2.status.placement_score == 0.25
+
+    def test_eviction_rate_bound(self):
+        h, node_of = frag_harness(
+            config={"defrag": {**DEFRAG, "max_evictions_per_hour": 1}}
+        )
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        assert h.defrag_sweep()["admitted"] == 1
+        h.settle()
+        # fragment a second gang the same way on the other block
+        free_one_on(h, node_of, nodes[2])
+        free_one_on(h, node_of, nodes[6])
+        h.settle()
+        h.apply(pcs("target2", 2, 1.0))
+        h.settle()
+        free_one_on(h, node_of, nodes[3])
+        free_one_on(h, node_of, nodes[3])
+        h.settle()
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 0
+        assert stats["rejected"].get("rejected-rate", 0) >= 1
+        # the rolling hour window releases the bound
+        h.advance(3601.0)
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 1
+
+    def test_disabled_by_default_and_cadence(self):
+        h = Harness(nodes=make_nodes(4))
+        assert h.maybe_defrag() is False
+        assert h.defrag_sweep() is None
+        h2, _ = frag_harness()
+        assert h2.maybe_defrag() is True   # first opportunity sweeps
+        assert h2.maybe_defrag() is False  # within the interval
+        h2.advance(61.0)
+        assert h2.maybe_defrag() is True
+
+
+# -- budget sharing with preemption -------------------------------------------
+
+class TestBudgetSharing:
+    def tenant_harness(self, budget):
+        return frag_harness(tenants=[
+            {"name": "default", "disruption_budget": budget},
+        ])
+
+    def test_defrag_rejects_when_preemption_spent_the_budget(self):
+        h, node_of = self.tenant_harness(budget=1)
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        # preemption spent the tenant's budget within the window
+        h.cluster.tenancy.ledger.charge(
+            "default", "preemption", h.clock.now()
+        )
+        stats = h.defrag_sweep()
+        assert stats["admitted"] == 0
+        assert stats["rejected"] == {"rejected-budget": 1}
+        ex = h.cluster.decisions.explain("default", g.metadata.name)
+        rec = next(
+            r for r in reversed(ex["records"])
+            if r["outcome"] == "migration"
+        )
+        d = rec["detail"]
+        assert d["verdict"] == "rejected-budget"
+        # the audit names which consumer spent what (satellite)
+        assert d["budget"] == {
+            "limit": 1, "spent_by": {"preemption": 1},
+        }
+        # outside the window the budget frees up again
+        h.advance(61.0)
+        assert h.defrag_sweep()["admitted"] == 1
+
+    def test_defrag_spend_blocks_preemption_in_the_window(self):
+        """The reverse direction, through the scheduler's own budget
+        check: a defrag charge in the window leaves no budget for a
+        preemption round — one window can never double-spend."""
+        h, node_of = self.tenant_harness(budget=1)
+        now = h.clock.now()
+        led = h.cluster.tenancy.ledger
+        led.charge("default", "defrag", now)
+        budget = h.cluster.tenancy.disruption_budget("default")
+        assert led.spent("default", now) >= budget
+        assert led.breakdown("default", now) == {"defrag": 1}
+
+    def test_armed_audit_raises_on_overspend(self):
+        h, node_of = self.tenant_harness(budget=1)
+        h.defrag.audit = True
+        h.cluster.tenancy.ledger.charge(
+            "default", "defrag", h.clock.now(), n=2
+        )
+        with pytest.raises(RuntimeError) as err:
+            h.defrag.sweep()
+        assert "disruption-budget audit" in str(err.value)
+        assert "defrag" in str(err.value)
+
+    def test_admitted_move_charges_the_shared_ledger(self):
+        h, node_of = self.tenant_harness(budget=3)
+        g, _ = spanning_target(h, node_of)
+        nodes = sorted(set(node_of.values()))
+        free_one_on(h, node_of, nodes[1])
+        free_one_on(h, node_of, nodes[1])
+        h.settle()
+        assert h.defrag_sweep()["admitted"] == 1
+        assert h.cluster.tenancy.ledger.breakdown(
+            "default", h.clock.now()
+        ) == {"defrag": 1}
+
+
+# -- chaos --------------------------------------------------------------------
+
+CHAOS_DEFRAG = {"defrag": {**DEFRAG, "sync_interval_seconds": 20.0,
+                           "max_moves_per_sweep": 2}}
+
+
+def chaos_workload():
+    from test_e2e_basic import simple_pcs
+
+    return simple_pcs(name="chaos", replicas=2)
+
+
+class TestDefragChaos:
+    def baseline(self):
+        from grove_tpu.chaos import settled_fingerprint
+
+        h = Harness(nodes=make_nodes(16), config=CHAOS_DEFRAG)
+        h.apply(chaos_workload())
+        h.settle()
+        return settled_fingerprint(h.store)
+
+    @pytest.mark.parametrize("seed", [2, 7])
+    def test_migration_storm_seeds_converge(self, seed):
+        from grove_tpu.chaos import (
+            ChaosHarness,
+            FaultPlan,
+            check_invariants,
+            settled_fingerprint,
+        )
+
+        plan = FaultPlan.from_seed(
+            seed, migration_storm_rate=0.5, migration_crash_rate=0.3,
+            migration_node_fault_rate=0.4,
+        )
+        ch = ChaosHarness(
+            plan, nodes=make_nodes(16), config=CHAOS_DEFRAG
+        )
+        assert ch.harness.defrag.audit is True  # armed by construction
+        ch.apply(chaos_workload())
+        ch.settle()
+        ch.run_chaos()
+        assert plan.counts.get("migration_storm", 0) > 0
+        assert settled_fingerprint(ch.raw_store) == self.baseline()
+        assert check_invariants(ch.raw_store) == []
+
+    def test_rate_zero_plans_never_draw_defrag_faults(self):
+        from grove_tpu.chaos import ChaosHarness, FaultPlan
+
+        plan = FaultPlan.from_seed(3)
+        ch = ChaosHarness(
+            plan, nodes=make_nodes(16), config=CHAOS_DEFRAG
+        )
+        ch.apply(chaos_workload())
+        ch.run_chaos()
+        assert "migration_storm" not in plan.counts
+        assert "migration_crash" not in plan.counts
+        assert "migration_node_fault" not in plan.counts

@@ -463,7 +463,7 @@ class TestFusedStaging:
         gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
         eng.solve(gangs, free=snap.free.copy())
         assert eng._dispatches == {"fused": 1, "split": 0,
-                                   "incremental": 0}
+                                   "incremental": 0, "whatif": 0}
 
     def test_staged_delta_rides_the_fused_launch(self):
         snap = cluster(blocks=2, racks=2, hosts=4, cpu=8.0)
@@ -480,7 +480,7 @@ class TestFusedStaging:
         # left behind, and the resident buffer caught up exactly
         assert eng._state.delta_uploads == 1
         assert eng._dispatches == {"fused": 2, "split": 0,
-                                   "incremental": 0}
+                                   "incremental": 0, "whatif": 0}
         assert eng._staged is None
         np.testing.assert_array_equal(
             decoded_state(eng), eng._masked_free(free)
@@ -659,7 +659,7 @@ class TestIncremental:
         ds = eng.debug_summary()["device_state"]
         assert ds["fused"] and ds["incremental"]
         assert ds["dispatches"] == {"fused": 1, "split": 0,
-                                    "incremental": 1}
+                                    "incremental": 1, "whatif": 0}
         assert ds["incremental_rows"] == 1
         assert ds["reuse_hits"] == 1
         assert ds["value_cache_resident"]
