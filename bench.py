@@ -357,6 +357,23 @@ def main() -> int:
                     help="--store-bench / --recovery: durable write-path "
                     "partition count for the partitioned side "
                     "(DurabilityConfig.partitions; default 4)")
+    ap.add_argument("--replication", action="store_true",
+                    help="HA object-store failover regime (ROADMAP item "
+                    "4b): run the fanned control-plane workload on the "
+                    "durable store with a SEMI-SYNC log-shipping "
+                    "standby, model total leader loss (host AND disk: "
+                    "no final catch-up) and measure "
+                    "failover-to-standby seconds (promote + settle) "
+                    "against the cold-restart recovery seconds of the "
+                    "SAME workload, interleaved A/B with min/median/"
+                    "max; asserts ZERO committed-write loss (promoted "
+                    "store seq + fingerprint against the leader's "
+                    "committed history). Also reports replication lag "
+                    "p50/p99 under --shards N fanned load (async "
+                    "bounded-lag mode) and the semi-sync "
+                    "commit-throughput tax vs async. Exits nonzero on "
+                    "any lost write, a failover median not beating the "
+                    "cold-restart median, or a vacuous run")
     ap.add_argument("--defrag", action="store_true",
                     help="continuous-defragmentation bench regime (ROADMAP "
                     "item 3): drive a LONG-CHURN gang arrival/departure "
@@ -390,6 +407,8 @@ def main() -> int:
     enable_compilation_cache()
     if args.store_bench:
         return bench_store(args)
+    if args.replication:
+        return bench_replication(args)
     if args.scale_tier:
         return bench_scale_tier(args)
     if args.diurnal:
@@ -2037,6 +2056,258 @@ def bench_recovery(num_nodes: int, replicas: int,
                 ],
             })
     return out
+
+
+def bench_replication(args) -> int:
+    """HA failover regime (`--replication`, ROADMAP item 4b) — three
+    probes over the fanned multi-namespace workload, every comparison
+    interleaved A/B (this host's throttling swings walls ~2x run-to-run;
+    the shared bench-noise discipline):
+
+      failover vs cold restart   Both sides settle the same workload on
+          a durable store and then lose the leader process at steady
+          state. The recovery side replays the WAL from disk
+          (Harness.cold_restart — the PR 9 posture, outage proportional
+          to history). The failover side promotes its SEMI-SYNC standby
+          with catch_up=False — total leader loss, host AND disk: only
+          the standby's already-applied state survives — and must come
+          back with ZERO committed-write loss (promoted seq equals the
+          leader's committed head; the settled fingerprint matches the
+          pre-kill fixpoint). The headline gate: failover p50 strictly
+          under recovery p50.
+
+      replication lag            The async bounded-lag mode under the
+          --shards N fanned control plane: lag sampled (records +
+          leader-clock seconds) after every settle step BEFORE the
+          driver's poll, p50/p99 reported — the alerting numbers the
+          runbook quotes.
+
+      semi-sync commit tax       The same apply/settle/delete cycle on
+          two live planes — ack async vs semi-sync — interleaved; the
+          tax is the ratio of settle-wall p50s (semi-sync pays one
+          standby apply + durable append inside every commit)."""
+    import os
+    import tempfile
+
+    from grove_tpu.chaos.harness import settled_fingerprint
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+
+    small = args.small
+    num_nodes = 64 if small else 200
+    fan = 8
+    per_pcs = 3 if small else 8
+    namespaces = 4
+    repeats = 3 if small else 5
+    churn_cycles = 1 if small else 2
+    partitions = max(args.partitions, 1)
+    failures: list[str] = []
+
+    def nodes():
+        return make_nodes(
+            num_nodes,
+            allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+        )
+
+    def durable_cfg(root: str, replication: bool = True,
+                    ack: str = "semi-sync", shards: int = 1) -> dict:
+        cfg: dict = {
+            "durability": {
+                "wal_dir": os.path.join(root, "wal"),
+                **({"partitions": partitions} if partitions > 1 else {}),
+            },
+        }
+        if replication:
+            cfg["replication"] = {
+                "enabled": True,
+                "ack_mode": ack,
+                "standby_wal_dir": os.path.join(root, "standby"),
+            }
+        if shards > 1:
+            cfg["controllers"] = {"shards": shards}
+        return cfg
+
+    def apply_all(h, tag: str) -> None:
+        for pcs in _fanned_workload(fan, per_pcs, tag, namespaces):
+            h.apply(pcs)
+
+    def delete_all(h, tag: str) -> None:
+        for j in range(fan):
+            h.store.delete(
+                "PodCliqueSet", f"bench-ns{j % namespaces}", f"{tag}-{j}"
+            )
+
+    def settled(h, tag: str) -> None:
+        """Settle the fanned workload plus churn cycles, growing the WAL
+        history the cold-restart side must replay (and the failover side
+        must NOT care about)."""
+        apply_all(h, tag)
+        h.settle()
+        for k in range(churn_cycles):
+            apply_all(h, f"{tag}c{k}")
+            h.settle()
+            delete_all(h, f"{tag}c{k}")
+            h.settle()
+
+    # -- probe A: failover vs cold restart, interleaved ---------------------
+    def failover_once(i: int) -> dict:
+        with tempfile.TemporaryDirectory(prefix="grove-repl-fo-") as td:
+            h = Harness(nodes=nodes(), config=durable_cfg(td))
+            settled(h, f"fo{i}")
+            fixpoint = settled_fingerprint(h.store)
+            committed = h.store.last_seq
+            t0 = time.perf_counter()
+            stats = h.promote_standby(catch_up=False)
+            promote_wall = time.perf_counter() - t0
+            if stats["lost_records"] or h.store.last_seq != committed:
+                failures.append(
+                    f"failover[{i}]: committed-write loss — leader head "
+                    f"{committed}, promoted head {h.store.last_seq}, "
+                    f"lost_records={stats['lost_records']}"
+                )
+            h.settle()
+            wall = time.perf_counter() - t0
+            if settled_fingerprint(h.store) != fixpoint:
+                failures.append(
+                    f"failover[{i}]: post-promotion fixpoint diverged"
+                )
+            return {"seconds": wall, "promote_seconds": promote_wall,
+                    "term": stats["term"]}
+
+    def recovery_once(i: int) -> dict:
+        with tempfile.TemporaryDirectory(prefix="grove-repl-cr-") as td:
+            h = Harness(
+                nodes=nodes(), config=durable_cfg(td, replication=False)
+            )
+            settled(h, f"cr{i}")
+            fixpoint = settled_fingerprint(h.store)
+            t0 = time.perf_counter()
+            stats = h.cold_restart()
+            replay_wall = time.perf_counter() - t0
+            h.settle()
+            wall = time.perf_counter() - t0
+            if settled_fingerprint(h.store) != fixpoint:
+                failures.append(
+                    f"recovery[{i}]: post-recovery fixpoint diverged"
+                )
+            return {"seconds": wall, "replay_seconds": replay_wall,
+                    "records": stats["wal_records_replayed"]}
+
+    fo_runs, cr_runs = interleaved_ab(failover_once, recovery_once,
+                                      repeats)
+    fo_walls = [r["seconds"] for r in fo_runs]
+    cr_walls = [r["seconds"] for r in cr_runs]
+    if p50(fo_walls) >= p50(cr_walls):
+        failures.append(
+            f"failover p50 {p50(fo_walls):.3f}s did not beat the "
+            f"cold-restart p50 {p50(cr_walls):.3f}s"
+        )
+
+    # -- probe B: replication lag under the sharded fanned load -------------
+    lag_records: list[int] = []
+    lag_seconds: list[float] = []
+    with tempfile.TemporaryDirectory(prefix="grove-repl-lag-") as td:
+        h = Harness(
+            nodes=nodes(),
+            config=durable_cfg(td, ack="async", shards=args.shards),
+        )
+        standby = h.cluster.standby
+        for step in range(4 if small else 6):
+            apply_all(h, f"lag{step}")
+            h.settle()
+            lag_records.append(standby.lag_records())
+            lag_seconds.append(standby.lag_seconds())
+            standby.poll()
+            delete_all(h, f"lag{step}")
+            h.settle()
+            lag_records.append(standby.lag_records())
+            lag_seconds.append(standby.lag_seconds())
+            standby.poll()
+            h.advance(1.0)
+        if standby.records_applied_total == 0:
+            failures.append("lag probe vacuous: standby applied nothing")
+        max_lag_bound = h.config.replication.max_lag_records
+
+    def pctl(samples: list, q: float):
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.999))]
+
+    # -- probe C: semi-sync commit tax, interleaved -------------------------
+    with tempfile.TemporaryDirectory(prefix="grove-repl-tax-") as td:
+        ha = Harness(
+            nodes=nodes(), config=durable_cfg(
+                os.path.join(td, "a"), ack="async"
+            )
+        )
+        hs = Harness(
+            nodes=nodes(), config=durable_cfg(
+                os.path.join(td, "s"), ack="semi-sync"
+            )
+        )
+        for h, tag in ((ha, "warma"), (hs, "warms")):
+            apply_all(h, tag)
+            h.settle()
+
+        def cycle(h, tag: str) -> float:
+            t0 = time.perf_counter()
+            apply_all(h, tag)
+            h.settle()
+            delete_all(h, tag)
+            h.settle()
+            # async mode may still trail: drain so both sides end each
+            # cycle fully shipped and the next cycle starts equal
+            h.cluster.standby.poll()
+            return time.perf_counter() - t0
+
+        async_walls, semi_walls = interleaved_ab(
+            lambda i: cycle(ha, f"taxa{i}"),
+            lambda i: cycle(hs, f"taxs{i}"),
+            repeats,
+        )
+    tax = p50(semi_walls) / p50(async_walls) if p50(async_walls) else 0.0
+
+    out = {
+        "metric": "store_failover",
+        "unit": "seconds",
+        "value": round(p50(fo_walls), 3),
+        "replication_nodes": num_nodes,
+        "replication_gangs": fan * per_pcs,
+        "replication_partitions": partitions,
+        "replication_lag_shards": args.shards,
+        "replication_repeats": repeats,
+        "failover_zero_loss": not any("loss" in f for f in failures),
+        "failover_terms": [r["term"] for r in fo_runs],
+        **wall_stats(fo_walls, "failover_", round_to=3),
+        **wall_stats([r["promote_seconds"] for r in fo_runs],
+                     "failover_promote_", round_to=3),
+        **wall_stats(cr_walls, "recovery_", round_to=3),
+        **wall_stats([r["replay_seconds"] for r in cr_runs],
+                     "recovery_replay_", round_to=3),
+        "recovery_records_replayed_p50": p50(
+            [r["records"] for r in cr_runs]
+        ),
+        "failover_vs_recovery_speedup": round(
+            p50(cr_walls) / p50(fo_walls), 2
+        ) if p50(fo_walls) else None,
+        "replication_lag_records_p50": pctl(lag_records, 0.50),
+        "replication_lag_records_p99": pctl(lag_records, 0.99),
+        "replication_lag_seconds_p50": round(pctl(lag_seconds, 0.50), 3),
+        "replication_lag_seconds_p99": round(pctl(lag_seconds, 0.99), 3),
+        "replication_max_lag_records_bound": max_lag_bound,
+        "semi_sync_tax": round(tax, 3),
+        **wall_stats(async_walls, "ack_async_cycle_", round_to=3),
+        **wall_stats(semi_walls, "ack_semi_sync_cycle_", round_to=3),
+        "backend": __import__("jax").default_backend(),
+    }
+    if pctl(lag_records, 0.99) > max_lag_bound:
+        failures.append(
+            f"async lag p99 {pctl(lag_records, 0.99)} exceeded the "
+            f"configured bound {max_lag_bound}"
+        )
+    for f in failures:
+        print(f"REPLICATION BENCH FAILURE: {f}", file=sys.stderr)
+    print(json.dumps(out))
+    return 1 if failures else 0
 
 
 def bench_controlplane_sharded(

@@ -452,6 +452,53 @@ class DurabilityConfig:
     partition_map: dict[str, int] = field(default_factory=dict)
 
 
+_REPLICATION_ACK_MODES = ("async", "semi-sync")
+
+
+@dataclass
+class ReplicationConfig:
+    """HA object store: a log-shipping standby (cluster/replication.py)
+    continuously tails the leader's WAL stream — one tailer per
+    partition, heap-merged by global seq, the same replay implementation
+    recovery uses — and applies records into a second, promotable
+    ObjectStore behind a bounded replication lag. Requires durability
+    (`durability.wal_dir`); off by default.
+
+      enabled           arm the standby (built at cluster construction,
+                        re-seedable after a standby crash)
+      standby_wal_dir   the standby's OWN durable directory (its
+                        bootstrap snapshot + every applied record are
+                        re-journaled here, so a promoted standby serves
+                        durably from the first write). Generations live
+                        under gen-NNNN subdirectories — a re-seeded
+                        standby starts a fresh one. Must differ from
+                        durability.wal_dir
+      ack_mode          "async"     — commits never wait; the standby
+                                      applies on its poll cadence, and
+                                      the leader forces a catch-up only
+                                      when the lag bounds are exceeded.
+                                      A failover that loses the leader's
+                                      disk loses at most the lag window
+                        "semi-sync" — a commit completes only once the
+                                      standby has durably appended the
+                                      record: the ZERO-LOSS mode the
+                                      failover bench measures (a stalled
+                                      standby degrades to async for the
+                                      stall, MySQL-semisync style, and
+                                      catches up at stall end)
+      max_lag_records   async backpressure: a commit that would leave the
+                        standby more than this many records behind
+                        triggers a synchronous catch-up poll
+      max_lag_seconds   same bound in leader-clock seconds
+    """
+
+    enabled: bool = False
+    standby_wal_dir: str | None = None
+    ack_mode: str = "async"
+    max_lag_records: int = 256
+    max_lag_seconds: float = 5.0
+
+
 @dataclass
 class OperatorConfig:
     api_version: str = API_VERSION
@@ -476,6 +523,7 @@ class OperatorConfig:
     log: LogConfig = field(default_factory=LogConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
 
 
 def _build(cls, data: Any, path: str, errs: list[str]):
@@ -517,6 +565,7 @@ _TYPES = {
     "LogConfig": LogConfig,
     "TracingConfig": TracingConfig,
     "DurabilityConfig": DurabilityConfig,
+    "ReplicationConfig": ReplicationConfig,
     "OperatorConfig": OperatorConfig,
 }
 
@@ -829,6 +878,50 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
                 "config.durability.partition_map: requires "
                 "config.durability.partitions > 1 (a single-partition "
                 "log has nothing to pin)"
+            )
+
+    rp = cfg.replication
+    if not isinstance(rp.enabled, bool):
+        errs.append("config.replication.enabled: must be a bool")
+    if rp.ack_mode not in _REPLICATION_ACK_MODES:
+        errs.append(
+            f"config.replication.ack_mode: must be one of "
+            f"{_REPLICATION_ACK_MODES}"
+        )
+    if not _int(rp.max_lag_records) or rp.max_lag_records < 1:
+        errs.append(
+            "config.replication.max_lag_records: must be an int >= 1"
+        )
+    if not _num(rp.max_lag_seconds) or rp.max_lag_seconds <= 0:
+        errs.append("config.replication.max_lag_seconds: must be > 0")
+    if rp.standby_wal_dir is not None and (
+        not isinstance(rp.standby_wal_dir, str) or not rp.standby_wal_dir
+    ):
+        errs.append(
+            "config.replication.standby_wal_dir: must be null or a "
+            "non-empty directory path"
+        )
+    if rp.enabled is True:
+        if not du.wal_dir:
+            # there is no WAL stream to tail without durability — an
+            # enabled-but-logless standby would be silently inert
+            errs.append(
+                "config.replication.enabled: requires "
+                "config.durability.wal_dir (the standby tails the "
+                "leader's WAL stream)"
+            )
+        if not rp.standby_wal_dir:
+            errs.append(
+                "config.replication.standby_wal_dir: required when "
+                "replication is enabled (the standby journals its "
+                "applied prefix durably so a promoted store serves "
+                "from disk-backed state)"
+            )
+        elif du.wal_dir and rp.standby_wal_dir == du.wal_dir:
+            errs.append(
+                "config.replication.standby_wal_dir: must differ from "
+                "config.durability.wal_dir — a standby journaling into "
+                "the leader's directory would interleave two histories"
             )
     return errs
 

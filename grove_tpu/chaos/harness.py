@@ -21,6 +21,7 @@ solve may legally pick a different (equally valid) placement.
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 from ..api.types import Node, Pod, PodClique, PodCliqueSet
@@ -164,6 +165,9 @@ class ChaosHarness:
         #: fault axis; see process_crash) + their recovery stats
         self.process_restarts = 0
         self.recovery_stats: list[dict[str, Any]] = []
+        #: standby failovers this run (the HA-replication fault axis;
+        #: see standby_promotion) — promotion stats ride recovery_stats
+        self.standby_promotions = 0
         sharded = self._sharded
         if sharded is not None:
             # the ownership audit rides every chaos round: a key
@@ -618,7 +622,17 @@ class ChaosHarness:
         if tear_tail:
             self._record("wal_torn_write")
             self._durable.tear_tail()
-        if corrupt_snapshot and self._durable.snapshot_seqs():
+        if corrupt_snapshot and self._durable.snapshot_seqs() and (
+            self._durable.can_survive_snapshot_corruption()
+        ):
+            # gated on survivability: the fault's contract is FALLBACK
+            # (recovery anchors on an older generation or a full
+            # segment chain), and a sole-anchor journal — a freshly
+            # promoted standby's bootstrap checkpoint — has nothing to
+            # fall back to; corrupting it would be injected data loss,
+            # not a recoverable fault. Leader directories always pass
+            # (their segment chains reach seq 0 until a full retention
+            # window exists), so pre-existing seeds are unchanged.
             self._record("snapshot_corruption")
             self._durable.corrupt_latest_snapshot()
         armed = self.chaos_store.armed
@@ -679,6 +693,130 @@ class ChaosHarness:
             self._durable.stall_partition(
                 plan.pick(num_parts), 2 + plan.pick(4)
             )
+
+    # -- HA-replication faults -------------------------------------------------
+    @property
+    def _standby(self):
+        """The cluster's StandbyReplica when replication is configured
+        and live, else None (replication faults and the per-step poll
+        cadence are skipped entirely — rate-guarded AND
+        capability-guarded, so pre-existing seeds replay identically
+        either way)."""
+        return getattr(self.harness.cluster, "standby", None)
+
+    def standby_promotion(self, dual_leader: bool = False) -> dict:
+        """Failover mid-plan: the leader process dies and the standby is
+        promoted — manager rebuilt over the promoted store, kubelet
+        relisted, a FRESH standby re-armed for the new leader (so later
+        replication draws keep firing), the chaos proxy's informer
+        memory cleared, exactly the process_crash re-derivation shape
+        but through the replication path instead of a disk replay.
+
+        dual_leader=True keeps the deposed leader's log ALIVE through
+        the promotion and PROVES the fence: its next append must raise
+        FencedAppend and its directory must be byte-unchanged — any
+        other outcome fails the seed loudly (the acceptance criterion:
+        a stale leader can never diverge the history)."""
+        from ..cluster.durability import FencedAppend
+
+        cluster = self.harness.cluster
+        old_log = cluster.durability
+        old_dirs = None
+        if dual_leader:
+            parts = getattr(old_log, "partitions", None) or [old_log]
+            old_dirs = {
+                p.dir: sorted(
+                    (n, os.path.getsize(os.path.join(p.dir, n)))
+                    for n in os.listdir(p.dir)
+                )
+                for p in parts
+            }
+        armed = self.chaos_store.armed
+        self.chaos_store.armed = False
+        try:
+            # force: chaos models the leader plane being dead — the
+            # coordination leases in the applied state are the DEAD
+            # fleet's and would otherwise hold promotion hostage for a
+            # lease duration of virtual time mid-storm (the honest
+            # lease-expiry wait is pinned by tests/test_replication.py)
+            stats = self.harness.promote_standby(force=True)
+            cluster.rebuild_standby()
+        finally:
+            self.chaos_store.armed = armed
+        self.chaos_store.reset_for_recovery()
+        self.standby_promotions += 1
+        self.recovery_stats.append(stats)
+        if self._sharded is not None:
+            self._sharded.audit = True
+            self._crashed_workers.clear()  # the fleet restarted
+        self._arm_defrag_audit()
+        if dual_leader:
+            # the deposed leader wakes up and tries to append: the term
+            # fence must refuse before a byte moves
+            ev = self.raw_store._events[-1] if self.raw_store._events \
+                else None
+            fenced = False
+            if ev is not None:
+                try:
+                    old_log.commit(self.raw_store, ev)
+                except FencedAppend:
+                    fenced = True
+                except Exception as exc:
+                    # any other failure shape means the fence did NOT
+                    # fire first (e.g. the append fell through to the
+                    # closed segment) — report it as the fence breach
+                    # it is, not an unrelated traceback
+                    raise RuntimeError(
+                        "dual-leader fence violated: the deposed "
+                        "leader's append did not raise FencedAppend "
+                        f"(got {type(exc).__name__}: {exc})"
+                    ) from exc
+            parts = getattr(old_log, "partitions", None) or [old_log]
+            now_dirs = {
+                p.dir: sorted(
+                    (n, os.path.getsize(os.path.join(p.dir, n)))
+                    for n in os.listdir(p.dir)
+                )
+                for p in parts
+            }
+            if ev is not None and not fenced:
+                raise RuntimeError(
+                    "dual-leader fence violated: the deposed leader's "
+                    "append was NOT refused"
+                )
+            if now_dirs != old_dirs:
+                raise RuntimeError(
+                    "dual-leader fence violated: the deposed leader's "
+                    "WAL directory changed after promotion"
+                )
+        return stats
+
+    def _inject_replication_faults(self) -> None:
+        """Per-step HA-replication fault draws (see FaultPlan): tailer
+        stalls, mid-plan failover, the dual-leader fence proof, standby
+        crash + re-seed. Every draw is guarded on rate > 0 AND on a
+        live standby being configured."""
+        plan = self.plan
+        if self._standby is None:
+            return
+        if plan.replication_stall_rate > 0 and plan.flip(
+            plan.replication_stall_rate
+        ):
+            self._record("replication_stall")
+            self._standby.stall_steps += 2 + plan.pick(4)
+        if plan.standby_crash_rate > 0 and plan.flip(
+            plan.standby_crash_rate
+        ):
+            self._record("standby_crash")
+            self.harness.cluster.rebuild_standby()
+        if plan.dual_leader_rate > 0 and plan.flip(plan.dual_leader_rate):
+            self._record("dual_leader")
+            self.standby_promotion(dual_leader=True)
+        if plan.standby_promotion_rate > 0 and plan.flip(
+            plan.standby_promotion_rate
+        ):
+            self._record("standby_promotion")
+            self.standby_promotion()
 
     def _repair_shards(self) -> None:
         """Disarm-time repair: crashed workers revive (fresh process,
@@ -764,6 +902,7 @@ class ChaosHarness:
                     self._inject_tenant_skew()
                 self._inject_shard_faults()
                 self._inject_durability_faults()
+                self._inject_replication_faults()
                 self._inject_serving_faults()
                 self._inject_defrag_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
@@ -787,6 +926,14 @@ class ChaosHarness:
                 self._tick_node_faults()
                 if self._durable is not None:
                     self._durable.tick_stall()
+                standby = self._standby
+                if standby is not None:
+                    # the async tailing cadence runs through the storm
+                    # (a semi-sync standby is already shipped per
+                    # commit; the poll is then a no-op) — no RNG draws,
+                    # so pre-existing seeds' sequences are untouched
+                    standby.poll()
+                    standby.tick_stall()
                 if self._serving is not None:
                     self.harness.cluster.pod_metrics.tick_dropout()
                 # give backoff requeues a chance to fire mid-chaos
@@ -799,6 +946,12 @@ class ChaosHarness:
                 # disarm-time repair, like every other fault class: the
                 # disk recovers, deferred snapshot work may resume
                 self._durable.stalled_steps = 0
+            if self._standby is not None:
+                # the standby's stall clears with the faults and it
+                # catches up to the leader's committed head — a settled
+                # chaos run leaves replication converged, not lagging
+                self._standby.stall_steps = 0
+                self._standby.poll()
             if self._serving is not None:
                 # injected spikes leave with the faults; the metrics
                 # pipeline resumes reporting immediately
@@ -927,6 +1080,7 @@ class ChaosHarness:
             ],
             "manager_restarts": self.manager_restarts,
             "process_restarts": self.process_restarts,
+            "standby_promotions": self.standby_promotions,
             # the durable-recovery audit trail: per crash, the snapshot
             # it recovered from, the WAL replay position it stopped at
             # (recovered_last_seq), torn/fallback outcomes — a failed

@@ -203,6 +203,35 @@ class FaultPlan:
     migration_crash_rate: float = 0.0
     migration_node_fault_rate: float = 0.0
 
+    # HA-replication faults (per chaos step; meaningful only when the
+    # harness runs config.replication.enabled — skipped entirely
+    # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the
+    # standing contract), so every pre-existing seed's draw sequence —
+    # and its verified convergence — is bit-identical.
+    #   replication_stall — the standby's tailing stalls for a few
+    #                       steps (network partition / slow standby):
+    #                       lag grows, semi-sync degrades to async for
+    #                       the window, and the standby must catch up
+    #                       at stall end — or RE-SEED if the leader's
+    #                       retention outran it
+    #   standby_promotion — the leader process dies mid-plan and the
+    #                       control plane fails over to the standby
+    #                       (promote + manager rebuild + kubelet
+    #                       relist); a fresh standby re-arms HA for the
+    #                       promoted leader so later draws keep firing
+    #   dual_leader       — a spurious promotion while the old leader
+    #                       is still live: the fault PROVES the fence —
+    #                       the deposed log's next append must raise
+    #                       FencedAppend and its directory must be
+    #                       byte-unchanged, else the seed fails loudly
+    #   standby_crash     — the standby process dies; a replacement
+    #                       re-seeds from the leader's snapshots into a
+    #                       fresh journal generation and resumes tailing
+    replication_stall_rate: float = 0.0
+    standby_promotion_rate: float = 0.0
+    dual_leader_rate: float = 0.0
+    standby_crash_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

@@ -7,6 +7,7 @@ from .inventory import make_nodes
 from .kubelet import SimKubelet
 from .cluster import Cluster
 from .nodehealth import NODE_LEASE_NAMESPACE, NodeLease
+from .replication import PromotionRefused, ReplicationLink, StandbyReplica
 
 __all__ = [
     "Cluster",
@@ -14,8 +15,11 @@ __all__ = [
     "NODE_LEASE_NAMESPACE",
     "NodeLease",
     "ObjectStore",
+    "PromotionRefused",
+    "ReplicationLink",
     "SimClock",
     "SimKubelet",
+    "StandbyReplica",
     "StoreError",
     "make_nodes",
 ]

@@ -97,6 +97,11 @@ class Cluster:
             )
             self.store.attach_durability(self.durability)
             if recovered_store is not None:
+                # the recovered history's leadership term resumes with
+                # the journal (promotion fencing, cluster/replication.py)
+                self.durability.term = self.store.recovery_stats.get(
+                    "term", 0
+                )
                 self.durability.checkpoint(self.store)
                 self.metrics.counter(
                     "grove_store_recoveries_total",
@@ -203,6 +208,7 @@ class Cluster:
                 else default_cluster_topology([])
             )
             self._init_caches()
+            self._init_replication()
             return
         # Topology sync at startup (clustertopology.go:41): ensure the
         # singleton ClusterTopology exists before any controller runs.
@@ -250,6 +256,7 @@ class Cluster:
         for node in nodes or []:
             self.store.create(node)
         self._init_caches()
+        self._init_replication()
 
     def _init_caches(self) -> None:
         """Derived-state caches, all rebuilt lazily from the store."""
@@ -309,6 +316,93 @@ class Cluster:
         self.store.flight_recorder = self.flight
         return self.tracer
 
+    # -- HA replication (cluster/replication.py) -----------------------------
+    def _init_replication(self) -> None:
+        """Build the log-shipping standby when config.replication is
+        enabled: the shared fencing link on the leader's log, a standby
+        store bootstrapped from the leader's durable directory, and the
+        per-commit ship hook (semi-sync appends before the commit
+        returns; async ships on lag-bound backpressure)."""
+        self.standby = None
+        self.replication_link = None
+        if not (self.config.replication.enabled and
+                self.durability is not None):
+            return
+        from .replication import ReplicationLink
+
+        self.replication_link = ReplicationLink(term=self.durability.term)
+        self.durability.link = self.replication_link
+        self._build_standby()
+
+    def _build_standby(self) -> None:
+        from .replication import StandbyReplica, next_generation
+
+        self.standby = StandbyReplica(
+            self.config, self.durability, self.store,
+            self.replication_link, metrics=self.metrics,
+            generation=next_generation(
+                self.config.replication.standby_wal_dir
+            ),
+        )
+        self.durability.post_commit = self.standby.on_leader_commit
+
+    def rebuild_standby(self) -> None:
+        """Standby replacement (the standby_crash chaos fault, or
+        re-arming HA after a promotion): the old replica's in-memory
+        state and journal generation are abandoned — its metric series
+        reconciled away — and a fresh standby bootstraps from the
+        CURRENT leader's snapshots + WAL into the next gen-NNNN
+        directory."""
+        if self.replication_link is None:
+            raise RuntimeError(
+                "rebuild_standby requires replication "
+                "(config.replication.enabled)"
+            )
+        if self.standby is not None:
+            self.standby.remove_metric_series()
+            self.standby.log.close()
+        self._build_standby()
+
+    def promote_standby(self, catch_up: bool = True) -> dict:
+        """Failover, store layer: seal + fence the standby
+        (StandbyReplica.promote), transplant its applied state into the
+        live store object in place (every runtime wiring — admission,
+        authorizer, chaos proxy, kubelet references — survives, the
+        recover_in_place discipline), re-home its journal onto the live
+        clock as the cluster's durability, and invalidate all derived
+        soft state. Control-plane re-derivation (lease fencing check,
+        manager rebuild, kubelet relist) is the harness's job: use
+        Harness.promote_standby, which calls this. The deposed leader's
+        log stays fenced — any append it attempts raises FencedAppend."""
+        if self.standby is None:
+            raise RuntimeError(
+                "promote_standby requires a live standby "
+                "(config.replication.enabled; a promoted cluster must "
+                "rebuild_standby() to re-arm HA)"
+            )
+        replica = self.standby
+        old_log = self.durability
+        old_log.post_commit = None
+        stats = replica.promote(catch_up=catch_up)
+        old_log.close()
+        self.store.adopt_state(replica.store, stats)
+        self.durability = replica.log
+        self.durability.adopt_clock(self.clock)
+        self.durability.adopt_metrics(self.metrics)
+        self.store.attach_durability(self.durability)
+        replica.remove_metric_series()
+        self.standby = None
+        self.invalidate_soft_state()
+        self.metrics.counter(
+            "grove_store_recoveries_total",
+            "store recoveries from durable state by outcome",
+        ).inc(outcome="promoted")
+        self.metrics.counter(
+            "grove_store_promotions_total",
+            "standby promotions by outcome",
+        ).inc(outcome="promoted")
+        return stats
+
     # -- durability / cold restart ------------------------------------------
     def invalidate_soft_state(self) -> None:
         """Drop every derived in-memory cache so the next read rebuilds
@@ -342,6 +436,9 @@ class Cluster:
                 "(config.durability.wal_dir)"
             )
         stats = self.store.recover_in_place(self.durability.dir)
+        self.durability.term = max(
+            self.durability.term, stats.get("term", 0)
+        )
         self.durability.checkpoint(self.store)
         self.invalidate_soft_state()
         self.metrics.counter(

@@ -90,8 +90,12 @@ SNAP_MAGIC = b"GRVSNP1\n"
 _HDR = struct.Struct("<II")
 
 #: record payload types (pickled tuples)
-_REC_EVENT = "event"      # ("event", seq, clock_now, Event)
+#: event records grow a 5th element — the writer's TERM — once the log
+#: has ever been promoted (term > 0); 4-tuple records from pre-HA
+#: histories replay as term 0, so old WALs stay readable
+_REC_EVENT = "event"      # ("event", seq, clock_now, Event[, term])
 _REC_COMPACT = "compact"  # ("compact", lsn, before_seq)
+_REC_TERM = "term"        # ("term", lsn, new_term) — a promotion fence
 
 _EVENT_SEQ_KEY = operator.attrgetter("seq")
 
@@ -108,6 +112,21 @@ LAYOUT_NAME = "layout.json"
 
 class DurabilityError(Exception):
     pass
+
+
+class FencedAppend(DurabilityError):
+    """A deposed leader tried to append into a history that has moved to
+    a higher term (a standby was promoted). Raised BEFORE anything is
+    written — in memory or on disk — so a stale leader can delay nothing
+    and diverge nothing (cluster/replication.py, the dual-leader chaos
+    fault)."""
+
+
+class ReplicaGap(DurabilityError):
+    """A WAL tailer fell behind the leader's retention window (a needed
+    segment was pruned before it was shipped): the standby cannot catch
+    up incrementally and must RE-SEED from the leader's snapshots
+    (StandbyReplica handles this by bootstrapping a fresh generation)."""
 
 
 def _crc(payload: bytes) -> int:
@@ -190,6 +209,16 @@ class DurableLog:
         #: snapshot work) — the store-bench reads the per-partition
         #: split to model parallel commit (bench.py --store-bench)
         self.wall_seconds = 0.0
+        #: HA replication (cluster/replication.py): the leadership TERM
+        #: this log writes under (0 = never promoted; stamped into every
+        #: record once > 0), the shared ReplicationLink carrying the
+        #: fleet's current term (None = no replication configured), the
+        #: per-commit ship hook semi-sync/bounded-lag replication
+        #: installs, and the fenced-append counter
+        self.term = 0
+        self.link = None
+        self.post_commit: Callable | None = None
+        self.fenced_appends_total = 0
         os.makedirs(self.dir, exist_ok=True)
         #: disk-stall fault state: while > 0, snapshot cuts are deferred
         #: (the disk is busy; appends still buffer) — chaos ticks it down
@@ -268,6 +297,44 @@ class DurableLog:
             self._segment.close()
             self._segment = None
 
+    # -- fencing (HA replication) -------------------------------------------
+    def check_fence(self) -> None:
+        """Refuse to extend a history that moved to a higher term: a
+        promoted standby bumped the shared ReplicationLink's term, and a
+        deposed leader waking up must fail its append — BEFORE any state,
+        in memory or on disk, changes (ObjectStore._emit calls this ahead
+        of the event-list append). Models the channel-level refusal a
+        real standby gives a lower-term shipper (and the epoch check a
+        fencing-aware WAL store performs per append)."""
+        if self.link is not None and self.link.term > self.term:
+            self.fenced_appends_total += 1
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "grove_store_fenced_appends_total",
+                    "appends refused because the history moved to a "
+                    "higher term (a standby was promoted)",
+                ).inc(**self._labels())
+            raise FencedAppend(
+                f"append fenced: this log writes term {self.term} but "
+                f"the store history is at term {self.link.term} (a "
+                "standby was promoted); a deposed leader must not "
+                "diverge the history"
+            )
+
+    def bump_term(self, term: int) -> None:
+        """Promotion: adopt a new leadership term — journaled as its own
+        record so recovery reproduces the fence point, and stamped into
+        every subsequent event record. The caller (StandbyReplica.promote)
+        bumps the shared link too, which is what actually deposes the old
+        leader."""
+        if term <= self.term:
+            raise DurabilityError(
+                f"term must increase (have {self.term}, got {term})"
+            )
+        self.term = term
+        if self._segment is not None:
+            self._append((_REC_TERM, self._applied_seq, term))
+
     # -- the commit path ----------------------------------------------------
     def commit(self, store: "ObjectStore", event) -> None:
         """Called by ObjectStore._emit for every committed mutation: append
@@ -276,13 +343,20 @@ class DurableLog:
         see them); fsync is governed by the policy — `commit` makes every
         acknowledged write crash-durable, `snapshot`/`never` trade the
         tail since the last fsync for throughput."""
+        self.check_fence()
         t0 = time.perf_counter()
         self._applied_seq = event.seq
         # the clock stamp lets a new-process boot resume virtual time at
         # the last committed write, not the (older) last snapshot
-        self._append((_REC_EVENT, event.seq, self.clock.now(), event))
+        rec = (_REC_EVENT, event.seq, self.clock.now(), event)
+        self._append(rec + (self.term,) if self.term else rec)
         self._maybe_snapshot(store)
         self.wall_seconds += time.perf_counter() - t0
+        if self.post_commit is not None:
+            # replication ship hook (outside wall_seconds: the standby
+            # keeps its own ship accounting) — semi-sync appends to the
+            # standby's journal before the commit returns
+            self.post_commit(store, event)
 
     def log_compaction(self, store: "ObjectStore", before_seq: int) -> None:
         """Journal an in-memory event-log compaction (compact_events) so
@@ -387,6 +461,9 @@ class DurableLog:
                 "events": list(store._events),
                 "clock": store.clock.now(),
             }
+        # the term rides every snapshot image (default 0 pre-HA; old
+        # snapshots without the key recover as term 0)
+        state.setdefault("term", self.term)
         payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         path = self._snapshot_path(seq)
         tmp = path + ".tmp"
@@ -454,11 +531,26 @@ class DurableLog:
         bases = self.segment_bases()
         return bases[0] if bases else 0
 
+    def adopt_clock(self, clock) -> None:
+        """Re-home the log onto another clock (promotion: the standby's
+        journal joins the live cluster's virtual time; the snapshot
+        cadence restarts from now)."""
+        self.clock = clock
+        self._last_snapshot_time = clock.now()
+
+    def adopt_metrics(self, metrics) -> None:
+        """Promotion: the standby's journal (built metric-less — its
+        appends must not count into the LEADER's WAL series) starts
+        exporting as the cluster's durability."""
+        self.metrics = metrics
+
     def debug_state(self) -> dict[str, Any]:
         snaps = self.snapshot_seqs()
         return {
             "wal_dir": self.dir,
             "fsync": self.config.fsync,
+            "term": self.term,
+            "fenced_appends_total": self.fenced_appends_total,
             "wal_records_total": self.wal_records_total,
             "wal_bytes_total": self.wal_bytes_total,
             "segment_bytes": self._segment_bytes,
@@ -481,6 +573,37 @@ class DurableLog:
         self._segment.write(_HDR.pack(1 << 20, 0))
         self._segment.write(b"torn-in-flight-append")
         self._segment.flush()
+
+    def seal_bootstrap(self) -> None:
+        """A bootstrap-SEEDED journal (a standby generation): the empty
+        genesis segment opened at construction implies history from
+        seq 0 this directory never actually held — records at or below
+        the bootstrap image exist only as the checkpoint snapshot. Drop
+        it so recovery's gap check (and the corruption-survivability
+        gate) see the journal's true floor instead of a phantom full
+        chain. No-op when the journal genuinely starts at seq 0."""
+        if self.last_snapshot_seq <= 0:
+            return
+        path = self._segment_path(0)
+        try:
+            if os.path.getsize(path) <= len(WAL_MAGIC):
+                os.unlink(path)
+        except FileNotFoundError:
+            pass
+
+    def can_survive_snapshot_corruption(self) -> bool:
+        """Whether losing the NEWEST snapshot still leaves an anchored
+        recovery: another retained snapshot to fall back to, or a
+        segment chain reaching seq 0 (full replay). False for a young
+        standby journal — its bootstrap checkpoint is the sole anchor
+        and no WAL exists below it (seal_bootstrap), so a corruption
+        there is unrecoverable by construction (the chaos corruption
+        draw is gated on this: its contract is fallback, not data
+        loss)."""
+        if len(self.snapshot_seqs()) >= 2:
+            return True
+        bases = self.segment_bases()
+        return bool(bases) and bases[0] == 0
 
     def corrupt_latest_snapshot(self) -> str | None:
         """Flip bytes in the middle of the newest snapshot (bit-rot /
@@ -570,8 +693,17 @@ class PartitionedLog:
             "partitions": self.num_partitions,
             "partition_map": dict(sorted(self._map.items())),
         }
+        #: replication facade state (see DurableLog): the shared link +
+        #: ship hook live on the FACADE — partitions never fence or ship
+        #: individually (one check, one ship, per logical commit)
+        self.link = None
+        self.post_commit = None
+        self._fenced_appends = 0
         if resume:
             on_disk = self._read_layout(marker)
+            # the promotion term rides the marker but is NOT part of the
+            # pinned partition scheme — strip it before comparing
+            on_disk = {k: v for k, v in on_disk.items() if k != "term"}
             if on_disk != layout:
                 raise DurabilityError(
                     f"{self.dir!r} was written under partition layout "
@@ -689,11 +821,79 @@ class PartitionedLog:
 
         return capture
 
+    # -- fencing / terms (HA replication; see DurableLog) --------------------
+    @property
+    def term(self) -> int:
+        return self.partitions[0].term
+
+    @term.setter
+    def term(self, value: int) -> None:
+        for p in self.partitions:
+            p.term = value
+
+    @property
+    def fenced_appends_total(self) -> int:
+        return self._fenced_appends
+
+    def check_fence(self) -> None:
+        if self.link is not None and self.link.term > self.term:
+            self._fenced_appends += 1
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "grove_store_fenced_appends_total",
+                    "appends refused because the history moved to a "
+                    "higher term (a standby was promoted)",
+                ).inc()
+            raise FencedAppend(
+                f"append fenced: this log writes term {self.term} but "
+                f"the store history is at term {self.link.term} (a "
+                "standby was promoted); a deposed leader must not "
+                "diverge the history"
+            )
+
+    def bump_term(self, term: int) -> None:
+        """Promotion: journal the term record to EVERY partition (the
+        merge applies the K copies idempotently, like compactions) and
+        pin the new term into the layout marker."""
+        for p in self.partitions:
+            p.bump_term(term)
+        marker = os.path.join(self.dir, LAYOUT_NAME)
+        layout = self._read_layout(marker)
+        layout["term"] = term
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(layout, fh)
+            fh.write("\n")
+        os.replace(tmp, marker)
+
+    def seal_bootstrap(self) -> None:
+        for p in self.partitions:
+            p.seal_bootstrap()
+
+    def adopt_clock(self, clock) -> None:
+        self.clock = clock
+        for p in self.partitions:
+            p.adopt_clock(clock)
+
+    def adopt_metrics(self, metrics) -> None:
+        self.metrics = metrics
+        for p in self.partitions:
+            p.adopt_metrics(metrics)
+        if metrics is not None:
+            metrics.gauge(
+                "grove_store_partitions",
+                "configured durable write-path partitions",
+            ).set(self.num_partitions)
+            self._reconcile_metric_series()
+
     # -- the DurableLog facade ----------------------------------------------
     def commit(self, store: "ObjectStore", event) -> None:
+        self.check_fence()
         idx = self.partition_of(event.namespace, event.kind)
         self._last_commit_partition = idx
         self.partitions[idx].commit(store, event)
+        if self.post_commit is not None:
+            self.post_commit(store, event)
 
     def log_compaction(self, store: "ObjectStore", before_seq: int) -> None:
         """Journaled to EVERY partition: each partition's replay must
@@ -789,6 +989,8 @@ class PartitionedLog:
             "wal_dir": self.dir,
             "fsync": self.config.fsync,
             "partitions": self.num_partitions,
+            "term": self.term,
+            "fenced_appends_total": self.fenced_appends_total,
             "wal_records_total": self.wal_records_total,
             "wal_bytes_total": self.wal_bytes_total,
             "segments": sum(len(p.segment_bases()) for p in self.partitions),
@@ -864,15 +1066,25 @@ class PartitionedLog:
         self.partitions[idx].tear_tail()
         return idx
 
-    def corrupt_latest_snapshot(self) -> str | None:
-        """Corrupt the globally newest snapshot across partitions (the
-        chaos snapshot_corruption facade)."""
+    def _newest_snapshot_partition(self):
         best = None
         best_seq = -1
         for p in self.partitions:
             seqs = p.snapshot_seqs()
             if seqs and seqs[-1] > best_seq:
                 best, best_seq = p, seqs[-1]
+        return best
+
+    def can_survive_snapshot_corruption(self) -> bool:
+        """The corruption facade lands on the partition holding the
+        globally newest snapshot — survivability is that partition's."""
+        best = self._newest_snapshot_partition()
+        return best is not None and best.can_survive_snapshot_corruption()
+
+    def corrupt_latest_snapshot(self) -> str | None:
+        """Corrupt the globally newest snapshot across partitions (the
+        chaos snapshot_corruption facade)."""
+        best = self._newest_snapshot_partition()
         return best.corrupt_latest_snapshot() if best is not None else None
 
     def corrupt_partition_snapshot(self, idx: int) -> str | None:
@@ -1033,6 +1245,118 @@ class _ReplayStream:
                 covered = max(covered, bases[i + 1])
 
 
+class WalTailer:
+    """Incremental byte-offset reader of one DurableLog directory's
+    segment chain — the stream-tail half of the replay implementation
+    (HA replication rides it; recovery uses the one-shot _ReplayStream).
+    Each poll() yields only the records appended since the previous
+    poll, following segment rotations. A torn record at the live tail
+    HOLDS the position (it is either an in-flight append or an
+    unacknowledged injected tear — retry next poll) unless a newer
+    segment exists, in which case the rotation sealed the tear (the
+    recovery-checkpoint contract) and the tailer skips into the next
+    generation. A segment vanishing under the tailer (pruned past the
+    retention window while the standby lagged) raises ReplicaGap — the
+    caller must re-seed from snapshots."""
+
+    def __init__(self, dirpath: str, applied_seq: int = 0):
+        self.dir = dirpath
+        #: event-seq dedup filter: records at or below it are skipped
+        #: (how a freshly bootstrapped tailer fast-forwards through the
+        #: retained chain to its recovery point)
+        self.applied_seq = applied_seq
+        self._base: int | None = None
+        self._offset = 0
+
+    def _bases(self) -> list[int]:
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            int(m.group(1)) for m in map(_SEG_RE.match, names) if m
+        )
+
+    def _path(self, base: int) -> str:
+        return os.path.join(self.dir, f"wal-{base:020d}.log")
+
+    def poll(self):
+        """Yield every record appended since the last poll (events past
+        `applied_seq` only; compaction/term records always). Generator —
+        the caller must drain it for the position to advance."""
+        bases = self._bases()
+        if self._base is None:
+            if not bases:
+                return  # nothing journaled yet; retry later
+            # first poll: skip segments the bootstrap recovery already
+            # covered (a segment is skippable when the NEXT base is at
+            # or below the applied position — _ReplayStream's rule), so
+            # the first poll is O(new records), not a second CRC pass
+            # over the whole retained chain. Term/compaction records in
+            # skipped segments are already folded into the bootstrap
+            # image (log.term, _compacted_seq) and re-apply
+            # idempotently anyway; the seq filter dedups the rest.
+            start = 0
+            for i in range(len(bases) - 1):
+                if bases[i + 1] <= self.applied_seq:
+                    start = i + 1
+            self._base, self._offset = bases[start], 0
+        while True:
+            try:
+                with open(self._path(self._base), "rb") as fh:
+                    if self._offset == 0:
+                        magic = fh.read(len(WAL_MAGIC))
+                        if len(magic) < len(WAL_MAGIC):
+                            return  # header still in flight
+                        if magic != WAL_MAGIC:
+                            raise ReplicaGap(
+                                f"{self._path(self._base)!r}: bad WAL "
+                                "magic while tailing"
+                            )
+                        self._offset = len(WAL_MAGIC)
+                    else:
+                        fh.seek(self._offset)
+                    while True:
+                        hdr = fh.read(_HDR.size)
+                        if not hdr:
+                            break  # clean EOF: caught up in this segment
+                        if len(hdr) < _HDR.size:
+                            break  # torn/in-flight: hold position
+                        length, crc = _HDR.unpack(hdr)
+                        payload = fh.read(length)
+                        if len(payload) < length or _crc(payload) != crc:
+                            break  # torn/in-flight: hold position
+                        try:
+                            rec = pickle.loads(payload)
+                        except Exception:
+                            break  # torn/in-flight: hold position
+                        self._offset += _HDR.size + length
+                        if rec[0] == _REC_EVENT:
+                            if rec[1] <= self.applied_seq:
+                                continue
+                            self.applied_seq = rec[1]
+                        yield rec
+            except FileNotFoundError:
+                # the segment we pointed at was pruned: the leader's
+                # retention window moved past us — whether we had read
+                # it fully is unknowable from here, so the standby must
+                # re-anchor on a snapshot
+                raise ReplicaGap(
+                    f"segment wal-{self._base:020d}.log vanished under "
+                    f"the tailer in {self.dir!r} (retention outran "
+                    "replication); re-seed from snapshots"
+                ) from None
+            newer = [b for b in self._bases() if b > self._base]
+            if not newer:
+                # live tail: a torn record here is an unacknowledged
+                # in-flight append — hold position, retry next poll
+                return
+            # rotation happened: the current segment is complete (a torn
+            # tail was sealed unacknowledged — recovery checkpointed past
+            # it); continue into the next generation
+            self._base, self._offset = min(newer), 0
+
+
 def load_durable_state(wal_dir: str, store: "ObjectStore") -> dict[str, Any]:
     """Rebuild `store` (whose state containers must be empty) from the
     durable dir: newest valid snapshot, then WAL replay in seq order,
@@ -1085,10 +1409,13 @@ def load_durable_state(wal_dir: str, store: "ObjectStore") -> dict[str, Any]:
             store.clock._now = max(store.clock._now, state["clock"])
 
     max_uid = store._uid
+    term = state.get("term", 0) if state is not None else 0
     stream = _ReplayStream(wal_dir, snapshot_seq)
     for rec in stream.records():
         if rec[0] == _REC_EVENT:
-            _, _seq, stamp, ev = rec
+            stamp, ev = rec[2], rec[3]
+            if len(rec) > 4:
+                term = max(term, rec[4])
             _replay_event(store, ev)
             if hasattr(store.clock, "_now"):
                 store.clock._now = max(store.clock._now, stamp)
@@ -1096,6 +1423,8 @@ def load_durable_state(wal_dir: str, store: "ObjectStore") -> dict[str, Any]:
                 m = _UID_RE.match(ev.obj.metadata.uid or "")
                 if m:
                     max_uid = max(max_uid, int(m.group(1)) + 1)
+        elif rec[0] == _REC_TERM:
+            term = max(term, rec[2])
         elif rec[0] == _REC_COMPACT:
             # journaled with the post-clamp horizon; idempotent, so a
             # compaction already reflected in the snapshot re-applies
@@ -1121,6 +1450,7 @@ def load_durable_state(wal_dir: str, store: "ObjectStore") -> dict[str, Any]:
         "wal_records_replayed": stream.replayed,
         "torn_tail": stream.torn,
         "recovered_last_seq": last,
+        "term": term,
     }
 
 
@@ -1152,6 +1482,11 @@ def _load_partitioned_state(
     events: list = []
     snapshots_skipped = 0
     max_uid = store._uid
+    # the layout marker is a term floor, not just bookkeeping: the
+    # promotion checkpoint TRUNCATES the segment that held the term
+    # record, so a post-promotion snapshot falling to corruption could
+    # otherwise recover a pre-promotion term — the marker survives
+    term = layout.get("term", 0)
     streams: list[tuple[str, _ReplayStream]] = []
     snapshot_seqs: dict[str, int] = {}
     for name in pdirs:
@@ -1161,6 +1496,7 @@ def _load_partitioned_state(
         snap_seq = 0
         if state is not None:
             snap_seq = state["last_seq"]
+            term = max(term, state.get("term", 0))
             max_uid = max(max_uid, state["uid"])
             store._compacted_seq = max(
                 store._compacted_seq, state["compacted_seq"]
@@ -1220,7 +1556,9 @@ def _load_partitioned_state(
     )
     for _key, rec in merged:
         if rec[0] == _REC_EVENT:
-            _, _seq, stamp, ev = rec
+            stamp, ev = rec[2], rec[3]
+            if len(rec) > 4:
+                term = max(term, rec[4])
             apply_event(ev)
             replayed += 1
             if hasattr(store.clock, "_now"):
@@ -1229,6 +1567,8 @@ def _load_partitioned_state(
                 m = _UID_RE.match(ev.obj.metadata.uid or "")
                 if m:
                     max_uid = max(max_uid, int(m.group(1)) + 1)
+        elif rec[0] == _REC_TERM:
+            term = max(term, rec[2])
         elif rec[0] == _REC_COMPACT:
             # K journaled copies (one per partition) apply idempotently
             _, _lsn, before_seq = rec
@@ -1252,6 +1592,7 @@ def _load_partitioned_state(
         "wal_records_replayed": replayed,
         "torn_tail": torn,
         "recovered_last_seq": last,
+        "term": term,
         "partitions": {
             name: {
                 "snapshot_seq": snapshot_seqs[name],

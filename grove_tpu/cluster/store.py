@@ -470,6 +470,12 @@ class ObjectStore:
         """Append a watch event. The store is MVCC — every write REPLACES
         the stored object with a new version and never mutates old versions
         — so events reference versions directly; no snapshot copies."""
+        if self._wal is not None:
+            # HA fencing (cluster/replication.py): a deposed leader's
+            # append fails here — seq counter, event log and WAL all
+            # stay untouched, so the durable history (the only state a
+            # deposed process can leak into the world) never extends
+            self._wal.check_fence()
         seq = next(self._seq)
         self._kind_serial[obj.KIND] = seq
         event = Event(
@@ -821,6 +827,33 @@ class ObjectStore:
         store = cls(clock=clock)
         store.recovery_stats = load_durable_state(wal_dir, store)
         return store
+
+    def adopt_state(self, other: "ObjectStore", stats: dict | None = None
+                    ) -> None:
+        """Replace THIS store's state with another store's — the standby
+        PROMOTION analog of recover_in_place: every piece of runtime
+        wiring (admission chains, authorizer, flight recorder, attached
+        DurableLog, clock identity) stays, while objects, event log,
+        indexes and counters become the donor's. The donor is consumed —
+        its containers are adopted by reference, never copied — and must
+        not be used afterwards. The live clock only moves FORWARD (the
+        donor's applied stamps are at or behind the leader's clock)."""
+        self._objs = {k: b for k, b in other._objs.items() if b}
+        self._events = list(other._events)
+        self._label_idx = {}
+        for kind, bucket in self._objs.items():
+            for key, obj in bucket.items():
+                self._index_add(kind, key, obj)
+        self._kind_serial = dict(other._kind_serial)
+        self._compacted_seq = other._compacted_seq
+        self._uid = other._uid
+        last = (
+            self._events[-1].seq if self._events else self._compacted_seq
+        )
+        self._seq = itertools.count(last + 1)
+        if hasattr(self.clock, "_now"):
+            self.clock._now = max(self.clock._now, other.clock.now())
+        self.recovery_stats = stats or {"outcome": "promoted"}
 
     def recover_in_place(self, wal_dir: str) -> dict:
         """Replace THIS store's state with the recovered image, keeping

@@ -263,6 +263,63 @@ class Harness:
         self.kubelet.reset_for_recovery()
         return stats
 
+    def promote_standby(self, catch_up: bool = True,
+                        force: bool = False) -> dict:
+        """Failover to the log-shipping standby (requires
+        config.replication.enabled) — the seconds-scale alternative to
+        cold_restart()'s history-proportional disk replay:
+
+          - the LEASE FENCE runs first (PR 8 machinery): a fresh
+            coordination lease in the standby's applied state — leader
+            election, shard workers, the coordinator — means the leader
+            plane is still renewing, and promotion refuses with
+            PromotionRefused (`grove_store_promotions_total{outcome=
+            "fence-refused"}`) rather than opening a dual-leader window
+            on purpose. force=True overrides when the operator knows the
+            leader is gone (the term fence still guarantees a surviving
+            stale leader cannot diverge the history);
+          - the standby seals its applied prefix behind a fresh
+            checkpoint, bumps the leadership term (stamped into every
+            subsequent WAL record) and becomes the store — transplanted
+            in place so every runtime reference survives
+            (Cluster.promote_standby);
+          - the dead leader's coordination leases and ShardMap expire,
+            the manager/scheduler rebuild (the sharded control plane
+            re-points at the promoted store), and the kubelet relists —
+            exactly the cold_restart re-derivation.
+
+        catch_up=False models TOTAL leader loss (host and disk): the
+        standby serves only its already-applied prefix — zero loss under
+        semi-sync, at most the lag window under async. After settle()
+        the control plane reaches the same fixpoint (tests/
+        test_replication.py pins this; chaos arms it as the
+        standby_promotion fault). Returns the promotion stats."""
+        cluster = self.cluster
+        if cluster.standby is None:
+            raise RuntimeError(
+                "promote_standby requires a live standby "
+                "(config.replication.enabled)"
+            )
+        if not force:
+            from ..cluster.replication import PromotionRefused
+
+            reason = cluster.standby.leader_lease_blocks(self.clock.now())
+            if reason is not None:
+                cluster.metrics.counter(
+                    "grove_store_promotions_total",
+                    "standby promotions by outcome",
+                ).inc(outcome="fence-refused")
+                cluster.metrics.counter(
+                    "grove_store_recoveries_total",
+                    "store recoveries from durable state by outcome",
+                ).inc(outcome="fence-refused")
+                raise PromotionRefused(reason)
+        stats = cluster.promote_standby(catch_up=catch_up)
+        self._expire_coordination()
+        self._build_manager()
+        self.kubelet.reset_for_recovery()
+        return stats
+
     def _expire_coordination(self) -> None:
         _expire_coordination_objects(self.store, self.config)
 

@@ -28,6 +28,17 @@ class Lease:
     KIND = "Lease"
 
 
+def lease_fresh(lease: Lease, now: float) -> bool:
+    """A lease is FRESH while its holder has renewed within the lease
+    duration of `now` — the one freshness predicate leader-election
+    takeover, shard-worker liveness (controller/sharding.py) and
+    standby-promotion fencing (cluster/replication.py) all share, so
+    "who may act" can never drift between the three."""
+    return bool(lease.holder_identity) and (
+        now - lease.renew_time <= lease.lease_duration_seconds
+    )
+
+
 class LeaderElector:
     """Acquire/renew/yield one named lease.
 
@@ -72,11 +83,9 @@ class LeaderElector:
                 lease.renew_time = now   # settle loop runs many rounds
                 self.store.update(lease)  # per clock instant)
             return True
-        if (
-            not lease.holder_identity  # released: immediately acquirable
-            or now - lease.renew_time > lease.lease_duration_seconds
-        ):
-            # holder stopped renewing (crashed): take over
+        if not lease_fresh(lease, now):
+            # released (immediately acquirable) or the holder stopped
+            # renewing (crashed): take over
             lease.holder_identity = self.identity
             lease.renew_time = now
             self.store.update(lease)

@@ -56,7 +56,7 @@ from typing import Callable, Optional
 
 from ..api.meta import ObjectMeta
 from ..observability.tracing import NOOP_TRACER
-from .leaderelection import Lease, LeaderElector
+from .leaderelection import Lease, LeaderElector, lease_fresh
 from .runtime import ControllerManager, Request
 
 #: namespace holding the coordination objects (same as leader election)
@@ -477,10 +477,7 @@ class ShardedManager:
             name = lease.metadata.name
             if not name.startswith(WORKER_LEASE_PREFIX):
                 continue
-            if (
-                lease.holder_identity
-                and now - lease.renew_time <= lease.lease_duration_seconds
-            ):
+            if lease_fresh(lease, now):
                 fresh.add(lease.holder_identity)
         return fresh
 

@@ -134,6 +134,12 @@ def harness_dump(harness) -> dict[str, Any]:
         # the tenant-queue arithmetic behind admission/fairness decisions
         # (grove_tpu/tenancy): shares, entitlements, deficits, budgets
         out["tenancy"] = tenancy.debug_state()
+    standby = getattr(harness.cluster, "standby", None)
+    if standby is not None:
+        # the HA log-shipping standby (cluster/replication.py): applied
+        # position, lag, terms, ack-mode posture — the runbook's first
+        # stop for "can I promote right now, and what would it cost"
+        out["replication"] = standby.debug_state()
     serving = getattr(harness.cluster, "serving", None)
     if serving is not None:
         # the elastic-serving loop (grove_tpu/serving): trace shape,

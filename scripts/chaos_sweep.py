@@ -167,6 +167,16 @@ DURABILITY_CONFIG = {
 }
 
 
+#: replication config for --replication sweeps: the log-shipping
+#: standby in SEMI-SYNC (the zero-loss mode — every commit ships before
+#: it returns), on top of the --durability axis. standby_wal_dir is
+#: filled in per seed next to the leader's wal_dir.
+REPLICATION_CONFIG = {
+    "enabled": True,
+    "ack_mode": "semi-sync",
+}
+
+
 #: solver config for --hierarchical sweeps: the min-nodes forced-flat
 #: threshold dropped to 0 so the two-level solve engages on the sweep's
 #: small clusters (the workload adds the rack confinement it needs)
@@ -196,10 +206,24 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              shards: int = 1,
              durability: bool = False,
              partitions: int = 1,
+             replication: bool = False,
              serving: bool = False,
              hierarchical: bool = False,
              defrag: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    if replication:
+        # the HA-replication fault axis: standby tailing stalls
+        # (semi-sync degrades for the window, must catch up), mid-plan
+        # failovers (promote + manager rebuild + re-armed standby),
+        # dual-leader fence proofs (the deposed log's append must be
+        # refused or the seed fails), standby crashes re-seeding from
+        # the leader's snapshots
+        overrides.update(
+            replication_stall_rate=0.2,
+            standby_promotion_rate=0.08,
+            dual_leader_rate=0.06,
+            standby_crash_rate=0.1,
+        )
     if defrag:
         # the continuous-defragmentation fault axis: forced migration
         # storms (stage + evict waves mid-chaos), crashes right after a
@@ -273,14 +297,23 @@ def run_seed(seed: int, nodes: int, baseline: dict,
             **config,
             "durability": {
                 **DURABILITY_CONFIG,
-                "wal_dir": wal_tmp.name,
+                "wal_dir": str(Path(wal_tmp.name) / "wal"),
                 "partitions": max(partitions, 1),
             },
         }
+        if replication:
+            config = {
+                **config,
+                "replication": {
+                    **REPLICATION_CONFIG,
+                    "standby_wal_dir": str(Path(wal_tmp.name) / "standby"),
+                },
+            }
     try:
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
             explain_dir, durability, serving, hierarchical, defrag,
+            replication,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -292,7 +325,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
                     explain_dir, durability, serving=False,
-                    hierarchical=False, defrag=False) -> dict:
+                    hierarchical=False, defrag=False,
+                    replication=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -343,6 +377,19 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
         result["recovery_outcomes"] = [
             s["outcome"] for s in ch.recovery_stats
         ]
+    if replication:
+        result["standby_promotions"] = ch.standby_promotions
+        standby = ch.harness.cluster.standby
+        # the settled standby must have converged to the leader's
+        # committed head — a lagging settle is a replication failure
+        # even when the workload fingerprint matches
+        lag = standby.lag_records() if standby is not None else None
+        result["standby_lag_at_settle"] = lag
+        if error is None and (standby is None or lag != 0):
+            result["ok"] = False
+            result["error"] = (
+                f"standby not converged at settle (lag={lag})"
+            )
     if not ok and trace_path is not None:
         # every failure class leaves the postmortem, not just the wedged
         # settle that settle_recovered auto-dumps (a diverged fingerprint
@@ -423,6 +470,22 @@ def main(argv=None) -> int:
                          "tail torn (divergent streams merged back at "
                          "recovery) and per-partition disk stalls; "
                          "1 = the classic single WAL")
+    ap.add_argument("--replication", action="store_true",
+                    help="with --durability: arm the HA-replication "
+                         "fault axis — the store runs with a SEMI-SYNC "
+                         "log-shipping standby (cluster/replication.py) "
+                         "and the plan adds seeded tailer stalls (lag "
+                         "grows, semi-sync degrades for the window, "
+                         "catch-up at stall end), mid-plan standby "
+                         "promotions (the control plane fails over to "
+                         "the promoted store and a fresh standby "
+                         "re-arms), dual-leader fence proofs (the "
+                         "deposed leader's append must be refused and "
+                         "its WAL directory byte-unchanged, else the "
+                         "seed fails), and standby crashes re-seeding "
+                         "from the leader's snapshots; convergence is "
+                         "checked against the same fault-free fixpoint "
+                         "and the standby must end the run caught up")
     ap.add_argument("--serving", action="store_true",
                     help="arm the elastic-serving fault axis: serving is "
                          "configured with a FLAT traffic trace feeding "
@@ -473,6 +536,9 @@ def main(argv=None) -> int:
     if args.partitions > 1 and not args.durability:
         ap.error("--partitions requires --durability (there is no WAL "
                  "to partition without it)")
+    if args.replication and not args.durability:
+        ap.error("--replication requires --durability (the standby "
+                 "tails the leader's WAL stream)")
     trace_dir = None
     if args.trace_dir:
         trace_dir = Path(args.trace_dir)
@@ -518,6 +584,7 @@ def main(argv=None) -> int:
                           shards=args.shards,
                           durability=args.durability,
                           partitions=args.partitions,
+                          replication=args.replication,
                           serving=args.serving,
                           hierarchical=args.hierarchical,
                           defrag=args.defrag)
@@ -532,6 +599,7 @@ def main(argv=None) -> int:
         "shards": args.shards,
         "durability": args.durability,
         "partitions": args.partitions,
+        "replication": args.replication,
         "serving": args.serving,
         "hierarchical": args.hierarchical,
         "defrag": args.defrag,
