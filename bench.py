@@ -186,19 +186,23 @@ def wall_stats(walls: list[float], prefix: str = "",
     }
 
 
-def interleaved_ab(measure_a, measure_b, repeats: int) -> tuple[list, list]:
+def interleaved_ab(measure_a, measure_b, repeats: int, *more) -> tuple:
     """The interleaved A/B loop every comparative regime shares: each
     repeat times side A then side B BACK-TO-BACK, so a host-load burst
     lands on both sides of the pair — the reported speedup (a ratio of
     p50s over interleaved samples) is far more stable than two
     separately measured medians. The callables take the repeat index;
     whatever they return is collected per side (None returns are the
-    caller's skip convention)."""
-    a_samples, b_samples = [], []
+    caller's skip convention). Extra sides (`*more`) join the same
+    per-repeat interleave — an A/B/C regime (e.g. the scale tier's
+    wave/serial/flat triple) keeps every side under the same load
+    bursts."""
+    sides = (measure_a, measure_b, *more)
+    samples = tuple([] for _ in sides)
     for i in range(repeats):
-        a_samples.append(measure_a(i))
-        b_samples.append(measure_b(i))
-    return a_samples, b_samples
+        for fn, out in zip(sides, samples):
+            out.append(fn(i))
+    return samples
 
 
 def main() -> int:
@@ -240,7 +244,12 @@ def main() -> int:
                     "mid-stream) with the delta, fused and "
                     "fused+incremental engines AGAINST the full-re-encode "
                     "reference and exit nonzero on any placement "
-                    "divergence — every path must be bit-identical")
+                    "divergence — every path must be bit-identical. Also "
+                    "gates the hierarchical tier (score-equal vs flat) "
+                    "and the WAVE-PARALLEL fine-solve driver (bitwise "
+                    "equal to the serial workers=0 path across memo "
+                    "replays, dirty ticks, churn and a fail/recover "
+                    "rebind)")
     ap.add_argument("--churn-rate", type=float, default=300.0,
                     help="sustained-churn bench: offered gang arrival "
                     "rate (gangs/sec) against the warm control plane; "
@@ -324,6 +333,15 @@ def main() -> int:
                     "ceiling this regime exists to break. Combine with "
                     "--sharded for the mesh path; exits nonzero if the "
                     "incremental tier never ran shard-locally")
+    ap.add_argument("--wave-workers", type=int, default=None,
+                    help="--scale-tier: hier_parallel_workers of the "
+                    "measured hierarchical engine (wave-parallel fine "
+                    "solves: dispatch-all then collect-in-order across "
+                    "domains). Default None = the engine's auto "
+                    "resolution (host cores, widened to the mesh's "
+                    "local device fan-out under --sharded); 0 pins the "
+                    "serial one-domain-at-a-time fine phase. The A/B "
+                    "side at workers=0 is always measured alongside")
     ap.add_argument("--tier-repeats", type=int, default=5,
                     help="--scale-tier: dirty-tick repeats per side "
                     "(min/median/max reported; this host's throttling "
@@ -888,7 +906,13 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
     (cordon-shaped schedulable flip) mid-stream that must force the
     full-solve fallback. The gate also fails if the incremental engine
     never actually exercised its dirty-row / reuse tiers — a vacuous
-    pass must not read as coverage."""
+    pass must not read as coverage.
+
+    Two more tiers ride the same gate: the HIERARCHICAL two-level solve
+    (score-equal vs flat — see section 7) and the WAVE-PARALLEL fine
+    phase (section 8), which must stay BITWISE equal to the serial
+    workers=0 wave driver, with its own never-ran-a-multi-domain-wave
+    vacuity guard."""
     import dataclasses
 
     eng_f = mk_engine(state_cache=False, fused=False, incremental=False)
@@ -1284,6 +1308,138 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         failures.append("coverage: the hierarchical tier's shard-local "
                         "incremental re-solve never ran")
 
+    # 8) WAVE-PARALLEL fine solves vs the serial wave driver: the
+    #    dispatch-all/collect-in-order restructure changes WHEN each
+    #    domain's encode/launch/repair runs, never what is computed —
+    #    domains partition node rows and collection commits in
+    #    deterministic domain order — so unlike the hier-vs-flat tier's
+    #    score-equality pin, this one is BITWISE (placements, unplaced
+    #    reasons, post-solve free), across fresh solves, the
+    #    domain-reuse memo, dirty ticks, seeded churn, and a
+    #    fail/recover-shaped rebind mid-stream.
+    eng_ws = mk_engine(hierarchical=True, hier_parallel_workers=0,
+                       state_cache=True, fused=True, incremental=True)
+    eng_wp = mk_engine(hierarchical=True, hier_parallel_workers=4,
+                       state_cache=True, fused=True, incremental=True)
+    wave_width_max = 0
+    wave_solves = 0
+    # a backlog that genuinely SPREADS across coarse domains (the
+    # best-fit coarse commit otherwise piles one demand class onto the
+    # single tightest block and every wave is width-1): two demand
+    # classes + half the blocks drained below the big class's per-pod
+    # fit, so the fit cut confines big gangs to the loose blocks while
+    # small gangs best-fit the tight ones — multi-domain waves by
+    # construction, which the width coverage guard below pins
+    wave_gangs = make_gangs(len(gangs))
+    for i, g in enumerate(wave_gangs):
+        g.name = f"wave{i:05d}"
+        if i % 2:
+            g.demand = g.demand * np.float32(3.0)
+    block_ids = snapshot.domain_ids[0]
+    wave_free = snapshot.free.copy()
+    drained_rows = block_ids >= (int(block_ids.max()) + 1) // 2
+    # the drain must tighten EVERY resource (the best-fit slack is the
+    # max over resources — a cpu-only drain leaves memory slack
+    # dominant and the tie-broken pick collapses back to one block)
+    wave_free[drained_rows] = np.minimum(
+        wave_free[drained_rows],
+        np.array([8.0, 24.0, 2.0], np.float32),
+    )
+
+    def solve_wave(label, gang_list, free, declare=None,
+                   expect_memo=False):
+        """Solve on the workers=0 reference and the wave-parallel
+        candidate from the same free content; the serial side's
+        post-solve free is the carried canonical state (the gate proves
+        the parallel side's is bit-identical anyway). `expect_memo`
+        asserts both sides actually replayed the domain-reuse memo —
+        a scenario named for the memo must not silently re-solve."""
+        nonlocal solves, wave_solves, wave_width_max
+        solves += 1
+        wave_solves += 1
+        free_s, free_p = free.copy(), free.copy()
+        if declare is not None:
+            eng_ws.note_free_rows(declare)
+            eng_wp.note_free_rows(declare)
+        res_s = eng_ws.solve(gang_list, free=free_s)
+        res_p = eng_wp.solve(gang_list, free=free_p)
+        if not res_s.stats.get("hierarchical"):
+            failures.append(f"wave[{label}]: reference ran flat — the "
+                            "scenario proves nothing")
+        if expect_memo and (
+            res_s.stats.get("hier_domain_reuse", 0) < 1
+            or res_p.stats.get("hier_domain_reuse", 0) < 1
+        ):
+            failures.append(
+                f"wave[{label}]: the domain-reuse memo never replayed "
+                "— the memo scenario is vacuous"
+            )
+        wave_width_max = max(
+            wave_width_max, int(res_p.stats.get("hier_wave_width", 0))
+        )
+        diff(f"wave[{label}]", "parallel", res_p, res_s, free_p, free_s)
+        return free_s
+
+    wave_input = wave_free.copy()
+    solve_wave("fresh", wave_gangs, wave_free)
+    # identical repeat of the SAME input content: both sides must
+    # replay the domain-reuse memo (memo keys on the PRE-solve rows,
+    # so the repeat re-solves the fresh input, not the carried post —
+    # the expect_memo assert keeps this scenario honest)
+    solve_wave("memo", wave_gangs, wave_input, expect_memo=True)
+    # dirty tick against the same input: the dirty gangs' domains
+    # re-solve, clean domains keep the memo
+    wdirty = list(wave_gangs)
+    for j in (1, 5, 9):
+        g = make_gangs(1)[0]
+        g.name = f"wave-dirty-{j}"
+        wdirty[j % len(wdirty)] = g
+    wfree = solve_wave("dirty-tick", wdirty, wave_input)
+    # seeded bind/unbind churn with carried committed state, declared
+    # per the note_free_rows superset contract
+    for rnd in range(2):
+        rows = rng.choice(n, size=min(24, n), replace=False)
+        scale = rng.uniform(0.4, 1.1, size=(rows.size, 1)).astype(
+            np.float32
+        )
+        wfree[rows] = np.minimum(
+            snapshot.capacity[rows], wfree[rows] * scale
+        ).astype(np.float32)
+        subset = [
+            wave_gangs[i]
+            for i in sorted(rng.choice(
+                len(wave_gangs),
+                size=min(max(8, len(wave_gangs) // 8), len(wave_gangs)),
+                replace=False,
+            ))
+        ]
+        wfree = solve_wave(f"churn[{rnd}]", subset, wfree,
+                           declare=rows.tolist())
+    # fail/recover-shaped rebind mid-stream: a node drops out of the
+    # schedulable set and comes back — both sides must ride the shard
+    # rebind path and stay bitwise-aligned through both flips
+    fail_row = int(rng.integers(n))
+    for flip_to in (False, True):
+        sched_w = eng_ws.snapshot.schedulable.copy()
+        sched_w[fail_row] = flip_to
+        snap_w = dataclasses.replace(eng_ws.snapshot,
+                                     schedulable=sched_w)
+        if not (eng_ws.rebind(snap_w) and eng_wp.rebind(snap_w)):
+            failures.append("wave[rebind]: rebind rejected a pure "
+                            "schedulable flip")
+        wfree = solve_wave(
+            "fail-node" if not flip_to else "recover-node", wave_gangs,
+            wfree,
+        )
+    if wave_width_max < 2:
+        failures.append(
+            "coverage: the wave-parallel driver never ran a "
+            "multi-domain wave — the wave gate is vacuous"
+        )
+    if eng_ws.debug_summary()["hierarchical"]["wave_workers"] != 0:
+        failures.append("wave: the workers=0 reference resolved a "
+                        "nonzero wave width")
+
     # the gate is only meaningful if the incremental tiers actually ran
     inc_ds = candidates["inc"].debug_summary()["device_state"]
     if check_paths and inc_ds["dispatches"]["incremental"] == 0:
@@ -1309,6 +1465,8 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         "reuse_hits": inc_ds["reuse_hits"],
         "hier_solves_compared": hier_solves,
         "hier_pruned_pairs": hier_pruned,
+        "wave_solves_compared": wave_solves,
+        "wave_width_max": wave_width_max,
         "hier_incremental_dispatches": (
             hier_ds["device_state"]["dispatches"]["incremental"]
         ),
@@ -1339,9 +1497,14 @@ def bench_scale_tier(args) -> int:
     replaced) so the SHARD-LOCAL incremental tier genuinely runs —
     clean domains ride the domain-reuse memo / sub-engine reuse, dirty
     domains re-score O(dirty) rows — and the dispatch-kind counters
-    prove it. Interleaved A/B against the flat engine where its tensor
-    still fits; min/median/max over repeats because this class of host
-    throttles hard run-to-run."""
+    prove it. Interleaved A/B/C: the wave-parallel engine (dispatch-all
+    then collect-in-order fine solves, --wave-workers) vs the SERIAL
+    fine phase (workers=0) vs the flat engine where its tensor still
+    fits, with a phase wall breakdown (coarse / fine-solve /
+    exactness-net + per-domain fine-wall spread) in the JSON;
+    min/median/max over repeats because this class of host throttles
+    hard run-to-run. On a >= 2-device mesh the wave side's fine-phase
+    median must beat the serial side's (exit nonzero otherwise)."""
     from grove_tpu.observability import MetricsRegistry
     from grove_tpu.solver.engine import _bucket
 
@@ -1372,11 +1535,21 @@ def bench_scale_tier(args) -> int:
         def mk(**kw):
             return PlacementEngine(snapshot, **kw)
 
-    hier = mk(hierarchical=True, metrics=registry)
+    hier = mk(hierarchical=True, metrics=registry,
+              hier_parallel_workers=args.wave_workers)
     # solver microbench: decision-ring recording off (the documented
     # opt-out) — at 20k gangs/solve the ring's LRU churn is a visible
     # constant the deployed path amortizes across its cluster-owned log
     hier.decisions = None
+    # the wave-parallel A/B side: the SAME hierarchical engine pinned to
+    # the serial one-domain-at-a-time fine phase (workers=0), solving
+    # the identical backlog sequence interleaved — the fine-phase
+    # speedup is the dispatch-all/collect-in-order overlap, nothing
+    # else. Its own registry, so both sides pay the identical per-gang
+    # metrics recording (an asymmetry here skews the bind-wall fields)
+    hier_serial = mk(hierarchical=True, hier_parallel_workers=0,
+                     metrics=MetricsRegistry())
+    hier_serial.decisions = None
     DIRTY = 8
 
     def dirty_tick(backlog, tick):
@@ -1385,10 +1558,16 @@ def bench_scale_tier(args) -> int:
         shape — a rebuilt replica keeps its identity — so a tick
         dirties its gangs' own domains instead of shifting every
         gang's position in the sorted order (which would re-chunk the
-        whole coarse assignment and invalidate every domain)."""
+        whole coarse assignment and invalidate every domain). The
+        positions are SPREAD one per backlog stride: fleet churn lands
+        across blocks, not clustered in one, so a tick dirties ~DIRTY
+        distinct domains — which is also what gives the wave-parallel
+        A/B real concurrent fine solves to overlap (a clustered tick
+        dirties 1-2 domains and measures nothing)."""
         out = list(backlog)
+        stride = max(1, len(out) // DIRTY)
         for j in range(DIRTY):
-            pos = (tick * DIRTY + j) % len(out)
+            pos = (j * stride + tick) % len(out)
             g = make_tier_gangs(1)[0]
             g.name = out[pos].name.split(".")[0] + f".{tick}"
             out[pos] = g
@@ -1413,15 +1592,74 @@ def bench_scale_tier(args) -> int:
     # compile; the first-ever dirty tick would otherwise carry it)
     backlog = list(gangs)
     hier.solve(backlog, free=snapshot.free.copy())
+    hier_serial.solve(backlog, free=snapshot.free.copy())
     backlog = dirty_tick(backlog, -1)
     hier.solve(backlog, free=snapshot.free.copy())
-    if flat is not None:
-        flat.solve(backlog, free=snapshot.free.copy())
+    hier_serial.solve(backlog, free=snapshot.free.copy())
 
     state = {"backlog": backlog, "placed": 0}
+    #: per-side phase walls (hier_coarse / fine-solve / exactness-net
+    #: seconds per repeat) + per-domain fine-wall spread + wave width —
+    #: the breakdown that names WHICH phase regressed, not just the p50
+    phase_keys = ("hier_coarse_seconds", "hier_fine_seconds",
+                  "hier_net_seconds")
+    track = {
+        side: {"phases": {k: [] for k in phase_keys},
+               "dom_min": [], "dom_med": [], "dom_max": [],
+               "width": 0}
+        for side in ("wave", "serial")
+    }
 
-    def run_hier(rep):
+    def record(side, res):
+        t = track[side]
+        for k in phase_keys:
+            t["phases"][k].append(res.stats.get(k, 0.0))
+        t["dom_min"].append(res.stats.get("hier_fine_wall_min", 0.0))
+        t["dom_med"].append(res.stats.get("hier_fine_wall_med", 0.0))
+        t["dom_max"].append(res.stats.get("hier_fine_wall_max", 0.0))
+        t["width"] = max(t["width"],
+                         int(res.stats.get("hier_wave_width", 0)))
+
+    def run_side(side, eng):
+        t0 = time.perf_counter()
+        res = eng.solve(state["backlog"], free=snapshot.free.copy())
+        wall = time.perf_counter() - t0
+        state["placed"] = res.num_placed
+        record(side, res)
+        return wall
+
+    def run_pair(rep):
+        """One dirty tick, then the wave and serial sides back-to-back
+        in ALTERNATING order, so any load burst mid-pair lands on both
+        sides across the repeat set rather than always on the same
+        one."""
         state["backlog"] = dirty_tick(state["backlog"], rep)
+        if rep % 2:
+            s_wall = run_side("serial", hier_serial)
+            w_wall = run_side("wave", hier)
+        else:
+            w_wall = run_side("wave", hier)
+            s_wall = run_side("serial", hier_serial)
+        return w_wall, s_wall
+
+    repeats = max(args.tier_repeats, 3)
+    # phase A: the wave-vs-serial pair, tight back-to-back and NOTHING
+    # in between — the flat engine's much larger solve leaves a
+    # cache/thermal wake that would land on whichever side follows it
+    # (measured ~4x on this host class), drowning the ~2x effect under
+    # measurement; even repeat count so the alternating order splits
+    # any residual order bias evenly
+    pair_walls = [run_pair(rep) for rep in range(repeats + repeats % 2)]
+    h_walls = [w for w, _s in pair_walls]
+    s_walls = [s for _w, s in pair_walls]
+
+    # phase B: the historical hierarchical-vs-flat A/B (where the flat
+    # tensor is still materializable), classic interleave. The wave
+    # engine keeps ticking the same backlog stream; its phase-B walls
+    # feed only the flat comparison (a 50-100x ratio that tolerates
+    # the wake), never the wave-vs-serial medians above.
+    def run_hier_flat(rep):
+        state["backlog"] = dirty_tick(state["backlog"], 1000 + rep)
         t0 = time.perf_counter()
         state["placed"] = hier.solve(
             state["backlog"], free=snapshot.free.copy()
@@ -1435,10 +1673,17 @@ def bench_scale_tier(args) -> int:
         flat.solve(state["backlog"], free=snapshot.free.copy())
         return time.perf_counter() - t0
 
-    h_walls, f_walls = interleaved_ab(
-        run_hier, run_flat, max(args.tier_repeats, 3)
-    )
-    f_walls = [w for w in f_walls if w is not None]
+    if flat is not None:
+        # the flat warm-up (compile + device state; at this tier a
+        # much larger solve than anything hierarchical) runs HERE, not
+        # before phase A — its cache/thermal wake must never land on a
+        # timed wave-vs-serial sample
+        flat.solve(state["backlog"], free=snapshot.free.copy())
+        hf_walls, f_walls = interleaved_ab(run_hier_flat, run_flat,
+                                           repeats)
+        f_walls = [w for w in f_walls if w is not None]
+    else:
+        hf_walls, f_walls = [], []
     placed = state["placed"]
     ds = hier.debug_summary()
     disp = ds["device_state"]["dispatches"]
@@ -1455,14 +1700,38 @@ def bench_scale_tier(args) -> int:
             "coverage: the coarse level neither pruned nor partitioned "
             "anything — the tier ran effectively flat"
         )
+    wave_workers = hier_block["wave_workers"]
+    wave_fine = track["wave"]["phases"]["hier_fine_seconds"]
+    serial_fine = track["serial"]["phases"]["hier_fine_seconds"]
+    fine_speedup = round(p50(serial_fine) / max(p50(wave_fine), 1e-9), 2)
+    if wave_workers >= 1 and track["wave"]["width"] < 2:
+        failures.append(
+            "coverage: the wave-parallel fine phase never dispatched a "
+            "multi-domain wave — the wave A/B is vacuous"
+        )
+    local_devices = len(mesh.local_devices) if mesh is not None else 1
+    if wave_workers >= 1 and local_devices >= 2 and fine_speedup <= 1.0:
+        # the mesh gate (ROADMAP item 1 follow-up): with the domains
+        # round-robined across >= 2 devices, dispatch-all/collect-in-
+        # order must beat one-domain-at-a-time on the fine phase median
+        # (single-device runs report the ratio without gating — there
+        # the overlap is host-vs-device only and throttling noise on
+        # this host class swings walls ~2x)
+        failures.append(
+            f"wave-parallel fine-phase speedup {fine_speedup} <= 1 on a "
+            f"{local_devices}-device mesh — the wave overlap bought "
+            "nothing"
+        )
     tier_p50 = p50(h_walls)
     out = {
         "metric": f"hierarchical scale tier ({num_gangs} x 8-pod gangs, "
         f"{num_nodes} nodes, 4-level topology)",
         "value": round(num_gangs / tier_p50, 1),
         "unit": "gangs/sec",
+        # flat comparison against the phase-B hier walls measured in
+        # the SAME interleave as the flat side (never phase A's)
         "vs_baseline": round(
-            (p50(f_walls) / tier_p50), 2
+            (p50(f_walls) / p50(hf_walls)), 2
         ) if f_walls else 0.0,
         "tier": args.scale_tier,
         "placed": placed,
@@ -1470,6 +1739,40 @@ def bench_scale_tier(args) -> int:
         "tier_sub_second_p50": tier_p50 < 1.0,
         "tier_repeats": len(h_walls),
         "tier_dirty_gangs_per_tick": DIRTY,
+        # phase wall breakdown (wave side): a future regression names
+        # the PHASE — coarse assignment, fine solves, or the serial
+        # exactness net — plus the per-domain fine-wall spread naming
+        # whether one slow domain or the whole wave moved
+        "phase_breakdown": {
+            **wall_stats(track["wave"]["phases"]["hier_coarse_seconds"],
+                         "coarse_"),
+            **wall_stats(wave_fine, "fine_solve_"),
+            **wall_stats(track["wave"]["phases"]["hier_net_seconds"],
+                         "exactness_net_"),
+            "domain_fine_wall_min_seconds": round(
+                min(track["wave"]["dom_min"]), 4
+            ),
+            "domain_fine_wall_median_seconds": round(
+                p50(track["wave"]["dom_med"]), 4
+            ),
+            "domain_fine_wall_max_seconds": round(
+                max(track["wave"]["dom_max"]), 4
+            ),
+        },
+        # wave-parallel vs serial fine phase, interleaved (the same
+        # dirty-ticked backlogs back-to-back; ranges reported because
+        # this host class throttles ~2x run-to-run)
+        "wave_parallel_ab": {
+            "wave_workers": wave_workers,
+            "wave_width_max": track["wave"]["width"],
+            **wall_stats(wave_fine, "wave_fine_"),
+            **wall_stats(serial_fine, "serial_fine_"),
+            **wall_stats(s_walls, "serial_",
+                         suffix="backlog_bind_seconds"),
+            "fine_phase_speedup_p50": fine_speedup,
+            "bind_speedup_p50": round(p50(s_walls) / tier_p50, 2),
+            "interleaved": True,
+        },
         "dispatches_by_kind": dict(disp),
         "incremental_rows": ds["device_state"]["incremental_rows"],
         "reuse_hits": ds["device_state"]["reuse_hits"],

@@ -177,6 +177,19 @@ class SolverConfig:
     # — the solve runs flat. 0 forces the hierarchy on any cluster
     # (tests, chaos smokes).
     hierarchical_min_nodes: int = 4096
+    # Wave parallelism of the hierarchical fine phase (solver/engine.py
+    # _run_wave): within one attempt wave, every surviving domain's
+    # dispatch half (host encode + staged-delta sync + device launch)
+    # runs through a bounded thread pool and ALL launches are enqueued
+    # before any result is awaited — domain A's host repair overlaps
+    # domain B's device compute, and the mesh engine's round-robined
+    # devices run concurrently. Collection and free-row commits stay in
+    # deterministic domain order, so placements are BIT-equal to the
+    # serial path (gated by bench.py --equivalence's wave scenario).
+    # None = auto (host core count, widened to the mesh's local device
+    # fan-out on sharded engines); 0 = the serial one-domain-at-a-time
+    # fine phase.
+    hier_parallel_workers: int | None = None
 
 
 #: built-in priority-tier ladder seeded as PriorityClass objects when
@@ -718,6 +731,13 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     if not _int(sv.hierarchical_min_nodes) or sv.hierarchical_min_nodes < 0:
         errs.append(
             "config.solver.hierarchical_min_nodes: must be an int >= 0"
+        )
+    if sv.hier_parallel_workers is not None and (
+        not _int(sv.hier_parallel_workers) or sv.hier_parallel_workers < 0
+    ):
+        errs.append(
+            "config.solver.hier_parallel_workers: must be None (auto) or "
+            "an int >= 0 (0 = serial fine solves)"
         )
 
     errs += _validate_tenancy(cfg.tenancy)

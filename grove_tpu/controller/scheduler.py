@@ -122,6 +122,14 @@ class GangScheduler:
             self._engine_kwargs["hier_min_nodes"] = (
                 cfg.solver.hierarchical_min_nodes
             )
+        if accepts_kwarg(engine_cls, "hier_parallel_workers"):
+            # wave-parallel fine solves (engine.py _run_wave): the
+            # dispatch-all/collect-in-order width of the hierarchical
+            # fine phase, None = engine auto, 0 = serial — bit-equal
+            # placements either way, so this is purely a wall knob
+            self._engine_kwargs["hier_parallel_workers"] = (
+                cfg.solver.hier_parallel_workers
+            )
         if accepts_kwarg(engine_cls, "decision_log"):
             # the CLUSTER-owned decision ring (observability/explain.py):
             # injected so placement explanations survive engine rebuilds
@@ -861,6 +869,15 @@ class GangScheduler:
                 hier_level=int(result.stats.get("hier_level", -1)),
                 hier_pruned_pairs=int(
                     result.stats.get("hier_pruned_pairs", 0)
+                ),
+                # wave-parallel fine-phase shape: widest wave this
+                # solve dispatched and the worker width it ran at
+                # (0 = serial fine solves)
+                hier_wave_width=int(
+                    result.stats.get("hier_wave_width", 0)
+                ),
+                hier_wave_workers=int(
+                    result.stats.get("hier_wave_workers", 0)
                 ),
             )
         self.log.debug(

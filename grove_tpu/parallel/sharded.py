@@ -214,9 +214,29 @@ class ShardedPlacementEngine(PlacementEngine):
         crosses devices. Local (addressable) devices only: in a
         multi-process mesh every process runs the identical host-side
         coarse pass and fine solves on its own devices, preserving the
-        replicated-results multihost contract with zero coordination."""
+        replicated-results multihost contract with zero coordination.
+
+        This pinning is what the WAVE-PARALLEL fine phase (engine.py
+        _run_wave) converts into genuine multi-device concurrency:
+        with dispatch-all/collect-in-order, every domain's launch is
+        enqueued on its own device before any result is awaited, so
+        the round-robined devices compute simultaneously and the
+        in-order collection waits max-over-domains, not sum (each
+        sub-engine's packed result already started its D2H via
+        copy_to_host_async at dispatch time)."""
         local = self.mesh.local_devices
         return local[dom % len(local)]
+
+    def _auto_hier_workers(self) -> int:
+        """Mesh engines widen the auto worker count to cover their
+        local devices: the wave's whole point here is keeping every
+        round-robined device in flight, so the dispatch pool must be
+        at least as wide as the device fan-out (bounded — past ~16 the
+        host-side encode threads only contend)."""
+        return max(
+            super()._auto_hier_workers(),
+            min(16, len(self.mesh.local_devices)),
+        )
 
     def _pad_nodes(self, arr: np.ndarray, axis: int, mult: int) -> np.ndarray:
         n = arr.shape[axis]

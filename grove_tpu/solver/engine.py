@@ -67,8 +67,10 @@ solve; results are bit-equal on every path (bench.py --equivalence).
 from __future__ import annotations
 
 import math
+import os
 import time
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from functools import partial
 
 import jax
@@ -79,7 +81,12 @@ from ..observability.explain import DecisionLog, diagnose_unplaced
 from ..observability.tracing import NOOP_TRACER
 from ..topology.encoding import TopologySnapshot
 from .fit import place_gang_in_domain, placement_score_for_nodes
-from .hierarchy import HierarchyState, coarse_admissible, coarse_assign
+from .hierarchy import (
+    DomainWork,
+    HierarchyState,
+    coarse_admissible,
+    coarse_assign,
+)
 from .problem import SolverGang
 from .result import GangPlacement, SolveResult
 from .serial import _place_one, gang_sort_key, stamp_fairness
@@ -671,6 +678,7 @@ class PlacementEngine:
         hierarchical: bool = False,
         hier_prune_level: int | None = None,
         hier_min_nodes: int = 0,
+        hier_parallel_workers: int | None = None,
         device=None,
     ):
         self.snapshot = snapshot
@@ -779,6 +787,20 @@ class PlacementEngine:
         self.hierarchical = hierarchical
         self.hier_prune_level = hier_prune_level
         self.hier_min_nodes = hier_min_nodes
+        #: wave parallelism of the hierarchical fine phase (config
+        #: solver.hier_parallel_workers): within one attempt wave, every
+        #: surviving domain's dispatch half (host encode + staged-delta
+        #: sync + device launch) runs through a bounded thread pool and
+        #: ALL launches are enqueued before any result is awaited —
+        #: domain A's host repair overlaps domain B's device compute,
+        #: and the mesh engine's round-robined devices run concurrently.
+        #: Collection and free-row commits stay in deterministic domain
+        #: order, so placements are BIT-equal to the serial path.
+        #: None = auto (_auto_hier_workers); 0 = the serial
+        #: one-domain-at-a-time path.
+        self.hier_parallel_workers = hier_parallel_workers
+        self._hier_pool: ThreadPoolExecutor | None = None
+        self._hier_pool_size = 0
         #: what sub-engines inherit for their own incremental tier: the
         #: NORMALIZED request, captured before ShardedPlacementEngine
         #: forces its own (flat-path) incremental off — sub-engines are
@@ -1083,17 +1105,21 @@ class PlacementEngine:
             "host<->device bytes moved by the engine, by payload kind",
         ).inc(float(nbytes), kind=kind)
 
-    def _count_dispatch_kind(self, kind: str) -> None:
-        """Count one device program launch by solve-path kind. `split`
-        counts both the legacy score program and the standalone delta
-        scatter (the two launches the fused path collapses into one);
-        `fused`/`incremental` are always exactly one launch per solve."""
-        self._dispatches[kind] += 1
+    def _count_dispatch_kind(self, kind: str, n: int = 1) -> None:
+        """Count `n` device program launches by solve-path kind (the
+        hierarchy mirrors a sub-engine's counter DELTA in one call, not
+        a launch at a time). `split` counts both the legacy score
+        program and the standalone delta scatter (the two launches the
+        fused path collapses into one); `fused`/`incremental` are
+        always exactly one launch per solve."""
+        if n <= 0:
+            return
+        self._dispatches[kind] += n
         if self.metrics is not None:
             self.metrics.counter(
                 "grove_solver_dispatches_total",
                 "device program launches by solve path kind",
-            ).inc(kind=kind)
+            ).inc(float(n), kind=kind)
 
     def _count_inc_rows(self, rows: int) -> None:
         self._inc_rows_total += rows
@@ -1401,23 +1427,24 @@ class PlacementEngine:
         eng.decisions = None
         return eng
 
-    def _solve_domain(self, hs, dom: int, members, free: np.ndarray,
-                      sub_stats: dict):
-        """Exact fine solve of one coarse domain's assigned gangs.
-        Returns ({name: global GangPlacement}, [failed (i, gang)]).
-        Tier 0 is the DOMAIN-REUSE memo: an identical gang set (by
-        object identity + fairness stamp) against bitwise-identical
-        free rows replays the previous placements and post-solve rows
-        in O(rows) — the hierarchy analog of the sub-engine's own
-        zero-dispatch reuse, one level up."""
+    def _domain_prepare(self, hs, dom: int, members,
+                        free: np.ndarray) -> DomainWork:
+        """Main-thread half of one domain's fine solve: shard
+        resolution, the free-row slice, the domain-reuse memo probe
+        (tier 0: an identical gang set — by object identity + fairness
+        stamp — against bitwise-identical free rows replays the
+        previous placements in O(rows)), and the pending-row custody
+        handoff. Runs serially in deterministic domain order, so shard
+        construction and memo probes never race; the returned work item
+        is what _domain_dispatch/_domain_collect operate on."""
         shard = hs.shard(dom)
-        idx = shard.idx
-        sub_free = np.ascontiguousarray(free[idx])
+        sub_free = np.ascontiguousarray(free[shard.idx])
         gangs = [g for _i, g in members]
         sig = (
             tuple(id(g) for g in gangs),
             tuple(g.fairness for g in gangs),
         )
+        work = DomainWork(dom, members, shard, gangs, sig, sub_free)
         if (
             # the memo is an incrementality tier: configured off
             # (solver.incremental_resolve), every repeat pays the full
@@ -1429,9 +1456,8 @@ class PlacementEngine:
             and shard.last_pre.shape == sub_free.shape
             and np.array_equal(shard.last_pre, sub_free)
         ):
-            free[idx] = shard.last_post
-            sub_stats["hier_domain_reuse"] += 1
-            return {p.gang.name: p for p in shard.last_placed}, []
+            work.memo = True
+            return work
         if shard.engine is None:
             shard.engine = self._make_sub_engine(shard)
         pend, shard.pending_rows = shard.pending_rows, set()
@@ -1442,13 +1468,48 @@ class PlacementEngine:
         shard.engine.note_free_rows(
             None if pend is None else sorted(pend)
         )
-        pre = sub_free.copy()
-        proxies = [shard.proxy(g, hs.level) for g in gangs]
-        res = shard.engine.solve(proxies, free=sub_free)
-        free[idx] = sub_free
+        work.pre = sub_free.copy()
+        return work
+
+    def _domain_dispatch(self, work: DomainWork, level: int) -> None:
+        """Async half of one domain's fine solve: gang-proxy build +
+        host encode + staged-delta sync + device launch, through the
+        sub-engine's own dispatch() (the existing SolveDispatch
+        machinery). Thread-pool safe: it touches only SHARD-LOCAL state
+        — the domain's proxies, mask slices, sub-engine and its device
+        — plus jax dispatch (thread-safe); the parent `free` matrix is
+        never read here (prepare already sliced it), and domains
+        partition node rows, so concurrent dispatch halves operate on
+        disjoint data."""
+        t0 = time.perf_counter()
+        work.proxies = [work.shard.proxy(g, level) for g in work.gangs]
+        work.handle = work.shard.engine.dispatch(
+            work.proxies, free=work.sub_free
+        )
+        work.encode_seconds = time.perf_counter() - t0
+
+    def _domain_collect(self, work: DomainWork, free: np.ndarray,
+                        sub_stats: dict):
+        """Collect half of one domain's fine solve: adopt the in-flight
+        device phase (block on the packed top-k D2H), run the exact
+        host repair, and commit the domain's free rows — or replay the
+        memo. MUST run in deterministic domain order on the main
+        thread: the `free` commits and the parent counter mirroring are
+        the wave's only shared-state writes. Returns
+        ({name: global GangPlacement}, [failed (i, gang)])."""
+        shard = work.shard
+        idx = shard.idx
+        if work.memo:
+            free[idx] = shard.last_post
+            sub_stats["hier_domain_reuse"] += 1
+            return {p.gang.name: p for p in shard.last_placed}, []
+        res = shard.engine.solve(
+            work.proxies, free=work.sub_free, dispatch=work.handle
+        )
+        free[idx] = work.sub_free
         placed_here: dict[str, GangPlacement] = {}
         failed = []
-        for i, g in members:
+        for i, g in work.members:
             subp = res.placed.get(g.name)
             if subp is None:
                 failed.append((i, g))
@@ -1462,9 +1523,9 @@ class PlacementEngine:
                     self.snapshot, gidx
                 ),
             )
-        shard.last_sig = sig
-        shard.last_pre = pre
-        shard.last_post = sub_free.copy()
+        shard.last_sig = work.sig
+        shard.last_pre = work.pre
+        shard.last_post = work.sub_free.copy()
         # the memo only replays COMPLETE outcomes: a failed gang would
         # re-enter the alternate walk, which a replay cannot reproduce
         shard.last_placed = (
@@ -1476,8 +1537,9 @@ class PlacementEngine:
         sub_stats["hier_fine_solves"] += 1
         disp = shard.engine._dispatches
         for kind, total in disp.items():
-            for _ in range(total - shard.disp_seen.get(kind, 0)):
-                self._count_dispatch_kind(kind)
+            self._count_dispatch_kind(
+                kind, total - shard.disp_seen.get(kind, 0)
+            )
             shard.disp_seen[kind] = total
         rows_total = shard.engine._inc_rows_total
         if rows_total > shard.inc_rows_seen:
@@ -1497,6 +1559,169 @@ class PlacementEngine:
             "fallbacks", 0.0
         )
         return placed_here, failed
+
+    def _solve_domain(self, hs, dom: int, members, free: np.ndarray,
+                      sub_stats: dict):
+        """Serial fine solve of one coarse domain (the workers=0 path
+        and single-domain waves): prepare -> dispatch -> collect back
+        to back. Returns ({name: global GangPlacement},
+        [failed (i, gang)])."""
+        work = self._domain_prepare(hs, dom, members, free)
+        if not work.memo:
+            self._domain_dispatch(work, hs.level)
+        return self._domain_collect(work, free, sub_stats)
+
+    def _auto_hier_workers(self) -> int:
+        """hier_parallel_workers=None resolution: enough host threads
+        to keep the encode pipeline ahead of the collect loop, bounded
+        — the dispatch half is host-side numpy plus an async launch, so
+        past the core count extra workers only contend (the mesh engine
+        widens this to cover its local devices)."""
+        return min(8, os.cpu_count() or 1)
+
+    def _wave_workers(self) -> int:
+        """Resolved wave-parallelism width (0 = serial fine solves)."""
+        w = self.hier_parallel_workers
+        if w is None:
+            return self._auto_hier_workers()
+        return max(0, int(w))
+
+    def _hier_pool_get(self, workers: int) -> ThreadPoolExecutor:
+        """The engine's bounded dispatch pool, grown (never shrunk) to
+        the resolved worker count. Threads are lazy — an engine whose
+        waves never run parallel creates none — and orphaned pools
+        self-clean on GC (idle workers exit when the executor is
+        collected), so engine rebuilds on topology changes do not leak
+        threads."""
+        if self._hier_pool is None or self._hier_pool_size < workers:
+            if self._hier_pool is not None:
+                self._hier_pool.shutdown(wait=False)
+            self._hier_pool = ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="grove-hier-wave",
+            )
+            self._hier_pool_size = workers
+        return self._hier_pool
+
+    def _run_wave(self, hs, groups: dict, free: np.ndarray,
+                  sub_stats: dict, tried: dict, placed_map: dict,
+                  fine_walls: list) -> list:
+        """One attempt wave of fine solves: DISPATCH-ALL (each domain's
+        host encode + staged-delta sync + device launch, thread-pooled
+        behind hier_parallel_workers), then COLLECT-IN-ORDER (block on
+        each domain's packed D2H, exact host repair, free-row commit)
+        in deterministic sorted domain order. Domains partition node
+        rows, so the dispatch halves touch disjoint free slices and
+        shard-local state only — placements are BIT-equal to solving
+        the domains one at a time (the workers=0 path; pinned by the
+        --equivalence wave scenario). The overlap: domain A's host
+        repair runs while domain B's device compute and D2H are in
+        flight, and on the mesh engine the round-robined devices
+        finally run concurrently. Returns the wave's failed (i, gang)
+        pairs."""
+        doms = sorted(groups)
+        workers = min(self._wave_workers(), len(doms))
+        parallel = workers >= 1 and len(doms) > 1
+        wave_t0 = time.perf_counter()
+        failures: list = []
+        memo_hits = 0
+        devices: set = set()
+        with self.tracer.span(
+            "engine.hier_wave", domains=len(doms),
+            workers=workers if parallel else 0,
+        ) as wsp:
+            if parallel:
+                works = [
+                    self._domain_prepare(hs, dom, groups[dom], free)
+                    for dom in doms
+                ]
+                pool = self._hier_pool_get(workers)
+                for w in works:
+                    if w.memo:
+                        memo_hits += 1
+                        continue
+                    if w.shard.engine._device is not None:
+                        devices.add(w.shard.engine._device)
+                    w.fut = pool.submit(
+                        self._domain_dispatch, w, hs.level
+                    )
+                try:
+                    for w in works:
+                        if w.fut is not None:
+                            w.fut.result()  # re-raise dispatch errors
+                        t0 = time.perf_counter()
+                        placed_here, failed = self._domain_collect(
+                            w, free, sub_stats
+                        )
+                        fine_walls.append(
+                            time.perf_counter() - t0 + w.encode_seconds
+                        )
+                        for i, _g in w.members:
+                            tried[i].add(w.dom)
+                        placed_map.update(placed_here)
+                        failures.extend(failed)
+                except BaseException:
+                    # the wave must not unwind while sibling dispatch
+                    # halves are still running: a caller catching this
+                    # and retrying solve() would re-enter the same
+                    # shards' prepare (pending-row swaps, memo fields,
+                    # staged deltas) concurrently with the orphaned
+                    # threads. Cancel what never started, then wait
+                    # out what did — only then propagate.
+                    for w in works:
+                        if w.fut is not None:
+                            w.fut.cancel()
+                    for w in works:
+                        if w.fut is not None and not w.fut.cancelled():
+                            try:
+                                w.fut.exception()  # barrier; error
+                                # already surfacing via the raise below
+                            except BaseException:
+                                pass
+                    raise
+            else:
+                for dom in doms:
+                    t0 = time.perf_counter()
+                    placed_here, failed = self._solve_domain(
+                        hs, dom, groups[dom], free, sub_stats
+                    )
+                    fine_walls.append(time.perf_counter() - t0)
+                    for i, _g in groups[dom]:
+                        tried[i].add(dom)
+                    placed_map.update(placed_here)
+                    failures.extend(failed)
+            wave_wall = time.perf_counter() - wave_t0
+            wsp.set(
+                wall_seconds=round(wave_wall, 6),
+                memo_hits=memo_hits,
+                failures=len(failures),
+                **({"devices": len(devices)} if devices else {}),
+            )
+        sub_stats["hier_waves"] += 1
+        sub_stats["hier_wave_width"] = max(
+            sub_stats["hier_wave_width"], float(len(doms))
+        )
+        # max-merged like the width: a trailing single-domain retry
+        # wave must not erase that earlier waves ran parallel
+        sub_stats["hier_wave_workers"] = max(
+            sub_stats["hier_wave_workers"],
+            float(workers if parallel else 0),
+        )
+        if devices:
+            sub_stats["hier_wave_devices"] = max(
+                sub_stats["hier_wave_devices"], float(len(devices))
+            )
+        if self.metrics is not None:
+            self.metrics.histogram(
+                "grove_solver_hier_wave_seconds",
+                "wall seconds of one hierarchical fine-solve wave "
+                "(dispatch-all + collect-in-order across domains)",
+            ).observe(wave_wall)
+            self.metrics.gauge(
+                "grove_solver_hier_wave_width",
+                "domains in the last hierarchical fine-solve wave",
+            ).set(float(len(doms)))
+        return failures
 
     def _hier_run(self, order: list[SolverGang], free: np.ndarray,
                   result: SolveResult, level: int):
@@ -1531,7 +1756,11 @@ class PlacementEngine:
             "hier_fine_solves": 0, "hier_domain_reuse": 0,
             "hier_sub_incremental": 0, "hier_sub_reused": 0,
             "incremental_rows": 0.0, "hier_repair_fallbacks": 0.0,
+            "hier_waves": 0, "hier_wave_width": 0.0,
+            "hier_wave_workers": 0.0, "hier_wave_devices": 0.0,
         }
+        fine_walls: list[float] = []
+        t_fine = time.perf_counter()
         placed_map: dict[str, GangPlacement] = {}
         pending = list(enumerate(order))
         tried: dict[int, set] = {i: set() for i, _g in pending}
@@ -1580,17 +1809,13 @@ class PlacementEngine:
                 if not groups:
                     pending = leftover
                     break
-                failures = []
-                for dom in sorted(groups):
-                    placed_here, failed = self._solve_domain(
-                        hs, dom, groups[dom], free, sub_stats
-                    )
-                    for i, _g in groups[dom]:
-                        tried[i].add(dom)
-                    placed_map.update(placed_here)
-                    failures.extend(failed)
+                failures = self._run_wave(
+                    hs, groups, free, sub_stats, tried, placed_map,
+                    fine_walls,
+                )
                 pending = sorted(leftover + failures)
                 attempt += 1
+        result.stats["hier_fine_seconds"] = time.perf_counter() - t_fine
         # exactness net: gangs inadmissible everywhere or failed in all
         # surviving domains take the flat repair's serial scan, so
         # hard-feasibility semantics stay identical to the flat path
@@ -1601,6 +1826,7 @@ class PlacementEngine:
         # solve-start admissible set; a gang admissible NOWHERE scans
         # the full cluster, exactly like the flat fallback (the
         # diagnosis that follows must match flat's).
+        t_net = time.perf_counter()
         fallbacks = 0
         for i, gang in pending:
             fallbacks += 1
@@ -1618,6 +1844,18 @@ class PlacementEngine:
                                     self._sched_nodes)
             if placed is not None:
                 placed_map[gang.name] = placed
+        result.stats["hier_net_seconds"] = time.perf_counter() - t_net
+        if fine_walls:
+            # per-domain fine-wall spread (dispatch half + collect half
+            # per domain; memo replays count as near-zero walls): the
+            # bench's phase breakdown names WHICH domains are slow, not
+            # just the p50 (in wave mode the collect half overlaps other
+            # domains' device compute, so the sum legitimately exceeds
+            # the fine-phase wall — that gap IS the overlap won)
+            s = sorted(fine_walls)
+            result.stats["hier_fine_wall_min"] = s[0]
+            result.stats["hier_fine_wall_med"] = s[len(s) // 2]
+            result.stats["hier_fine_wall_max"] = s[-1]
         result.stats.update(sub_stats)
         result.stats["hierarchical"] = 1.0
         result.stats["hier_level"] = float(level)
@@ -2482,6 +2720,10 @@ class PlacementEngine:
             # are already mirrored into the dispatches block above.
             "hierarchical": {
                 "enabled": self.hierarchical,
+                # resolved wave-parallelism width of the fine phase
+                # (0 = serial one-domain-at-a-time; the configured
+                # knob may be None = auto)
+                "wave_workers": self._wave_workers(),
                 "prune_level": (
                     None if self._hier is None else self._hier.level
                 ),

@@ -214,6 +214,44 @@ class DomainShard:
         return p
 
 
+class DomainWork:
+    """One domain's in-flight fine solve within a wave — the handle the
+    engine's dispatch-all/collect-in-order driver threads between its
+    three phases. `prepare` (main thread, deterministic domain order)
+    fills the slice/memo/sig fields; `dispatch` (thread-pooled) fills
+    the proxies and the sub-engine's SolveDispatch handle; `collect`
+    (main thread, deterministic domain order again) consumes everything.
+    A memo hit (`memo=True`) skips the dispatch half entirely — the
+    replay needs no device work."""
+
+    __slots__ = ("dom", "members", "shard", "gangs", "sig", "sub_free",
+                 "pre", "memo", "proxies", "handle", "fut",
+                 "encode_seconds")
+
+    def __init__(self, dom: int, members, shard: DomainShard, gangs,
+                 sig, sub_free: np.ndarray):
+        self.dom = dom
+        self.members = members
+        self.shard = shard
+        self.gangs = gangs
+        self.sig = sig
+        self.sub_free = sub_free
+        #: pre-solve copy of the domain's free rows (the reuse memo key)
+        self.pre: np.ndarray | None = None
+        #: domain-reuse memo hit: collect replays shard.last_placed /
+        #: last_post without any dispatch
+        self.memo = False
+        #: sub-snapshot gang proxies, built in the dispatch half
+        self.proxies: list | None = None
+        #: the sub-engine's in-flight SolveDispatch (None when the
+        #: sub-backlog had nothing to score — collect solves plain)
+        self.handle = None
+        #: the dispatch half's Future when thread-pooled (None = inline)
+        self.fut = None
+        #: host wall of the dispatch half (encode + staged sync + launch)
+        self.encode_seconds = 0.0
+
+
 class HierarchyState:
     """Per-engine hierarchical solve state for ONE (snapshot, prune
     level): the global-node -> (coarse domain, local row) maps and the

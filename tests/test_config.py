@@ -290,6 +290,7 @@ class TestConfigChangesBehavior:
             "hierarchical": True,
             "hier_prune_level": None,
             "hier_min_nodes": 4096,
+            "hier_parallel_workers": None,
         }
         assert all(p.node_name for p in h.store.list(Pod.KIND))
 

@@ -416,6 +416,207 @@ class TestShardedHierarchy:
         )
 
 
+def spread_problem(num_small: int = 10, num_big: int = 10):
+    """A cluster + backlog whose coarse assignment genuinely SPREADS
+    across blocks — the wave-parallel driver's input shape. Two demand
+    classes + half the blocks drained below the big class's per-pod fit
+    (EVERY resource tightened: the best-fit slack is the max over
+    resources, so a cpu-only drain leaves memory slack dominant and the
+    tie-broken pick collapses back onto one block): small gangs
+    best-fit the tight drained blocks, big gangs are fit-cut there and
+    land in the loose ones — multi-domain waves by construction."""
+    snap = make_cluster(512)  # 8 blocks
+    ids = snap.domain_ids[0]
+    free = snap.free.copy()
+    free[ids < 4] = np.minimum(
+        free[ids < 4], np.array([8.0, 24.0, 2.0], np.float32)
+    )
+    gangs = [make_gang(f"s{i:02d}", pods=4, cpu=4.0)
+             for i in range(num_small)]
+    gangs += [make_gang(f"b{i:02d}", pods=4, cpu=16.0)
+              for i in range(num_big)]
+    return snap, free, gangs
+
+
+def assert_bitwise(rs, rw, free_s, free_w):
+    """The wave contract: bit-equal placements, identical unplaced
+    reasons, identical post-solve free — not merely score-equal."""
+    assert sorted(rs.placed) == sorted(rw.placed)
+    for name, ps in rs.placed.items():
+        pw = rw.placed[name]
+        assert pw.pod_to_node == ps.pod_to_node, name
+        assert np.array_equal(pw.node_indices, ps.node_indices), name
+        assert pw.placement_score == ps.placement_score, name
+    assert rs.unplaced == rw.unplaced
+    assert np.array_equal(free_s, free_w)
+
+
+class TestWaveParallel:
+    """Wave-parallel fine solves (engine.py _run_wave): dispatch-all /
+    collect-in-order across domains must be BIT-equal to the serial
+    workers=0 path — domains partition node rows and collection commits
+    in deterministic domain order, so only the overlap changes, never
+    the result."""
+
+    def _pair(self, snap, workers=4):
+        serial = PlacementEngine(snap, hierarchical=True,
+                                 hier_parallel_workers=0)
+        wave = PlacementEngine(snap, hierarchical=True,
+                               hier_parallel_workers=workers)
+        return serial, wave
+
+    def test_bit_equality_multi_domain_wave(self):
+        snap, free, gangs = spread_problem()
+        serial, wave = self._pair(snap)
+        fs, fw = free.copy(), free.copy()
+        rs = serial.solve(gangs, free=fs)
+        rw = wave.solve(gangs, free=fw)
+        # the wave driver must actually have run a parallel wave, else
+        # the equality below is vacuous
+        assert rw.stats["hier_wave_width"] >= 2
+        assert rw.stats["hier_wave_workers"] >= 1
+        assert rs.stats["hier_wave_workers"] == 0
+        assert_bitwise(rs, rw, fs, fw)
+
+    def test_bit_equality_under_churn(self):
+        snap, free, gangs = spread_problem()
+        serial, wave = self._pair(snap)
+        rng = np.random.default_rng(5)
+        n = snap.num_nodes
+        widths = 0
+        for rnd in range(4):
+            rows = rng.choice(n, size=24, replace=False)
+            scale = rng.uniform(0.4, 1.1, size=(rows.size, 1)).astype(
+                np.float32
+            )
+            free[rows] = np.minimum(
+                snap.capacity[rows], free[rows] * scale
+            ).astype(np.float32)
+            serial.note_free_rows(rows.tolist())
+            wave.note_free_rows(rows.tolist())
+            subset = [gangs[i] for i in sorted(
+                rng.choice(len(gangs), size=12, replace=False)
+            )]
+            fs, fw = free.copy(), free.copy()
+            rs = serial.solve(subset, free=fs)
+            rw = wave.solve(subset, free=fw)
+            widths = max(widths, int(rw.stats["hier_wave_width"]))
+            assert_bitwise(rs, rw, fs, fw)
+            free = fs
+        assert widths >= 2
+
+    def test_domain_reuse_and_dirty_tick_parity(self):
+        snap, free, gangs = spread_problem()
+        serial, wave = self._pair(snap)
+        serial.solve(gangs, free=free.copy())
+        wave.solve(gangs, free=free.copy())
+        # identical repeat: both sides replay the domain-reuse memo
+        fs, fw = free.copy(), free.copy()
+        rs = serial.solve(gangs, free=fs)
+        rw = wave.solve(gangs, free=fw)
+        assert rw.stats["hier_domain_reuse"] >= 1
+        assert (rw.stats["hier_domain_reuse"]
+                == rs.stats["hier_domain_reuse"])
+        assert rw.stats["hier_fine_solves"] == rs.stats["hier_fine_solves"]
+        assert_bitwise(rs, rw, fs, fw)
+        # dirty tick: one replaced gang re-solves its domain (the
+        # shard-local incremental tier), clean domains keep the memo
+        dirty = list(gangs)
+        dirty[3] = make_gang("fresh-0", pods=4, cpu=4.0)
+        fs, fw = free.copy(), free.copy()
+        rs = serial.solve(dirty, free=fs)
+        rw = wave.solve(dirty, free=fw)
+        assert rw.stats.get("incremental") == rs.stats.get("incremental")
+        assert rw.stats["hier_domain_reuse"] >= 1
+        assert_bitwise(rs, rw, fs, fw)
+
+    def test_fail_recover_rebind_mid_stream(self):
+        """A chaos-shaped node fail/recover between solves: the
+        schedulable flip rides rebind() into every shard, and the wave
+        path must stay bitwise-aligned with the serial path through
+        BOTH flips (stale shard state after a rebind would diverge)."""
+        import dataclasses as dc
+
+        snap, free, gangs = spread_problem()
+        serial, wave = self._pair(snap)
+        fs, fw = free.copy(), free.copy()
+        assert_bitwise(serial.solve(gangs, free=fs),
+                       wave.solve(gangs, free=fw), fs, fw)
+        failed = 7
+        for up in (False, True):  # fail_node, then recover_node
+            sched = serial.snapshot.schedulable.copy()
+            sched[failed] = up
+            snap2 = dc.replace(serial.snapshot, schedulable=sched)
+            assert serial.rebind(snap2) and wave.rebind(snap2)
+            fs, fw = free.copy(), free.copy()
+            rs = serial.solve(gangs, free=fs)
+            rw = wave.solve(gangs, free=fw)
+            assert_bitwise(rs, rw, fs, fw)
+            if not up:
+                for p in rw.placed.values():
+                    assert failed not in p.node_indices.tolist()
+
+    def test_workers_zero_is_serial(self):
+        snap, free, gangs = spread_problem()
+        eng = PlacementEngine(snap, hierarchical=True,
+                              hier_parallel_workers=0)
+        res = eng.solve(gangs, free=free.copy())
+        assert res.stats["hier_wave_workers"] == 0.0
+        assert res.stats["hier_waves"] >= 1
+        assert eng._hier_pool is None  # the serial path builds no pool
+        assert eng.debug_summary()["hierarchical"]["wave_workers"] == 0
+
+    def test_auto_workers_resolution(self):
+        snap = make_cluster(128)
+        eng = PlacementEngine(snap, hierarchical=True)
+        assert eng._wave_workers() >= 1
+        assert (eng.debug_summary()["hierarchical"]["wave_workers"]
+                == eng._wave_workers())
+
+    def test_wave_stats_and_metrics(self):
+        from grove_tpu.observability import MetricsRegistry
+
+        snap, free, gangs = spread_problem()
+        reg = MetricsRegistry()
+        eng = PlacementEngine(snap, hierarchical=True,
+                              hier_parallel_workers=2, metrics=reg)
+        res = eng.solve(gangs, free=free.copy())
+        assert res.stats["hier_waves"] >= 1
+        assert res.stats["hier_wave_width"] >= 2
+        assert res.stats["hier_fine_seconds"] > 0.0
+        assert "hier_net_seconds" in res.stats
+        walls = [res.stats["hier_fine_wall_min"],
+                 res.stats["hier_fine_wall_med"],
+                 res.stats["hier_fine_wall_max"]]
+        assert walls == sorted(walls)
+        h = reg.histogram("grove_solver_hier_wave_seconds")
+        assert h.count == res.stats["hier_waves"]
+        assert reg.gauge("grove_solver_hier_wave_width").value() >= 1
+
+    def test_sharded_wave_bitwise_matches_serial(self):
+        from grove_tpu.parallel import (
+            ShardedPlacementEngine,
+            make_solver_mesh,
+        )
+
+        mesh = make_solver_mesh()
+        snap, free, gangs = spread_problem()
+        serial = ShardedPlacementEngine(snap, mesh, hierarchical=True,
+                                        hier_parallel_workers=0)
+        wave = ShardedPlacementEngine(snap, mesh, hierarchical=True)
+        # mesh auto resolution covers the local device fan-out
+        assert wave._wave_workers() >= min(
+            16, len(mesh.local_devices)
+        )
+        fs, fw = free.copy(), free.copy()
+        rs = serial.solve(gangs, free=fs)
+        rw = wave.solve(gangs, free=fw)
+        assert rw.stats["hier_wave_width"] >= 2
+        if len(mesh.local_devices) > 1:
+            assert rw.stats["hier_wave_devices"] >= 2
+        assert_bitwise(rs, rw, fs, fw)
+
+
 class TestDispatchAdoption:
     def test_dispatch_carries_level_and_adopts(self):
         snap = make_cluster(128)
@@ -484,6 +685,12 @@ class TestConfigAndScheduler:
                         "hierarchical_prune_level": 1,
                         "hierarchical_min_nodes": 0}}
         )
+        # wave parallelism: None (auto), 0 (serial) and positive widths
+        # are all valid
+        for w in (None, 0, 4):
+            load_operator_config(
+                {"solver": {"hier_parallel_workers": w}}
+            )
         with pytest.raises(ValidationError):
             load_operator_config(
                 {"solver": {"hierarchical_solve": "yes"}}
@@ -495,6 +702,14 @@ class TestConfigAndScheduler:
         with pytest.raises(ValidationError):
             load_operator_config(
                 {"solver": {"hierarchical_min_nodes": -1}}
+            )
+        with pytest.raises(ValidationError):
+            load_operator_config(
+                {"solver": {"hier_parallel_workers": -1}}
+            )
+        with pytest.raises(ValidationError):
+            load_operator_config(
+                {"solver": {"hier_parallel_workers": "many"}}
             )
 
     def test_scheduler_threads_hierarchy_e2e(self):
@@ -515,7 +730,8 @@ class TestConfigAndScheduler:
 
         h = Harness(
             nodes=make_nodes(32),
-            config={"solver": {"hierarchical_min_nodes": 0}},
+            config={"solver": {"hierarchical_min_nodes": 0,
+                               "hier_parallel_workers": 2}},
         )
         pcs = PodCliqueSet(
             metadata=ObjectMeta(name="w"),
@@ -554,6 +770,8 @@ class TestConfigAndScheduler:
         hier = eng.get("hierarchical") or {}
         assert hier.get("enabled") is True
         assert hier.get("shards_built", 0) >= 1
+        # the config knob threaded through to the engine
+        assert hier.get("wave_workers") == 2
 
     def test_debug_summary_block(self):
         snap = make_cluster(128)
@@ -561,6 +779,7 @@ class TestConfigAndScheduler:
         block = eng.debug_summary()["hierarchical"]
         assert block == {
             "enabled": True,
+            "wave_workers": eng._wave_workers(),
             "prune_level": None,
             "coarse_domains": None,
             "shards_built": 0,
