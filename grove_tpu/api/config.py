@@ -513,6 +513,60 @@ class ReplicationConfig:
 
 
 @dataclass
+class FederationConfig:
+    """Multi-cluster federation (grove_tpu/federation): a global
+    coordinator routes each arriving gang to one member cluster using
+    the hierarchical pruner's over-admitting coarse cut predicates
+    (clusters as super-domains), delegates to that cluster's full
+    control plane, and survives whole-cluster loss by fencing the dead
+    cluster's durable log and draining its committed gang set into
+    survivors under per-tenant disruption budgets. Requires durability:
+    every member cluster journals under its own directory and the
+    coordinator keeps its routing/fencing state in its own durable
+    journal. Off by default.
+
+      enabled                           arm the federation layer
+      clusters                          member cluster count (>= 2)
+      cluster_wal_dirs                  explicit per-cluster durable
+                                        directories (len == clusters,
+                                        all distinct). Empty = derive
+                                        cluster-NN subdirectories under
+                                        durability.wal_dir
+      coordinator_wal_dir               the coordinator's OWN durable
+                                        journal directory (routes +
+                                        cluster state records). None =
+                                        derive coordinator/ under
+                                        durability.wal_dir. Must differ
+                                        from every cluster directory
+      heartbeat_interval_seconds        member heartbeat cadence the
+                                        health monitor samples
+      outage_detection_window_seconds   a cluster whose newest heartbeat
+                                        lags the newest PEER heartbeat
+                                        by more than this is declared
+                                        dead (must exceed the heartbeat
+                                        interval, or healthy members
+                                        false-trigger between beats)
+      drain_window_seconds              declared bound on a whole-cluster
+                                        drain: fence time + this window
+                                        must cover the last re-placed
+                                        gang (asserted by tests/chaos)
+      drain_max_gangs_per_round         drain pacing: at most this many
+                                        gangs re-placed per coordinator
+                                        round (per-tenant DisruptionLedger
+                                        budgets bound it further)
+    """
+
+    enabled: bool = False
+    clusters: int = 3
+    cluster_wal_dirs: list[str] = field(default_factory=list)
+    coordinator_wal_dir: str | None = None
+    heartbeat_interval_seconds: float = 10.0
+    outage_detection_window_seconds: float = 45.0
+    drain_window_seconds: float = 600.0
+    drain_max_gangs_per_round: int = 8
+
+
+@dataclass
 class OperatorConfig:
     api_version: str = API_VERSION
     kind: str = KIND
@@ -537,6 +591,7 @@ class OperatorConfig:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+    federation: FederationConfig = field(default_factory=FederationConfig)
 
 
 def _build(cls, data: Any, path: str, errs: list[str]):
@@ -579,6 +634,7 @@ _TYPES = {
     "TracingConfig": TracingConfig,
     "DurabilityConfig": DurabilityConfig,
     "ReplicationConfig": ReplicationConfig,
+    "FederationConfig": FederationConfig,
     "OperatorConfig": OperatorConfig,
 }
 
@@ -942,6 +998,102 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
                 "config.replication.standby_wal_dir: must differ from "
                 "config.durability.wal_dir — a standby journaling into "
                 "the leader's directory would interleave two histories"
+            )
+
+    fe = cfg.federation
+    if not isinstance(fe.enabled, bool):
+        errs.append("config.federation.enabled: must be a bool")
+    if not _int(fe.clusters) or fe.clusters < 2:
+        errs.append(
+            "config.federation.clusters: must be an int >= 2 (a "
+            "one-member federation has nowhere to fail over to)"
+        )
+    dirs_ok = isinstance(fe.cluster_wal_dirs, (list, tuple)) and all(
+        isinstance(d, str) and d for d in fe.cluster_wal_dirs
+    )
+    if not dirs_ok:
+        errs.append(
+            "config.federation.cluster_wal_dirs: must be a list of "
+            "non-empty directory paths"
+        )
+    elif fe.cluster_wal_dirs:
+        if _int(fe.clusters) and len(fe.cluster_wal_dirs) != fe.clusters:
+            errs.append(
+                "config.federation.cluster_wal_dirs: when given, must "
+                "name exactly config.federation.clusters directories"
+            )
+        if len(set(fe.cluster_wal_dirs)) != len(fe.cluster_wal_dirs):
+            errs.append(
+                "config.federation.cluster_wal_dirs: entries must be "
+                "distinct — two clusters journaling into one directory "
+                "would interleave two histories"
+            )
+    if fe.coordinator_wal_dir is not None and (
+        not isinstance(fe.coordinator_wal_dir, str)
+        or not fe.coordinator_wal_dir
+    ):
+        errs.append(
+            "config.federation.coordinator_wal_dir: must be null or a "
+            "non-empty directory path"
+        )
+    if not _num(fe.heartbeat_interval_seconds) or (
+        fe.heartbeat_interval_seconds <= 0
+    ):
+        errs.append(
+            "config.federation.heartbeat_interval_seconds: must be > 0"
+        )
+    if not _num(fe.outage_detection_window_seconds) or (
+        fe.outage_detection_window_seconds <= 0
+    ):
+        errs.append(
+            "config.federation.outage_detection_window_seconds: must "
+            "be > 0"
+        )
+    elif (
+        _num(fe.heartbeat_interval_seconds)
+        and fe.heartbeat_interval_seconds > 0
+        and fe.outage_detection_window_seconds
+        <= fe.heartbeat_interval_seconds
+    ):
+        errs.append(
+            "config.federation.outage_detection_window_seconds: must "
+            "exceed heartbeat_interval_seconds — a window shorter than "
+            "one beat declares healthy members dead between beats"
+        )
+    if not _num(fe.drain_window_seconds) or fe.drain_window_seconds <= 0:
+        errs.append("config.federation.drain_window_seconds: must be > 0")
+    if not _int(fe.drain_max_gangs_per_round) or (
+        fe.drain_max_gangs_per_round < 1
+    ):
+        errs.append(
+            "config.federation.drain_max_gangs_per_round: must be an "
+            "int >= 1"
+        )
+    if fe.enabled is True:
+        # no member may run without its own durable history: failover
+        # recovers the dead cluster's committed set FROM ITS DIRECTORY,
+        # and the coordinator's routing state must itself survive a
+        # coordinator crash — federation without durability would be a
+        # failover that forgets what it was failing over
+        if not du.wal_dir and not (dirs_ok and fe.cluster_wal_dirs):
+            errs.append(
+                "config.federation.enabled: requires "
+                "config.durability.wal_dir (per-cluster directories and "
+                "the coordinator journal derive under it) or explicit "
+                "config.federation.cluster_wal_dirs"
+            )
+        if not du.wal_dir and not fe.coordinator_wal_dir:
+            errs.append(
+                "config.federation.coordinator_wal_dir: required when "
+                "federation is enabled without config.durability.wal_dir "
+                "(the coordinator journals routes and fences durably)"
+            )
+        if dirs_ok and fe.coordinator_wal_dir and (
+            fe.coordinator_wal_dir in fe.cluster_wal_dirs
+        ):
+            errs.append(
+                "config.federation.coordinator_wal_dir: must differ "
+                "from every cluster_wal_dirs entry"
             )
     return errs
 

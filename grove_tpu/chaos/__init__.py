@@ -3,6 +3,11 @@
 store.py, harness.py; docs/operations.md "Fault tolerance & chaos
 testing")."""
 
+from .federation import (
+    FederationChaos,
+    federation_fingerprint,
+    federation_invariants,
+)
 from .harness import ChaosHarness, check_invariants, settled_fingerprint
 from .plan import FaultPlan
 from .store import ChaosStore, ConflictStorm, ManagerCrash, TransientFault
@@ -12,8 +17,11 @@ __all__ = [
     "ChaosStore",
     "ConflictStorm",
     "FaultPlan",
+    "FederationChaos",
     "ManagerCrash",
     "TransientFault",
     "check_invariants",
+    "federation_fingerprint",
+    "federation_invariants",
     "settled_fingerprint",
 ]

@@ -232,6 +232,29 @@ class FaultPlan:
     dual_leader_rate: float = 0.0
     standby_crash_rate: float = 0.0
 
+    # -- federation faults (chaos/federation.py; multi-cluster runs only).
+    # All default 0.0 and every draw is guarded (`rate > 0 and flip(...)`),
+    # so single-cluster plans — and therefore every pre-existing seed's
+    # draw sequence and verified convergence — are bit-identical. None of
+    # these join the from_seed mix tuple for the same reason.
+    #   cluster_outage     — one member cluster's heartbeats stop for
+    #                        good: the monitor must declare it, the
+    #                        coordinator must fence it (directory
+    #                        byte-unchanged, zombie appends refuse) and
+    #                        drain its whole committed gang set into
+    #                        survivors within the declared window
+    #   cluster_partition  — heartbeats suppressed for a few steps, then
+    #                        healed: a short blip must NOT trigger
+    #                        failover; one that outlives the window is a
+    #                        real outage and the healed member comes back
+    #                        as a fenced zombie, proving the fence
+    #   coordinator_crash  — the global layer loses every in-memory
+    #                        routing structure and must rebuild them from
+    #                        its durable journal alone
+    cluster_outage_rate: float = 0.0
+    cluster_partition_rate: float = 0.0
+    coordinator_crash_rate: float = 0.0
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

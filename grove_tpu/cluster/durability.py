@@ -1602,3 +1602,23 @@ def _load_partitioned_state(
             for name, stream in streams
         },
     }
+
+
+def read_only_state(wal_dir: str) -> tuple["ObjectStore", dict[str, Any]]:
+    """Rebuild a durable directory's committed state into a SCRATCH
+    store without attaching durability to it — a pure read: no
+    checkpoint, no genesis segment, not one byte written under
+    `wal_dir`. This is the federation coordinator's failover evidence
+    path (grove_tpu/federation): after fencing a dead cluster it reads
+    the committed gang set OUT of the fenced directory to drain into
+    survivors, and the byte-unchanged directory is what proves the
+    fence held. Returns (store, recovery stats) — the stats carry
+    `recovered_last_seq`, so the caller can assert the drained set
+    covers the full committed history (zero-loss accounting)."""
+    from .clock import SimClock
+    from .store import ObjectStore
+
+    store = ObjectStore(SimClock())
+    stats = load_durable_state(wal_dir, store)
+    store.recovery_stats = stats
+    return store, stats

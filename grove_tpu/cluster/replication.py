@@ -121,6 +121,24 @@ class ReplicationLink:
         self.term = term
 
 
+def fence_deposed(log, link: ReplicationLink) -> int:
+    """Depose a leader WITHOUT promoting a standby — the federation
+    coordinator's fence primitive for a whole-cluster outage
+    (grove_tpu/federation). Raising the shared link term above the
+    (possibly still running) cluster's own log term makes every
+    subsequent append from that control plane fail `FencedAppend`
+    before a byte moves (DurableLog.check_fence): a zombie cluster
+    returning from a partition may keep computing, but it can never
+    again extend its durable history — so it can never double-place a
+    gang the survivors adopted. The fenced directory is left
+    byte-untouched, which is exactly what lets the coordinator read
+    the committed set out of it as failover evidence
+    (durability.read_only_state). Returns the fencing term."""
+    new_term = max(link.term, log.term) + 1
+    link.term = new_term
+    return new_term
+
+
 #: the standby gauges this module owns; labeled by standby generation
 #: and reconciled away on promotion/re-seed (the PR 8/12 series-hygiene
 #: pattern) so a dead standby's series never linger on /metrics

@@ -52,13 +52,20 @@ def _expire_coordination_objects(store, config) -> None:
 class Harness:
     def __init__(self, nodes: list[Node] | None = None,
                  cluster: Cluster | None = None, engine_cls=None,
-                 config: OperatorConfig | dict | None = None):
+                 config: OperatorConfig | dict | None = None,
+                 cell_name: str | None = None):
         """config: an OperatorConfig, or a plain dict decoded+validated
         through api.config.load_operator_config (the --config YAML analog,
         cmd/cli/cli.go:89-106). Ignored when an existing cluster (which owns
-        its config) is passed."""
+        its config) is passed.
+
+        cell_name: the member-cluster identity when this harness is one
+        cell of a federation (grove_tpu/federation). Passed only by the
+        coordinator, gated through `accepts_kwarg` so single-cluster
+        callers and older harness subclasses stay untouched."""
         if isinstance(config, dict):
             config = load_operator_config(config)
+        self.cell_name = cell_name
         self.cluster = cluster or Cluster(nodes=nodes, config=config)
         self.config = self.cluster.config
         self.store = self.cluster.store
@@ -408,6 +415,38 @@ class Harness:
 
     def apply(self, pcs: PodCliqueSet):
         return self.store.create(pcs)
+
+    def adopt_workloads(self, sets: list[PodCliqueSet],
+                        source: str | None = None) -> list[PodCliqueSet]:
+        """Federation drain entry point: adopt PodCliqueSets recovered
+        from ANOTHER cluster's durable history. Each set is re-created
+        here with a fresh ObjectMeta carrying only the portable identity
+        (name/namespace/labels/annotations) — uid, resource_version and
+        timestamps belong to the dead store's history, and its
+        deletion_timestamp/finalizers/owner_references must not leak
+        into a store that never saw the owners. The create rides the
+        normal admission + journal path, so an adopted gang is committed
+        here exactly like a user-applied one; the next settle() places
+        it through the ordinary scheduler/eviction machinery."""
+        from ..api.meta import ObjectMeta
+        from ..cluster.store import clone
+
+        out = []
+        for pcs in sets:
+            annotations = dict(pcs.metadata.annotations or {})
+            if source:
+                annotations["grove.io/drained-from"] = source
+            fresh = PodCliqueSet(
+                metadata=ObjectMeta(
+                    name=pcs.metadata.name,
+                    namespace=pcs.metadata.namespace,
+                    labels=dict(pcs.metadata.labels or {}),
+                    annotations=annotations,
+                ),
+                spec=clone(pcs.spec),
+            )
+            out.append(self.store.create(fresh))
+        return out
 
     def settle(self, max_rounds: int | None = None) -> None:
         """Controllers + kubelet to fixpoint: reconcile until quiescent,

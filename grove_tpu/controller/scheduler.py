@@ -1341,6 +1341,32 @@ class GangScheduler:
         ).inc()
         self.recorder.normal(gang, "Defragmenting", msg)
 
+    def drain_budget_remaining(self, tenant: str | None,
+                               now: float | None = None) -> int | None:
+        """Federation drain entry point: how many more of `tenant`'s
+        gangs may be disrupted RIGHT NOW, by the same arithmetic the
+        preemption pass below and the defragmenter run — configured
+        budget minus the shared DisruptionLedger's live-window spend.
+        None = unlimited (tenancy off, exempt workload, or no budget
+        configured). The federation coordinator paces a whole-cluster
+        drain through this so "federation-drain" charges land in the
+        SAME rolling window as "preemption" and "defrag" — a cluster
+        failover cannot be used to launder a tenant's disruption
+        budget."""
+        tenancy = (
+            self.tenancy
+            if self.tenancy is not None and self.tenancy.enabled
+            else None
+        )
+        if tenancy is None or tenant is None:
+            return None
+        budget = tenancy.disruption_budget(tenant)
+        if budget is None:
+            return None
+        if now is None:
+            now = self.store.clock.now()
+        return max(0, budget - tenancy.ledger.spent(tenant, now))
+
     # -- priority preemption (the reclaim the reference outsources to KAI;
     # SURVEY §2: Grove hands PodGangs to an external scheduler that owns
     # reclaim between priority queues — grove_tpu owns the scheduler, so it

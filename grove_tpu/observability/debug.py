@@ -146,6 +146,14 @@ def harness_dump(harness) -> dict[str, Any]:
         # workload tiers, injected spikes, metrics-pipeline occupancy —
         # the runbook's first stop for "why didn't the HPA scale"
         out["serving"] = serving.debug_state()
+    federation = getattr(harness, "federation", None)
+    if federation is not None:
+        # this harness is one member cell of a federation
+        # (grove_tpu/federation): cell identity + lifecycle state, fence
+        # term, drain progress, and every wedged gang's home cluster and
+        # routing verdict — the runbook's first stop for "which cluster
+        # owns this gang, and did the router ever admit it"
+        out["federation"] = federation.debug_state()
     return out
 
 

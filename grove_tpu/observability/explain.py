@@ -86,6 +86,11 @@ class UnsatCode(str, Enum):
     #: the legacy magic string from a custom/older engine (kept
     #: preemption-eligible so external engines retain old behavior)
     NO_FEASIBLE_DOMAIN = "NoFeasibleDomain"
+    #: the federation router cut every member cluster (the same coarse
+    #: cordon/aggregate/fit predicates the hierarchical pruner runs,
+    #: one level up — grove_tpu/federation); the gang never reached any
+    #: cluster's control plane
+    NO_FEASIBLE_CLUSTER = "NoFeasibleCluster"
 
 
 #: codes for which priority preemption could plausibly free usable
@@ -95,6 +100,10 @@ class UnsatCode(str, Enum):
 #: QUOTA is excluded too: a shed gang is over its own tenant's quota, and
 #: evicting other tenants' work cannot lower that tenant's usage of it —
 #: preemption on a shed gang would just destroy victims for nothing.
+#: NO_FEASIBLE_CLUSTER is excluded for the same structural reason as
+#: UNRESOLVED_LEVEL: the gang was cut ABOVE every cluster's control
+#: plane, so no in-cluster eviction pass can run for it — only the
+#: federation router retrying against refreshed aggregates can admit it.
 PREEMPTIBLE_CODES = frozenset(
     (
         UnsatCode.CAPACITY,

@@ -404,6 +404,49 @@ def coarse_admissible(
     return admissible, dom_free, stats, inverse.reshape(-1)
 
 
+def cluster_level_aggregates(
+    snapshots: list[TopologySnapshot],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[str]]:
+    """`domain_level_aggregates` lifted ONE level above the topology
+    tree: each member cluster of a federation is a single super-domain
+    (ids all zero, nd = 1 per snapshot), so the global router's cut
+    predicates are literally the coarse phase's, evaluated over
+    per-cluster aggregates. Returns (sched_cnt [C], free [C, R],
+    max_free [C, R], resource_names) on the UNION resource axis —
+    heterogeneous members contribute zero for resources they lack,
+    which can only tighten their own cuts, never another cluster's.
+
+    The over-admit contract carries up unchanged: every cut is implied
+    by a constraint some member control plane would itself enforce
+    (no schedulable node; aggregate free short of total demand; no
+    single node fits the largest pod), so routing may only OVER-admit —
+    a cluster the flat single-cluster solve would place into is never
+    cut (tests/test_federation.py sweeps this against per-cluster
+    exact solves)."""
+    axis: list[str] = []
+    for snap in snapshots:
+        for r in snap.resource_names:
+            if r not in axis:
+                axis.append(r)
+    c, nr = len(snapshots), len(axis)
+    sched_cnt = np.zeros(c, dtype=np.float64)
+    free = np.zeros((c, nr), dtype=np.float64)
+    max_free = np.zeros((c, nr), dtype=np.float64)
+    for i, snap in enumerate(snapshots):
+        cols = [axis.index(r) for r in snap.resource_names]
+        fm = np.where(snap.schedulable[:, None], snap.free, 0.0)
+        cnt, agg = domain_level_aggregates(
+            np.zeros(fm.shape[0], dtype=np.int64), 1,
+            snap.schedulable, fm,
+        )
+        sched_cnt[i] = cnt[0]
+        free[i, cols] = agg[0]
+        srows = np.flatnonzero(snap.schedulable)
+        if srows.size:
+            max_free[i, cols] = fm[srows].max(axis=0)
+    return sched_cnt, free, max_free, axis
+
+
 def coarse_assign(
     order: list[SolverGang],
     admissible: np.ndarray,

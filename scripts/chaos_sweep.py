@@ -199,6 +199,24 @@ DEFRAG_CONFIG = {
 }
 
 
+#: federation config for --federation sweeps: a 3-member federation with
+#: a SHORT outage window (a seeded cluster_partition of a few 2-second
+#: steps can outlive it, so the healed-zombie fence path is actually on
+#: the sweep's fault path) and a drain window generous enough that
+#: pacing — not the deadline — bounds the failover. wal_dir is filled in
+#: per seed (each member + the coordinator journal get subdirectories).
+FEDERATION_CONFIG = {
+    "federation": {
+        "enabled": True,
+        "clusters": 3,
+        "heartbeat_interval_seconds": 2.0,
+        "outage_detection_window_seconds": 12.0,
+        "drain_window_seconds": 400.0,
+        "drain_max_gangs_per_round": 4,
+    }
+}
+
+
 def run_seed(seed: int, nodes: int, baseline: dict,
              trace_dir: Path | None = None,
              explain_dir: Path | None = None,
@@ -409,6 +427,172 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
     return result
 
 
+def federation_workload() -> list:
+    """The federation sweep's workload: a fan of independent gangs (one
+    routing decision each) across two namespaces — enough of them that a
+    whole member's committed set is a real drain, small enough that a
+    3-member sweep stays CI-sized."""
+    from grove_tpu.api.meta import ObjectMeta
+    from grove_tpu.api.types import (
+        Container,
+        PodCliqueSet,
+        PodCliqueSetSpec,
+        PodCliqueSetTemplateSpec,
+        PodCliqueSpec,
+        PodCliqueTemplateSpec,
+        PodSpec,
+    )
+
+    return [
+        PodCliqueSet(
+            metadata=ObjectMeta(
+                name=f"fed-{j}",
+                namespace="team-a" if j % 2 else "team-b",
+            ),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[
+                        PodCliqueTemplateSpec(
+                            name="w",
+                            spec=PodCliqueSpec(
+                                replicas=4,
+                                pod_spec=PodSpec(
+                                    containers=[
+                                        Container(
+                                            name="m",
+                                            resources={"cpu": 1.0},
+                                        )
+                                    ]
+                                ),
+                            ),
+                        )
+                    ]
+                ),
+            ),
+        )
+        for j in range(9)
+    ]
+
+
+def _build_federation(nodes: int, wal_root: str):
+    from grove_tpu.cluster import make_nodes as _mk
+    from grove_tpu.federation import FederationCoordinator
+
+    config = {
+        **FEDERATION_CONFIG,
+        "durability": {
+            **DURABILITY_CONFIG,
+            "wal_dir": str(Path(wal_root) / "wal"),
+        },
+    }
+    fed = FederationCoordinator(
+        config,
+        [_mk(nodes, name_prefix=f"c{i}-n") for i in range(3)],
+    )
+    quiet = io.StringIO()
+    for cell in fed.cells:
+        cell.harness.cluster.logger.stream = quiet
+        cell.harness.manager.logger.stream = quiet
+        cell.harness.scheduler.log.stream = quiet
+        cell.harness.defrag.log.stream = quiet
+    return fed
+
+
+def federation_baseline(nodes: int) -> dict:
+    """The fault-free federation fixpoint the chaotic runs must converge
+    back to (merged survivor-side workload fingerprint)."""
+    import tempfile
+
+    from grove_tpu.chaos import federation_fingerprint
+
+    with tempfile.TemporaryDirectory(prefix="grove-fed-base-") as td:
+        fed = _build_federation(nodes, td)
+        try:
+            for pcs in federation_workload():
+                fed.apply(pcs)
+            fed.settle()
+            for _ in range(4):
+                fed.advance(2.0)
+            return federation_fingerprint(fed)
+        finally:
+            fed.close()
+
+
+def run_federation_seed(seed: int, nodes: int, baseline: dict,
+                        trace_dir: Path | None = None,
+                        explain_dir: Path | None = None) -> dict:
+    """One seeded federation chaos run: whole-cluster outage, cluster
+    partitions and coordinator crashes over the 3-member harness, judged
+    against the fault-free federation fixpoint. The three federation
+    rates are fixed (not mix-scaled): they are the only draws this
+    driver makes, so every seed exercises the failover machinery."""
+    import tempfile
+
+    from grove_tpu.chaos import FederationChaos
+
+    plan = FaultPlan(
+        seed=seed,
+        cluster_outage_rate=0.1,
+        cluster_partition_rate=0.08,
+        coordinator_crash_rate=0.05,
+        chaos_steps=40,
+        step_seconds=2.0,
+    )
+    t0 = time.perf_counter()
+    error = None
+    post: dict = {}
+    fed = None
+    with tempfile.TemporaryDirectory(prefix=f"grove-fed-{seed}-") as td:
+        try:
+            fed = _build_federation(nodes, td)
+            post = FederationChaos(plan, fed).run(federation_workload())
+        except Exception as exc:  # a failing seed must not stop the sweep
+            error = f"{type(exc).__name__}: {exc}"
+        finally:
+            if fed is not None:
+                fed.close()
+    fingerprint_ok = bool(post) and post["fingerprint"] == baseline
+    violations = post.get("invariant_violations", [])
+    ok = fingerprint_ok and not violations and error is None
+    result = {
+        "seed": seed,
+        "ok": ok,
+        "fingerprint_match": fingerprint_ok,
+        "invariant_violations": violations,
+        "error": error,
+        "faults_injected": dict(sorted(plan.counts.items())),
+        "fence_proofs": post.get("fence_proofs", 0),
+        "coordinator_crashes": post.get("coordinator_crashes", 0),
+        "outage_cluster": post.get("outage_cluster"),
+        "cluster_states": post.get("cluster_states", {}),
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+    }
+    outage = post.get("outage")
+    if outage is not None and post.get("drained_at") is not None:
+        result["drain_seconds"] = round(
+            post["drained_at"] - outage["declared_at"], 3
+        )
+    if not ok and trace_dir is not None:
+        # the federation postmortem: per-member lifecycle + routing
+        # verdicts + the wedged set, the global-layer analog of the
+        # flight-recorder dump
+        trace_path = str(trace_dir / f"seed-{seed}-federation-flight.json")
+        with open(trace_path, "w") as fh:
+            json.dump(post, fh, indent=2, default=str)
+            fh.write("\n")
+        result["flight_dump"] = trace_path
+    if explain_dir is not None and post.get("wedged", {}).get("wedged"):
+        explain_path = str(
+            explain_dir / f"seed-{seed}-federation-explain.json"
+        )
+        with open(explain_path, "w") as fh:
+            json.dump(post["wedged"], fh, indent=2, default=str)
+            fh.write("\n")
+        result["explain_dump"] = explain_path
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seeds", type=int, default=60,
@@ -532,7 +716,30 @@ def main(argv=None) -> int:
                          "fault (some shed with QuotaExceeded); the "
                          "skew leaves at disarm, so convergence is "
                          "checked against the same fault-free fixpoint")
+    ap.add_argument("--federation", action="store_true",
+                    help="sweep the FEDERATION fault axis instead of the "
+                         "single-cluster matrix: a 3-member federation "
+                         "(grove_tpu/federation, per-seed temp WAL "
+                         "dirs, durability always on) under seeded "
+                         "whole-cluster outages (declare + fence + "
+                         "drain into survivors, the zombie append "
+                         "refused and its directory byte-unchanged), "
+                         "cluster partitions (short blips must NOT "
+                         "fail over; ones outliving the window must), "
+                         "and coordinator crashes (routing state "
+                         "rebuilt from the durable journal); "
+                         "convergence is checked against a fault-free "
+                         "federation fixpoint. Standalone — not "
+                         "composable with the single-cluster axes")
     args = ap.parse_args(argv)
+    if args.federation and (
+        args.durability or args.replication or args.shards > 1
+        or args.serving or args.hierarchical or args.defrag
+        or args.tenant_skew
+    ):
+        ap.error("--federation is its own sweep axis (every member "
+                 "already runs durable); it does not compose with the "
+                 "single-cluster axes")
     if args.partitions > 1 and not args.durability:
         ap.error("--partitions requires --durability (there is no WAL "
                  "to partition without it)")
@@ -547,6 +754,36 @@ def main(argv=None) -> int:
     if args.explain_dir:
         explain_dir = Path(args.explain_dir)
         explain_dir.mkdir(parents=True, exist_ok=True)
+
+    if args.federation:
+        baseline = federation_baseline(args.nodes)
+        results = []
+        failed = []
+        for seed in range(args.start, args.start + args.seeds):
+            result = run_federation_seed(
+                seed, args.nodes, baseline,
+                trace_dir=trace_dir, explain_dir=explain_dir,
+            )
+            print(json.dumps(result), flush=True)
+            results.append(result)
+            if not result["ok"]:
+                failed.append(seed)
+        summary = {
+            "swept": args.seeds,
+            "start": args.start,
+            "nodes": args.nodes,
+            "federation": True,
+            "failed_seeds": failed,
+            "ok": not failed,
+        }
+        print(json.dumps(summary), flush=True)
+        if args.json_path:
+            with open(args.json_path, "w") as fh:
+                json.dump(
+                    {"summary": summary, "results": results}, fh, indent=2
+                )
+                fh.write("\n")
+        return 1 if failed else 0
 
     # the baseline fixpoint must be computed under the SAME config the
     # chaos runs use (tenancy changes PodGang defaulting) — but always
