@@ -303,6 +303,51 @@ class DefragConfig:
 
 
 @dataclass
+class StreamConfig:
+    """Streaming admission→solve front (grove_tpu/streaming/): replaces
+    round-draining with SLO-aware micro-batches. Each arriving gang gets
+    a deadline budget of `slo_seconds`; a batching window closes when the
+    oldest waiter's remaining budget says so (or `max_batch_gangs` hits),
+    consecutive micro-batches pipeline through the dispatch/collect
+    split, and overload degrades by SHEDDING with a structured
+    `UnsatCode.DeadlineExceeded` — never by wedging or unbounded queueing.
+
+      enabled                   off by default — streaming changes the
+                                backlog-draining contract; opting in is
+                                deliberate
+      slo_seconds               per-gang deadline budget from stream
+                                arrival to admission into a solve batch;
+                                a projected wait beyond it sheds the gang
+      window_min_seconds        normal batching window: a micro-batch
+                                closes once its oldest waiter has waited
+                                this long (arrivals inside the window
+                                coalesce into one solve)
+      window_max_seconds        widened window under brownout level >= 1
+                                (amortizes solves when the queue is deep)
+      max_batch_gangs           size cap that closes a window early
+      queue_cap_gangs           bounded admission queue: arrivals beyond
+                                it shed immediately (backpressure floor)
+      brownout_depth_fraction   queue depth / queue_cap_gangs at which
+                                the brownout ladder starts climbing
+                                (L1 widen window, L2 suspend defrag
+                                sweeps, L3 shed burst-band waiters)
+      readmit_depth_fraction    depth fraction below which shed gangs
+                                re-enter the stream with fresh deadlines
+                                (must be < brownout_depth_fraction so
+                                re-admit and shed never oscillate)
+    """
+
+    enabled: bool = False
+    slo_seconds: float = 30.0
+    window_min_seconds: float = 0.25
+    window_max_seconds: float = 2.0
+    max_batch_gangs: int = 64
+    queue_cap_gangs: int = 512
+    brownout_depth_fraction: float = 0.5
+    readmit_depth_fraction: float = 0.25
+
+
+@dataclass
 class AutoscalerConfig:
     """k8s HPA controller knobs (controller/autoscaler.py).
 
@@ -578,6 +623,7 @@ class OperatorConfig:
     solver: SolverConfig = field(default_factory=SolverConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     defrag: DefragConfig = field(default_factory=DefragConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
@@ -626,6 +672,7 @@ _TYPES = {
     "SolverConfig": SolverConfig,
     "TenancyConfig": TenancyConfig,
     "DefragConfig": DefragConfig,
+    "StreamConfig": StreamConfig,
     "AutoscalerConfig": AutoscalerConfig,
     "ServingConfig": ServingConfig,
     "AuthorizationConfig": AuthorizationConfig,
@@ -798,6 +845,7 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
 
     errs += _validate_tenancy(cfg.tenancy)
     errs += _validate_defrag(cfg.defrag)
+    errs += _validate_stream(cfg.stream)
 
     le = cfg.leader_election
     if not isinstance(le.enabled, bool):
@@ -1239,6 +1287,59 @@ def _validate_defrag(df: DefragConfig) -> list[str]:
     if not _int(df.candidates_per_sweep) or df.candidates_per_sweep < 1:
         errs.append(
             "config.defrag.candidates_per_sweep: must be an int >= 1"
+        )
+    return errs
+
+
+def _validate_stream(st: StreamConfig) -> list[str]:
+    """Aggregated semantic validation of the streaming-admission block."""
+    errs: list[str] = []
+    if not isinstance(st.enabled, bool):
+        errs.append("config.stream.enabled: must be a bool")
+    for f in ("slo_seconds", "window_min_seconds", "window_max_seconds"):
+        v = getattr(st, f)
+        if not _num(v) or v <= 0:
+            errs.append(f"config.stream.{f}: must be > 0")
+    if (
+        _num(st.window_min_seconds)
+        and _num(st.window_max_seconds)
+        and st.window_min_seconds > 0
+        and st.window_max_seconds < st.window_min_seconds
+    ):
+        errs.append(
+            "config.stream.window_max_seconds: must be >= window_min_seconds"
+        )
+    if (
+        _num(st.slo_seconds)
+        and _num(st.window_min_seconds)
+        and st.window_min_seconds > 0
+        and st.slo_seconds < st.window_min_seconds
+    ):
+        # an SLO shorter than the minimum window sheds EVERY arrival:
+        # no gang could ever wait out a window inside its budget
+        errs.append(
+            "config.stream.slo_seconds: must be >= window_min_seconds"
+        )
+    for f in ("max_batch_gangs", "queue_cap_gangs"):
+        v = getattr(st, f)
+        if not _int(v) or v < 1:
+            errs.append(f"config.stream.{f}: must be an int >= 1")
+    for f in ("brownout_depth_fraction", "readmit_depth_fraction"):
+        v = getattr(st, f)
+        if not _num(v) or not (0 < v <= 1):
+            errs.append(f"config.stream.{f}: must be in (0, 1]")
+    if (
+        _num(st.brownout_depth_fraction)
+        and _num(st.readmit_depth_fraction)
+        and 0 < st.brownout_depth_fraction <= 1
+        and 0 < st.readmit_depth_fraction <= 1
+        and st.readmit_depth_fraction >= st.brownout_depth_fraction
+    ):
+        # hysteresis: re-admitting at or above the brownout threshold
+        # would oscillate shed <-> re-admit every round
+        errs.append(
+            "config.stream.readmit_depth_fraction: must be < "
+            "brownout_depth_fraction (shed/re-admit hysteresis)"
         )
     return errs
 

@@ -157,6 +157,10 @@ class ChaosHarness:
         #: PCS keys; all deleted at disarm so the recovered fixpoint
         #: matches the fault-free run)
         self._skew_workloads: list[tuple[str, str]] = []
+        #: burst-storm workloads injected this run (same lifecycle as
+        #: the skew workloads: deleted at disarm so the recovered
+        #: fixpoint matches the fault-free run)
+        self._storm_workloads: list[tuple[str, str]] = []
         #: shard-fault bookkeeping: crashed worker indices (revived at
         #: disarm; shards fail over meanwhile via orphaned-lease
         #: detection)
@@ -346,6 +350,21 @@ class ChaosHarness:
         skew. Injected PCS are tracked and deleted at disarm (see
         _repair_infrastructure), so the post-chaos fixpoint equals the
         fault-free one."""
+        plan = self.plan
+        tenants = self._skew_tenant_names()
+        ns = tenants[plan.pick(len(tenants))]
+        for _ in range(max(1, plan.tenant_skew_burst)):
+            name = f"skew-{len(self._skew_workloads)}"
+            # injected via the RAW store: the fault driver must not fault
+            # its own injections (the chaos proxy would raise transient
+            # write failures / ManagerCrash at the driver level)
+            self.raw_store.create(self._burst_pcs(ns, name))
+            self._skew_workloads.append((ns, name))
+
+    @staticmethod
+    def _burst_pcs(ns: str, name: str):
+        """One single-replica two-pod PCS — the unit of injected load for
+        the tenant-skew and burst-storm fault axes."""
         from ..api.meta import ObjectMeta
         from ..api.types import (
             Container,
@@ -357,40 +376,77 @@ class ChaosHarness:
             PodSpec,
         )
 
-        plan = self.plan
-        tenants = self._skew_tenant_names()
-        ns = tenants[plan.pick(len(tenants))]
-        for _ in range(max(1, plan.tenant_skew_burst)):
-            name = f"skew-{len(self._skew_workloads)}"
-            pcs = PodCliqueSet(
-                metadata=ObjectMeta(name=name, namespace=ns),
-                spec=PodCliqueSetSpec(
-                    replicas=1,
-                    template=PodCliqueSetTemplateSpec(
-                        cliques=[
-                            PodCliqueTemplateSpec(
-                                name="w",
-                                spec=PodCliqueSpec(
-                                    replicas=2,
-                                    pod_spec=PodSpec(
-                                        containers=[
-                                            Container(
-                                                name="m",
-                                                resources={"cpu": 1.0},
-                                            )
-                                        ]
-                                    ),
+        return PodCliqueSet(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=PodCliqueSetSpec(
+                replicas=1,
+                template=PodCliqueSetTemplateSpec(
+                    cliques=[
+                        PodCliqueTemplateSpec(
+                            name="w",
+                            spec=PodCliqueSpec(
+                                replicas=2,
+                                pod_spec=PodSpec(
+                                    containers=[
+                                        Container(
+                                            name="m",
+                                            resources={"cpu": 1.0},
+                                        )
+                                    ]
                                 ),
-                            )
-                        ]
-                    ),
+                            ),
+                        )
+                    ]
                 ),
+            ),
+        )
+
+    # -- streaming-admission faults ------------------------------------------
+    @property
+    def _stream(self):
+        """The scheduler's StreamFront when config.stream.enabled, else
+        None (stream faults are skipped entirely — rate-guarded AND
+        capability-guarded, so pre-existing seeds replay identically
+        either way). Read through the harness each time: a manager
+        crash-restart rebuilds the scheduler and its front."""
+        return getattr(self.harness.scheduler, "stream", None)
+
+    def _inject_stream_faults(self) -> None:
+        """Per-step streaming-admission fault draws (see FaultPlan):
+        burst storms and arrival stalls. Every draw is guarded on
+        rate > 0 AND on the streaming front being configured.
+
+        burst_storm lands `plan.burst_storm_gangs` single-replica gangs
+        in ONE seeded tenant's namespace at a single instant — the ~10x
+        overload spike the front must absorb by shedding with structured
+        DeadlineExceeded rather than wedging. Injected PCS are tracked
+        and deleted at disarm (see _repair_infrastructure), so the
+        recovered fixpoint equals the fault-free one.
+
+        arrival_stall holds admission for a few chaos steps via the
+        front's stall hook; deadline budgets keep burning through the
+        stall, so it resolves into either a batched admit or a deadline
+        shed — never a wedged queue. Cleared at disarm."""
+        plan = self.plan
+        stream = self._stream
+        if stream is None:
+            return
+        if plan.burst_storm_rate > 0 and plan.flip(plan.burst_storm_rate):
+            self._record("burst_storm")
+            tenants = self._skew_tenant_names()
+            ns = tenants[plan.pick(len(tenants))]
+            for _ in range(max(1, plan.burst_storm_gangs)):
+                name = f"storm-{len(self._storm_workloads)}"
+                self.raw_store.create(self._burst_pcs(ns, name))
+                self._storm_workloads.append((ns, name))
+        if plan.arrival_stall_rate > 0 and plan.flip(
+            plan.arrival_stall_rate
+        ):
+            self._record("arrival_stall")
+            stream.stall(
+                self.clock.now()
+                + max(1, plan.arrival_stall_steps) * plan.step_seconds
             )
-            # injected via the RAW store: the fault driver must not fault
-            # its own injections (the chaos proxy would raise transient
-            # write failures / ManagerCrash at the driver level)
-            self.raw_store.create(pcs)
-            self._skew_workloads.append((ns, name))
 
     def _inject_shard_faults(self) -> None:
         """Per-step sharded-control-plane fault draws (see FaultPlan):
@@ -870,6 +926,16 @@ class ChaosHarness:
             if self.raw_store.peek(PodCliqueSet.KIND, ns, name) is not None:
                 self.raw_store.delete(PodCliqueSet.KIND, ns, name)
         self._skew_workloads = []
+        for ns, name in self._storm_workloads:
+            # storm load leaves with the faults, exactly like skew load
+            if self.raw_store.peek(PodCliqueSet.KIND, ns, name) is not None:
+                self.raw_store.delete(PodCliqueSet.KIND, ns, name)
+        self._storm_workloads = []
+        stream = self._stream
+        if stream is not None:
+            # any in-flight arrival stall clears with the faults;
+            # parked waiters admit (or deadline-shed) on the next rounds
+            stream.clear_stall()
 
     def run_chaos(self) -> None:
         """The chaos phase: `plan.chaos_steps` driver steps of manager
@@ -905,6 +971,7 @@ class ChaosHarness:
                 self._inject_replication_faults()
                 self._inject_serving_faults()
                 self._inject_defrag_faults()
+                self._inject_stream_faults()
                 stalled = plan.flip(plan.kubelet_stall_rate)
                 if stalled:
                     self._record("kubelet_stall")

@@ -255,6 +255,29 @@ class FaultPlan:
     cluster_partition_rate: float = 0.0
     coordinator_crash_rate: float = 0.0
 
+    # streaming-admission faults (per chaos step; meaningful only when
+    # the harness runs config.stream.enabled — skipped entirely
+    # otherwise). DEFAULT 0 with runtime draws guarded on rate > 0 (the
+    # standing contract), so every pre-existing seed's draw sequence —
+    # and its verified convergence — is bit-identical. Not in the
+    # from_seed mix tuple for the same reason.
+    #   burst_storm   — a ~10x Poisson burst of gangs lands in one step:
+    #                   the streaming front must shed with structured
+    #                   DeadlineExceeded rather than wedge, and once the
+    #                   storm workload is deleted at disarm the run must
+    #                   converge back to the fault-free fixpoint
+    #   arrival_stall — admission stalls for a few steps (the front holds
+    #                   every waiter); budgets keep burning, so the stall
+    #                   ends in either a clean batched admit or a
+    #                   deadline shed — never a wedged queue
+    burst_storm_rate: float = 0.0
+    #: multiplier on the plan's step-sized arrival expectation — how many
+    #: gangs one injected storm creates (the "10x" in a 10x burst)
+    burst_storm_gangs: int = 20
+    arrival_stall_rate: float = 0.0
+    #: how many chaos steps one injected stall holds admission
+    arrival_stall_steps: int = 3
+
     counts: dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:

@@ -402,6 +402,13 @@ class Harness:
         cfg = self.config.defrag
         if not cfg.enabled:
             return False
+        stream = getattr(self.scheduler, "stream", None)
+        if stream is not None and stream.defrag_suspended:
+            # brownout L2 (grove_tpu/streaming): defrag evictions feed
+            # the very backlog the stream is shedding — hold sweeps (and
+            # their cadence clock) until the queue drains below the
+            # ladder
+            return False
         if (
             self.clock.now() - self.defrag.last_sync
             < cfg.sync_interval_seconds

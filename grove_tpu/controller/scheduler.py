@@ -37,7 +37,12 @@ from ..observability.events import (
     REASON_PODGANG_SCHEDULED,
     REASON_PODGANG_UNSCHEDULABLE,
 )
-from ..observability.explain import unsat_code, unsat_preemptible
+from ..observability.explain import (
+    UnsatCode,
+    UnsatDiagnosis,
+    unsat_code,
+    unsat_preemptible,
+)
 from ..observability.tracing import accepts_kwarg, accepts_tracer_kwarg
 from ..solver import PlacementEngine, SolverGang, encode_podgangs
 from ..solver.problem import (
@@ -48,6 +53,15 @@ from ..solver.problem import (
 from .runtime import Request, Result
 
 _SINGLETON_REQ = Request("", "schedule")
+
+
+def _min_requeue(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    """Earliest of two optional requeue delays (None = no timer)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
 
 
 class GangScheduler:
@@ -146,6 +160,20 @@ class GangScheduler:
         #: None when the cluster predates it (custom test fixtures);
         #: every hook below checks enabled
         self.tenancy = getattr(cluster, "tenancy", None)
+        #: streaming admission front (grove_tpu/streaming): None keeps
+        #: the classic round-draining contract. Owned by the scheduler
+        #: instance — its queue is SOFT state, so a manager crash-restart
+        #: rebuilds it empty and pending gangs re-register with fresh
+        #: deadlines on the next scan (conservative, never a lost gang).
+        self.stream = None
+        stream_cfg = getattr(cfg, "stream", None)
+        if stream_cfg is not None and stream_cfg.enabled:
+            from ..streaming import StreamFront
+
+            self.stream = StreamFront(
+                stream_cfg, cluster.store.clock, metrics=cluster.metrics,
+                tenancy=self.tenancy,
+            )
         #: fairness kwarg gates, same capability pattern as the
         #: device-state knobs: the DRF weight vector is only passed to
         #: solve/dispatch when the engine's signature takes it — a
@@ -546,6 +574,23 @@ class GangScheduler:
                         (gang.metadata.namespace, gang.metadata.name)
                     )
             sp.set(backlog=len(backlog_keys), dispatched=False)
+            if self.stream is not None and backlog_keys:
+                # speculative micro-batch partition: the SAME plan the
+                # reconcile computes at this instant (plan_round is
+                # idempotent per instant), so the dispatched batch is
+                # exactly what the consume-time filter admits. Sheds are
+                # NOT stamped here (pre_round writes nothing) — the
+                # reconcile's plan re-reports them until acked.
+                plan = self.stream.plan_round(
+                    backlog_keys, self.store.clock.now(),
+                    band_of=self._stream_band_of,
+                )
+                backlog_keys = plan.admitted
+                sp.set(
+                    stream_admitted=len(plan.admitted),
+                    stream_deferred=plan.deferred,
+                    stream_shed=len(plan.shed),
+                )
             if not backlog_keys:
                 return
             snapshot = self.cluster.topology_snapshot()
@@ -619,6 +664,10 @@ class GangScheduler:
             "preemption_attempted_for": len(self._preempted_for),
             "pending_migrations": len(self._migrations),
             "migrated_tombstones": len(self._migrated),
+            "stream": (
+                self.stream.debug_state()
+                if self.stream is not None else None
+            ),
             "placement": {
                 "mean_score": (
                     round(sum(scores.values()) / len(scores), 4)
@@ -712,6 +761,24 @@ class GangScheduler:
         # simply no data (debug_state reports None for the same state)
         if score_n:
             self.export_placement_score(score_sum / score_n)
+        # streaming admission (grove_tpu/streaming): partition the
+        # backlog into this round's micro-batch, the waiters whose
+        # window is still open, and the sheds — the AUTHORITATIVE plan
+        # (same instant, same keys => same partition as pre_round's
+        # speculative call, so dispatch adoption still works). Sheds are
+        # stamped immediately: a round that admits nothing must still
+        # shed rather than silently defer past the SLO.
+        stream_plan = None
+        stream_requeue: Optional[float] = None
+        if self.stream is not None:
+            stream_plan = self.stream.plan_round(
+                backlog_keys, self.store.clock.now(),
+                band_of=self._stream_band_of,
+            )
+            backlog_keys = stream_plan.admitted
+            stream_requeue = stream_plan.requeue_after
+            if stream_plan.shed:
+                self._shed_stream(stream_plan)
         # one preemption attempt per BACKLOG STAY: a gang that left the
         # backlog (deleted, or scheduled elsewhere, or pods gone) gets a
         # fresh attempt on return — and the set cannot leak across gang
@@ -732,9 +799,10 @@ class GangScheduler:
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
             self._update_phases(examine)
-            return Result(
-                requeue_after=self.retry_seconds if blocked_pending else None
-            )
+            return Result(requeue_after=_min_requeue(
+                self.retry_seconds if blocked_pending else None,
+                stream_requeue,
+            ))
 
         snapshot = self.cluster.topology_snapshot()
         engine = self._engine_for(snapshot)
@@ -747,6 +815,26 @@ class GangScheduler:
             self.retry_seconds if blocked_pending else None
         )
         if backlog_keys:
+            if stream_plan is not None:
+                # consume-time accounting, exactly once per solved batch
+                # (never in the speculative plan): per-gang queue-wait
+                # tracer points for the span timeline, the wait
+                # histogram, and a fresh budget for whatever the solve
+                # leaves unplaced (its wait-to-first-solve was served)
+                now_v = self.store.clock.now()
+                for ns, name in backlog_keys:
+                    self.tracer.point(
+                        "scheduler.stream_admit",
+                        gang=f"{ns}/{name}",
+                        queue_wait=round(
+                            stream_plan.waits.get((ns, name), 0.0), 9
+                        ),
+                        window=stream_plan.window_seconds,
+                        brownout=stream_plan.brownout_level,
+                    )
+                self.stream.consumed(
+                    backlog_keys, stream_plan.waits, now_v
+                )
             with self.tracer.span(
                 "scheduler.solve", gangs=len(backlog_keys)
             ) as solve_sp:
@@ -778,7 +866,7 @@ class GangScheduler:
             (examine | set(backlog_keys)) - self._just_bound
         )
         self._just_bound = set()
-        return Result(requeue_after=requeue)
+        return Result(requeue_after=_min_requeue(requeue, stream_requeue))
 
     def _solve_backlog(
         self, backlog_keys, snapshot, engine, free, demand_fn, solve_sp
@@ -888,44 +976,9 @@ class GangScheduler:
         for name, placement in result.placed.items():
             self._bind(by_name[name], placement)
         for name, reason in result.unplaced.items():
-            gang = by_name[name]
-            code = unsat_code(reason)
-            # per-solve outcome counter, labeled by the structured code
-            # (distinct from gangs_unschedulable_total, which counts
-            # state TRANSITIONS): "what is blocking my backlog" as a
-            # queryable time series
-            self.metrics.counter(
-                "grove_scheduler_unplaced_total",
-                "unplaced gang solve outcomes by structured reason code",
-            ).inc(reason=code.value if code is not None else "Unknown")
-            before = clone(gang.status)
-            prev = get_condition(
-                gang.status.conditions, PodGangConditionType.SCHEDULED.value
+            self._stamp_unschedulable(
+                by_name[name], reason, unsat_code(reason)
             )
-            entered = prev is None or prev.status != "False"
-            set_condition(
-                gang.status.conditions,
-                PodGangConditionType.SCHEDULED.value,
-                "False",
-                # the condition carries the STRUCTURED code as its
-                # machine-readable reason (k8s CamelCase convention);
-                # free-form strings from custom engines keep the legacy
-                # "Unschedulable". The human message stays the full text.
-                reason=code.value if code is not None else "Unschedulable",
-                message=reason,
-                now=self.store.clock.now(),
-            )
-            if gang.status != before:
-                self.store.update_status(gang)
-                self._mark_own()
-            if entered:  # count state TRANSITIONS, not message churn
-                self.metrics.counter(
-                    "grove_scheduler_gangs_unschedulable_total",
-                    "gangs that entered the Unschedulable state",
-                ).inc()
-                self.recorder.warning(
-                    gang, REASON_PODGANG_UNSCHEDULABLE, reason
-                )
         if self.preemption_enabled and result.unplaced:
             with self.tracer.span(
                 "scheduler.preempt", starved=len(result.unplaced)
@@ -935,6 +988,105 @@ class GangScheduler:
                     demand_fn,
                 ))
         return bool(result.unplaced)
+
+    def _stamp_unschedulable(self, gang: PodGang, reason,
+                             code) -> None:
+        """The ONE unplaced-gang stamping path, shared by the solver's
+        unsat outcomes and the streaming front's sheds: the per-solve
+        outcome counter labeled by structured code, the Scheduled=False
+        condition carrying the code as its machine-readable reason, and
+        the transition counter + warning event on ENTERING the state."""
+        # per-solve outcome counter, labeled by the structured code
+        # (distinct from gangs_unschedulable_total, which counts
+        # state TRANSITIONS): "what is blocking my backlog" as a
+        # queryable time series
+        self.metrics.counter(
+            "grove_scheduler_unplaced_total",
+            "unplaced gang solve outcomes by structured reason code",
+        ).inc(reason=code.value if code is not None else "Unknown")
+        before = clone(gang.status)
+        prev = get_condition(
+            gang.status.conditions, PodGangConditionType.SCHEDULED.value
+        )
+        entered = prev is None or prev.status != "False"
+        set_condition(
+            gang.status.conditions,
+            PodGangConditionType.SCHEDULED.value,
+            "False",
+            # the condition carries the STRUCTURED code as its
+            # machine-readable reason (k8s CamelCase convention);
+            # free-form strings from custom engines keep the legacy
+            # "Unschedulable". The human message stays the full text.
+            reason=code.value if code is not None else "Unschedulable",
+            message=reason,
+            now=self.store.clock.now(),
+        )
+        if gang.status != before:
+            self.store.update_status(gang)
+            self._mark_own()
+        if entered:  # count state TRANSITIONS, not message churn
+            self.metrics.counter(
+                "grove_scheduler_gangs_unschedulable_total",
+                "gangs that entered the Unschedulable state",
+            ).inc()
+            self.recorder.warning(
+                gang, REASON_PODGANG_UNSCHEDULABLE, reason
+            )
+
+    def _stream_band_of(self, key: tuple[str, str]) -> tuple:
+        """(tenant, shed band) of one waiting gang — the streaming
+        front's L3 shed order and per-tenant shed counters. Best-effort
+        without tenancy (every gang sheds in the first band)."""
+        if self.tenancy is not None and self.tenancy.enabled:
+            gang = self.store.kind_bucket(PodGang.KIND).get(key)
+            if gang is not None:
+                tenant = self.tenancy.tenant_of_gang(gang)
+                return tenant, self.tenancy.stream_band(tenant)
+        return None, "best-effort"
+
+    def _shed_stream(self, plan) -> None:
+        """Stamp this round's stream sheds with the structured
+        DeadlineExceeded diagnosis — the identical condition / metric /
+        event path a solver unsat rides, plus a decision-log record so
+        `explain` answers "why was my gang shed" — then ack them back to
+        the front (per-tenant shed counters + the disruption-ledger
+        charge happen there, exactly once per shed)."""
+        import time as _time
+
+        now = self.store.clock.now()
+        acked = []
+        for shed in plan.shed:
+            ns, name = shed.key
+            gang = self.store.get(PodGang.KIND, ns, name)
+            if gang is None or gang.metadata.deletion_timestamp is not None:
+                acked.append(shed.key)
+                continue
+            diag = UnsatDiagnosis(
+                f"stream admission shed: {shed.detail}",
+                code=UnsatCode.DEADLINE,
+                funnel={"stream": {
+                    "detail": shed.detail,
+                    "tenant": shed.tenant,
+                    "band": shed.band,
+                    "brownout_level": plan.brownout_level,
+                }},
+            )
+            self._stamp_unschedulable(gang, diag, UnsatCode.DEADLINE)
+            decisions = getattr(self.cluster, "decisions", None)
+            if decisions is not None:
+                from ..observability.explain import DecisionRecord
+
+                decisions.record(DecisionRecord(
+                    namespace=ns, gang=name, outcome="unplaced",
+                    wall_time=_time.time(),
+                    detail={
+                        "code": UnsatCode.DEADLINE.value,
+                        "message": str(diag),
+                        "funnel": diag.funnel,
+                    },
+                ))
+            acked.append(shed.key)
+        self.stream.ack_shed(acked, now)
 
     def bind_round_batch(self, batch) -> None:
         """Manager wiring hook (ControllerManager.register): install the

@@ -91,6 +91,11 @@ class UnsatCode(str, Enum):
     #: one level up — grove_tpu/federation); the gang never reached any
     #: cluster's control plane
     NO_FEASIBLE_CLUSTER = "NoFeasibleCluster"
+    #: the streaming admission front shed the gang: its projected queue
+    #: wait (or measured queue depth under brownout) exceeded the
+    #: declared SLO budget — overload backpressure, not a capacity or
+    #: feasibility fact about the cluster (grove_tpu/streaming)
+    DEADLINE = "DeadlineExceeded"
 
 
 #: codes for which priority preemption could plausibly free usable
@@ -104,6 +109,9 @@ class UnsatCode(str, Enum):
 #: UNRESOLVED_LEVEL: the gang was cut ABOVE every cluster's control
 #: plane, so no in-cluster eviction pass can run for it — only the
 #: federation router retrying against refreshed aggregates can admit it.
+#: DEADLINE is excluded like QUOTA: a shed is admission-queue overload
+#: backpressure — evicting placed work cannot shorten the admission
+#: queue, and the stream re-admits the gang itself once depth recovers.
 PREEMPTIBLE_CODES = frozenset(
     (
         UnsatCode.CAPACITY,

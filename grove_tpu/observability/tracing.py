@@ -419,6 +419,14 @@ class GangTimeline:
       scheduler.bind   point, attrs: gang="ns/name", created_at, pods=N
                        — parented (transitively) under scheduler.solve
       scheduler.solve  span per backlog solve round
+      scheduler.stream_admit
+                       point, attrs: gang="ns/name", queue_wait —
+                       emitted at micro-batch consume time when the
+                       streaming admission front is on; surfaces as the
+                       per-gang `queue_wait` timeline field (a SEPARATE
+                       annotation, NOT a GANG_PHASES entry: the phase
+                       sum telescopes exactly to running - created, and
+                       the stream wait is already inside `queued`)
       kubelet.pod_start / kubelet.pod_ready
                        points, attrs: namespace, gang, pod="ns/name"
 
@@ -468,6 +476,17 @@ class GangTimeline:
                     prev = binds.get(key)
                     if prev is None or sp.v0 >= prev.v0:
                         binds[key] = sp
+        stream_waits: dict[str, float] = {}
+        for sp in self.spans:
+            if sp.name == "scheduler.stream_admit":
+                key = sp.attrs.get("gang")
+                if key:
+                    # last admit wins, matching the last-bind rule: a
+                    # shed-then-readmitted gang reports the wait of the
+                    # admission that actually led to its bind
+                    stream_waits[key] = float(
+                        sp.attrs.get("queue_wait", 0.0)
+                    )
         starts: dict[str, dict[str, float]] = {}
         readies: dict[str, dict[str, float]] = {}
         for sp in self.spans:
@@ -523,6 +542,10 @@ class GangTimeline:
                     "running": cp[5],
                 },
                 "phases": phases,
+                # streaming admission queue wait (None without the
+                # stream front): an annotation BESIDE the phases — the
+                # GANG_PHASES telescoping-sum contract is untouched
+                "queue_wait": stream_waits.get(key),
                 "bind_latency": cp[3] - cp[0],
                 "startup": cp[5] - cp[3],
                 "total": cp[5] - cp[0],
@@ -546,6 +569,10 @@ class GangTimeline:
                 "sum": round(sum(vals), 9),
                 "max": round(max(vals), 9) if vals else 0.0,
             }
+        waits = [
+            tl["queue_wait"] for tl in complete
+            if tl["queue_wait"] is not None
+        ]
         return {
             "gangs": len(tls),
             "complete": len(complete),
@@ -556,6 +583,10 @@ class GangTimeline:
             "startup_sum": round(
                 sum(tl["startup"] for tl in complete), 9
             ),
+            # streaming admission wait (gangs carrying a stream_admit
+            # point; zero-sum with no stream front)
+            "queue_wait_sum": round(sum(waits), 9),
+            "queue_wait_max": round(max(waits), 9) if waits else 0.0,
         }
 
 

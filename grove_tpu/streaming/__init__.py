@@ -1,0 +1,24 @@
+"""Streaming admission→solve front (docs/scheduling.md "Streaming
+admission"): SLO-aware micro-batches, bounded queues with structured
+DeadlineExceeded shedding, and the brownout ladder — the continuous
+alternative to round-draining the whole backlog."""
+
+from .front import (
+    BAND_SHED_RANK,
+    BROWNOUT_DEFRAG_LEVEL,
+    BROWNOUT_SHED_LEVEL,
+    BROWNOUT_WIDEN_LEVEL,
+    StreamFront,
+    StreamPlan,
+    StreamShed,
+)
+
+__all__ = [
+    "BAND_SHED_RANK",
+    "BROWNOUT_DEFRAG_LEVEL",
+    "BROWNOUT_SHED_LEVEL",
+    "BROWNOUT_WIDEN_LEVEL",
+    "StreamFront",
+    "StreamPlan",
+    "StreamShed",
+]

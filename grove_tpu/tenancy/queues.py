@@ -239,6 +239,17 @@ class TenancyManager:
     def tenant_of_gang(self, gang) -> Optional[str]:
         return self.tenant_of(gang.metadata.namespace, gang.metadata.labels)
 
+    def stream_band(self, tenant: Optional[str]) -> str:
+        """Shed-ordering band for the streaming brownout ladder (L3):
+        "best-effort" (no tenant attribution) sheds first, then "burst"
+        — queues currently demanding above their guaranteed floor
+        (burst_eligible, the same flag the fairness error measures over)
+        — and "guaranteed" work sheds last."""
+        q = self.queues.get(tenant) if tenant is not None else None
+        if q is None:
+            return "best-effort"
+        return "burst" if q.burst_eligible else "guaranteed"
+
     def tier_of_gang(self, gang) -> str:
         """The tier defaulted onto a gang with an empty
         priority_class_name: its tenant's tier, else the config default."""

@@ -199,6 +199,26 @@ DEFRAG_CONFIG = {
 }
 
 
+#: stream config for --stream sweeps: the streaming admission front with
+#: a queue cap SMALLER than one injected burst storm (20 gangs) so a
+#: storm actually crosses the overflow + brownout ladder and sheds with
+#: structured DeadlineExceeded, a small batch size so micro-batch
+#: windows are on the fault path, and a readmit floor low enough that
+#: shed workload gangs re-enter only once the storm drains at disarm
+STREAM_CONFIG = {
+    "stream": {
+        "enabled": True,
+        "slo_seconds": 20.0,
+        "window_min_seconds": 0.25,
+        "window_max_seconds": 2.0,
+        "max_batch_gangs": 4,
+        "queue_cap_gangs": 12,
+        "brownout_depth_fraction": 0.5,
+        "readmit_depth_fraction": 0.25,
+    }
+}
+
+
 #: federation config for --federation sweeps: a 3-member federation with
 #: a SHORT outage window (a seeded cluster_partition of a few 2-second
 #: steps can outlive it, so the healed-zombie fence path is actually on
@@ -227,8 +247,19 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              replication: bool = False,
              serving: bool = False,
              hierarchical: bool = False,
-             defrag: bool = False) -> dict:
+             defrag: bool = False,
+             stream: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
+    if stream:
+        # the streaming-admission fault axis: seeded ~10x burst storms
+        # (the front must shed with structured DeadlineExceeded, never
+        # wedge; the storm load leaves at disarm and shed workload gangs
+        # re-admit) and arrival stalls (budgets burn through the hold —
+        # the stall ends in a batched admit or a deadline shed)
+        overrides.update(
+            burst_storm_rate=0.3,
+            arrival_stall_rate=0.15,
+        )
     if replication:
         # the HA-replication fault axis: standby tailing stalls
         # (semi-sync degrades for the window, must catch up), mid-plan
@@ -308,6 +339,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         config = {**config, **HIERARCHICAL_CONFIG}
     if defrag:
         config = {**config, **DEFRAG_CONFIG}
+    if stream:
+        config = {**config, **STREAM_CONFIG}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
     if wal_tmp is not None:
@@ -331,7 +364,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
             explain_dir, durability, serving, hierarchical, defrag,
-            replication,
+            replication, stream,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -344,7 +377,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
                     explain_dir, durability, serving=False,
                     hierarchical=False, defrag=False,
-                    replication=False) -> dict:
+                    replication=False, stream=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -395,6 +428,28 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
         result["recovery_outcomes"] = [
             s["outcome"] for s in ch.recovery_stats
         ]
+    if stream:
+        front = getattr(ch.harness.scheduler, "stream", None)
+        metrics = ch.harness.cluster.metrics
+        result["stream_queue_depth_at_settle"] = (
+            front.queue_depth() if front is not None else None
+        )
+        result["stream_shed_registry_at_settle"] = (
+            front.shed_registry_size() if front is not None else None
+        )
+        result["stream_sheds"] = metrics.counter(
+            "grove_stream_shed_total", "gangs shed by the streaming front"
+        ).total()
+        if error is None and (
+            front is None or front.queue_depth() != 0
+        ):
+            # a drained settle with waiters still parked is a wedged
+            # queue — exactly what the storm axis exists to catch
+            result["ok"] = False
+            result["error"] = (
+                "stream queue not drained at settle (depth="
+                f"{None if front is None else front.queue_depth()})"
+            )
     if replication:
         result["standby_promotions"] = ch.standby_promotions
         standby = ch.harness.cluster.standby
@@ -716,6 +771,20 @@ def main(argv=None) -> int:
                          "fault (some shed with QuotaExceeded); the "
                          "skew leaves at disarm, so convergence is "
                          "checked against the same fault-free fixpoint")
+    ap.add_argument("--stream", action="store_true",
+                    help="arm the streaming-admission fault axis: the "
+                         "scheduler runs the continuous admission front "
+                         "(SLO deadline budgets, micro-batch windows, "
+                         "backpressure + brownout shedding; "
+                         "grove_tpu/streaming) and the plan adds seeded "
+                         "~10x burst storms (the front must shed with "
+                         "structured DeadlineExceeded, never wedge; the "
+                         "storm load leaves at disarm and shed workload "
+                         "gangs re-admit once the queue drains) and "
+                         "arrival stalls (deadline budgets burn through "
+                         "the hold); convergence is checked against the "
+                         "fault-free fixpoint under the SAME config and "
+                         "the queue must end the run drained")
     ap.add_argument("--federation", action="store_true",
                     help="sweep the FEDERATION fault axis instead of the "
                          "single-cluster matrix: a 3-member federation "
@@ -735,7 +804,7 @@ def main(argv=None) -> int:
     if args.federation and (
         args.durability or args.replication or args.shards > 1
         or args.serving or args.hierarchical or args.defrag
-        or args.tenant_skew
+        or args.tenant_skew or args.stream
     ):
         ap.error("--federation is its own sweep axis (every member "
                  "already runs durable); it does not compose with the "
@@ -797,6 +866,8 @@ def main(argv=None) -> int:
         baseline_config = {**baseline_config, **HIERARCHICAL_CONFIG}
     if args.defrag:
         baseline_config = {**baseline_config, **DEFRAG_CONFIG}
+    if args.stream:
+        baseline_config = {**baseline_config, **STREAM_CONFIG}
     baseline_h = Harness(
         nodes=make_nodes(args.nodes),
         config=baseline_config or None,
@@ -804,6 +875,15 @@ def main(argv=None) -> int:
     baseline_h.apply(sweep_workload(scaled=args.serving,
                                     hierarchical=args.hierarchical))
     baseline_h.settle()
+    if args.stream:
+        # the streaming front parks sub-batch arrivals on window timers
+        # and settle() never advances the clock — drain the windows the
+        # way the chaotic runs' settle_recovered does, so the baseline
+        # fixpoint is the fully-placed one
+        for _ in range(8):
+            baseline_h.advance(
+                STREAM_CONFIG["stream"]["window_max_seconds"]
+            )
     if args.serving:
         # drive the HPA loop to its flat-trace equilibrium: the chaotic
         # runs must converge back to exactly this fleet shape
@@ -824,7 +904,8 @@ def main(argv=None) -> int:
                           replication=args.replication,
                           serving=args.serving,
                           hierarchical=args.hierarchical,
-                          defrag=args.defrag)
+                          defrag=args.defrag,
+                          stream=args.stream)
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
@@ -840,6 +921,7 @@ def main(argv=None) -> int:
         "serving": args.serving,
         "hierarchical": args.hierarchical,
         "defrag": args.defrag,
+        "stream": args.stream,
         "failed_seeds": failed,
         "ok": not failed,
     }

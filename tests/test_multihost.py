@@ -59,11 +59,104 @@ print("RESULT", sig, flush=True)
 """
 
 
+#: the pure-jax capability probe: form the same 2-process Gloo cluster
+#: the real test uses and execute ONE cross-process computation (a jit
+#: over an array sharded across the 4-device global mesh). Some jaxlib
+#: builds form the cluster fine but cannot EXECUTE multi-process
+#: computations on the CPU backend — that is an environment capability
+#: gap, not an engine parity regression, and the probe separates the two.
+_PROBE_WORKER = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1], num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()), ("d",))
+x = jax.device_put(jnp.arange(4.0), NamedSharding(mesh, P("d")))
+print("PROBE", float(jax.jit(jnp.sum)(x)), flush=True)
+"""
+
+_CAPABILITY_GAP = "Multiprocess computations aren't implemented"
+
+#: session cache: None = not probed yet, "" = capable, else skip reason
+_probe_result: str | None = None
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_worker_pair(worker: str, timeout: int) -> tuple[list[int], str]:
+    """Spawn the 2-process CPU Gloo pair running `worker`; returns the
+    return codes and combined output."""
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker,
+             f"127.0.0.1:{port}", str(i), repo],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outputs.append(out)
+    finally:
+        # a worker hung in the Gloo handshake must not orphan the pair
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    return [p.returncode for p in procs], "\n".join(outputs)
+
+
+def _multihost_skip_reason() -> str:
+    """'' when this environment can execute cross-process computations,
+    else the skip reason. Probed once per session. ONLY the known
+    capability gap skips — any other probe failure returns '' so the
+    real test runs and reports the regression loudly."""
+    global _probe_result
+    if _probe_result is None:
+        try:
+            rcs, out = _run_worker_pair(_PROBE_WORKER, timeout=120)
+        except Exception as exc:
+            # a broken probe must not mask an engine regression
+            rcs, out = [0, 0], f"probe error: {exc}"
+        if any(rc != 0 for rc in rcs) and _CAPABILITY_GAP in out:
+            _probe_result = (
+                "this jaxlib's CPU backend cannot execute multi-process "
+                f"computations ({_CAPABILITY_GAP!r}); the 2-process "
+                "parity test needs a build with cross-process CPU "
+                "collectives or a real multi-host TPU slice"
+            )
+        else:
+            _probe_result = ""
+    return _probe_result
+
+
 @pytest.mark.skipif(
     os.environ.get("JAX_PLATFORMS", "cpu") not in ("", "cpu"),
     reason="multi-process Gloo cluster runs on the CPU backend",
 )
 def test_two_process_cluster_reaches_identical_placements():
+    reason = _multihost_skip_reason()
+    if reason:
+        pytest.skip(reason)
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]

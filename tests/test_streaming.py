@@ -1,0 +1,610 @@
+"""The streaming admission→solve front (grove_tpu/streaming): SLO
+deadline budgets, micro-batch windows, backpressure shedding with
+structured DeadlineExceeded, the brownout ladder, and the shed→re-admit
+lifecycle — unit-level on StreamFront, end-to-end through the scheduler,
+and under seeded burst-storm chaos."""
+
+import pytest
+
+from grove_tpu.api.config import (
+    ValidationError,
+    load_operator_config,
+)
+from grove_tpu.api.meta import ObjectMeta, get_condition
+from grove_tpu.api.podgang import PodGang, PodGangConditionType
+from grove_tpu.api.types import (
+    Container,
+    PodCliqueSet,
+    PodCliqueSetSpec,
+    PodCliqueSetTemplateSpec,
+    PodCliqueSpec,
+    PodCliqueTemplateSpec,
+    PodSpec,
+)
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability.explain import (
+    PREEMPTIBLE_CODES,
+    UnsatCode,
+)
+from grove_tpu.streaming import (
+    BROWNOUT_DEFRAG_LEVEL,
+    StreamFront,
+)
+
+SCHEDULED = PodGangConditionType.SCHEDULED.value
+DEADLINE = UnsatCode.DEADLINE.value
+
+
+def front(metrics=None, tenancy=None, **over):
+    defaults = dict(
+        enabled=True,
+        slo_seconds=10.0,
+        window_min_seconds=0.5,
+        window_max_seconds=2.0,
+        max_batch_gangs=4,
+        queue_cap_gangs=16,
+        brownout_depth_fraction=0.5,
+        readmit_depth_fraction=0.25,
+    )
+    defaults.update(over)
+    cfg = load_operator_config({"stream": defaults}).stream
+    return StreamFront(cfg, None, metrics=metrics, tenancy=tenancy)
+
+
+def keys(n, ns="default"):
+    return [(ns, f"g{i:03d}") for i in range(n)]
+
+
+class TestWindow:
+    def test_subbatch_arrivals_defer_until_the_window_closes(self):
+        f = front()
+        ks = keys(2)
+        plan = f.plan_round(ks, now=0.0)
+        assert plan.admitted == []
+        assert plan.deferred == 2
+        assert plan.requeue_after == pytest.approx(0.5)
+        # window elapsed: the oldest waiter has waited it out
+        plan = f.plan_round(ks, now=0.5)
+        assert plan.admitted == ks
+        assert plan.waits == {k: pytest.approx(0.5) for k in ks}
+
+    def test_size_cap_closes_the_window_immediately(self):
+        f = front()
+        ks = keys(6)
+        plan = f.plan_round(ks, now=0.0)
+        # the oldest max_batch admitted, the rest wait with a wake timer
+        assert plan.admitted == ks[:4]
+        assert plan.deferred == 2
+        assert plan.requeue_after is not None
+
+    def test_exhausted_budget_closes_early(self):
+        # SLO nearly burned: remaining budget <= window forces the close
+        # even though the oldest waiter has not waited out the window
+        f = front(slo_seconds=1.0, window_min_seconds=0.9)
+        ks = keys(2)
+        f.plan_round(ks, now=0.0)
+        plan = f.plan_round(ks, now=0.2)
+        assert plan.admitted == ks
+
+    def test_admitted_preserves_caller_key_order(self):
+        f = front()
+        ks = keys(4)
+        f.plan_round(ks, now=0.0)
+        plan = f.plan_round(list(reversed(ks)), now=0.0)
+        assert plan.admitted == list(reversed(ks))
+
+
+class TestDeterminism:
+    def test_plan_round_idempotent_at_one_instant_under_flood(self):
+        # the pre_round speculative plan and the reconcile's
+        # authoritative plan run at the same virtual instant and must
+        # agree on the partition
+        f = front()
+        ks = keys(40)  # way past queue_cap 16: overflow + brownout sheds
+        p1 = f.plan_round(ks, now=1.0)
+        p2 = f.plan_round(ks, now=1.0)
+        assert p1.admitted == p2.admitted
+        assert sorted(s.key for s in p1.shed) == \
+            sorted(s.key for s in p2.shed)
+        assert p1.brownout_level == p2.brownout_level
+        assert p1.window_seconds == p2.window_seconds
+
+    def test_readmit_is_idempotent_at_one_instant(self):
+        f = front()
+        ks = keys(40)
+        plan = f.plan_round(ks, now=0.0)
+        f.ack_shed([s.key for s in plan.shed], now=0.0)
+        # only the shed registry's keys stay in the backlog scan: the
+        # waiters all bound, so the prune drops them and depth recovers
+        shed_keys = sorted(f._shed)
+        p1 = f.plan_round(shed_keys, now=5.0)
+        assert p1.readmitted > 0
+        p2 = f.plan_round(shed_keys, now=5.0)
+        # the first call's bounded re-fill ended the re-admit condition
+        assert p2.readmitted == 0
+        assert p1.admitted == p2.admitted
+
+
+class TestShedding:
+    def test_deadline_exhausted_budget_sheds_with_detail(self):
+        f = front(slo_seconds=2.0)
+        ks = keys(2)
+        f.plan_round(ks, now=0.0)
+        plan = f.plan_round(ks, now=2.5)
+        assert sorted(s.key for s in plan.shed) == sorted(ks)
+        assert all("deadline exceeded" in s.detail for s in plan.shed)
+        assert plan.admitted == []
+
+    def test_overflow_sheds_the_newest_arrivals(self):
+        f = front()
+        old = [("default", "old")]
+        f.plan_round(old, now=0.0)
+        flood = old + keys(20)
+        plan = f.plan_round(flood, now=0.1)
+        shed_keys = {s.key for s in plan.shed}
+        assert old[0] not in shed_keys  # the oldest keeps its place
+        assert any("queue overflow" in s.detail for s in plan.shed)
+
+    def test_projected_wait_beyond_slo_sheds(self):
+        # 12 waiting / batch 4: positions 8+ sit 2 full windows out;
+        # with a 1s SLO and 0.9s windows that breaks their budget
+        f = front(slo_seconds=1.0, window_min_seconds=0.9,
+                  window_max_seconds=0.9, max_batch_gangs=4,
+                  queue_cap_gangs=16, brownout_depth_fraction=0.99)
+        plan = f.plan_round(keys(12), now=0.0)
+        projected = [s for s in plan.shed
+                     if "projected wait beyond SLO" in s.detail]
+        assert len(projected) == 4
+
+    def test_unacked_sheds_rereported_until_acked(self):
+        f = front(slo_seconds=1.0)
+        ks = keys(2)
+        f.plan_round(ks, now=0.0)
+        p1 = f.plan_round(ks, now=2.0)
+        assert len(p1.shed) == 2
+        p2 = f.plan_round(ks, now=2.0)
+        assert sorted(s.key for s in p2.shed) == sorted(ks)
+        f.ack_shed(ks, now=2.0)
+        p3 = f.plan_round(ks, now=2.0)
+        assert p3.shed == []
+
+
+class TestBrownout:
+    def test_ladder_levels_follow_measured_depth(self):
+        f = front(queue_cap_gangs=12, brownout_depth_fraction=0.5,
+                  max_batch_gangs=2, slo_seconds=100.0)
+        f.plan_round(keys(3), now=0.0)  # 3/12 = 0.25 < 0.5
+        assert f.brownout_level == 0
+        plan = f.plan_round(keys(7), now=0.0)  # 7/12 ~ 0.58 -> L1
+        assert f.brownout_level == 1
+        assert plan.window_seconds == pytest.approx(2.0)  # widened
+        f2 = front(queue_cap_gangs=12, brownout_depth_fraction=0.5,
+                   max_batch_gangs=2, slo_seconds=100.0)
+        f2.plan_round(keys(9), now=0.0)  # 9/12 = 0.75 -> L2
+        assert f2.brownout_level == BROWNOUT_DEFRAG_LEVEL
+        assert f2.defrag_suspended
+
+    def test_l3_sheds_band_ordered_cheapest_first(self):
+        bands = {}
+        for i, key in enumerate(keys(16)):
+            bands[key] = (f"t{i}", ["guaranteed", "burst",
+                                    "best-effort"][i % 3])
+
+        f = front(queue_cap_gangs=16, brownout_depth_fraction=0.5,
+                  max_batch_gangs=2, slo_seconds=100.0,
+                  window_min_seconds=0.5, window_max_seconds=0.5)
+        plan = f.plan_round(keys(16), now=0.0,
+                            band_of=lambda k: bands[k])
+        brownout = [s for s in plan.shed if "brownout shed" in s.detail]
+        assert brownout, "a full queue must reach the L3 rung"
+        # guaranteed-band work only sheds after every cheaper band did
+        shed_bands = [s.band for s in brownout]
+        assert "guaranteed" not in shed_bands
+        assert set(shed_bands) <= {"best-effort", "burst"}
+        survivors_bands = [bands[k][1] for k in f._waiting]
+        assert "guaranteed" in survivors_bands
+
+    def test_defrag_suspension_is_read_by_the_harness(self):
+        h = Harness(
+            nodes=make_nodes(8),
+            config={
+                "defrag": {"enabled": True,
+                           "sync_interval_seconds": 1.0},
+                "stream": {"enabled": True},
+            },
+        )
+        h.clock.advance(100.0)  # cadence long elapsed
+        h.scheduler.stream.brownout_level = BROWNOUT_DEFRAG_LEVEL
+        assert h.maybe_defrag() is False  # L2: sweeps held
+        h.scheduler.stream.brownout_level = 0
+        assert h.maybe_defrag() is True  # only the brownout blocked it
+
+
+class TestReadmission:
+    def test_shed_readmit_lifecycle_with_fresh_deadline(self):
+        f = front(slo_seconds=1.0, queue_cap_gangs=8)
+        ks = keys(2)
+        f.plan_round(ks, now=0.0)
+        plan = f.plan_round(ks, now=2.0)  # both shed on deadline
+        assert len(plan.shed) == 2
+        # not re-admitted before the stamp is acked: a shed must become
+        # visible before it can be silently retracted
+        p = f.plan_round(ks, now=3.0)
+        assert p.readmitted == 0
+        f.ack_shed(ks, now=3.0)
+        p = f.plan_round(ks, now=4.0)
+        assert p.readmitted == 2
+        # fresh budget: arrival re-anchored at re-admission time
+        assert all(f._waiting[k] == 4.0 for k in ks)
+
+    def test_readmit_waits_for_depth_to_recover(self):
+        f = front(queue_cap_gangs=8, readmit_depth_fraction=0.25,
+                  max_batch_gangs=2)
+        busy = keys(4, ns="busy")
+        f.plan_round(busy, now=0.0)  # 4 live waiters
+        # seed an ACKED shed registry behind them (the lifecycle that
+        # builds this organically is covered end-to-end below; this
+        # isolates the depth gate)
+        shed_ks = keys(2, ns="shed")
+        f._shed.update({k: 0.0 for k in shed_ks})
+        # depth 4/8 is above the 0.25 re-admit floor: registry holds
+        p = f.plan_round(busy + shed_ks, now=0.1)
+        assert p.readmitted == 0
+        # three waiters bound -> depth 1/8 recovered below the floor
+        p = f.plan_round(busy[:1] + shed_ks, now=0.2)
+        assert p.readmitted == 2
+
+    def test_idle_front_with_shed_registry_keeps_a_wake_timer(self):
+        f = front(slo_seconds=1.0)
+        ks = keys(2)
+        f.plan_round(ks, now=0.0)
+        plan = f.plan_round(ks, now=2.0)  # everything waiting shed
+        assert plan.admitted == []
+        # the scheduler must wake to re-admit without any store event
+        assert plan.requeue_after is not None
+
+
+class TestStall:
+    def test_stall_defers_admission_but_deadline_sheds_still_run(self):
+        f = front(slo_seconds=2.0)
+        ks = keys(4)
+        f.plan_round(ks, now=0.0)
+        f.stall(until=10.0)
+        plan = f.plan_round(ks, now=1.0)
+        assert plan.admitted == []
+        assert plan.requeue_after is not None
+        # budgets keep burning through the stall: a stall sheds, it
+        # does not wedge
+        plan = f.plan_round(ks, now=3.0)
+        assert sorted(s.key for s in plan.shed) == sorted(ks)
+        f.clear_stall()
+        assert f.debug_state()["stalled_until"] is None
+
+
+class TestConfig:
+    def test_stream_validation_names_every_error(self):
+        with pytest.raises(ValidationError) as err:
+            load_operator_config({"stream": {
+                "enabled": True,
+                "slo_seconds": 0.1,
+                "window_min_seconds": 0.5,
+                "window_max_seconds": 0.25,
+                "max_batch_gangs": 0,
+                "queue_cap_gangs": -1,
+                "brownout_depth_fraction": 0.2,
+                "readmit_depth_fraction": 0.8,
+            }})
+        text = str(err.value)
+        assert "stream.window_max_seconds" in text
+        assert "stream.slo_seconds" in text
+        assert "stream.max_batch_gangs" in text
+        assert "stream.queue_cap_gangs" in text
+        assert "stream.readmit_depth_fraction" in text
+
+    def test_defaults_validate_clean(self):
+        cfg = load_operator_config({"stream": {"enabled": True}})
+        assert cfg.stream.enabled is True
+
+    def test_deadline_code_is_not_preemptible(self):
+        # a shed is admission-queue overload backpressure — evicting
+        # placed work cannot shorten the admission queue
+        assert UnsatCode.DEADLINE not in PREEMPTIBLE_CODES
+        assert DEADLINE == "DeadlineExceeded"
+
+
+# -- end-to-end through the scheduler ------------------------------------
+
+
+def pcs(name, ns="default", pods=2, cpu=1.0):
+    return PodCliqueSet(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodCliqueSetSpec(
+            replicas=1,
+            template=PodCliqueSetTemplateSpec(
+                cliques=[
+                    PodCliqueTemplateSpec(
+                        name="w",
+                        spec=PodCliqueSpec(
+                            replicas=pods,
+                            pod_spec=PodSpec(
+                                containers=[Container(
+                                    name="m",
+                                    resources={"cpu": cpu},
+                                )]
+                            ),
+                        ),
+                    )
+                ]
+            ),
+        ),
+    )
+
+
+STREAM = {
+    "enabled": True,
+    "slo_seconds": 10.0,
+    "window_min_seconds": 0.5,
+    "window_max_seconds": 2.0,
+    "max_batch_gangs": 4,
+    "queue_cap_gangs": 10,
+    "brownout_depth_fraction": 0.5,
+    "readmit_depth_fraction": 0.25,
+}
+
+
+def stream_harness(nodes=24, stream=None, **extra_cfg):
+    return Harness(
+        nodes=make_nodes(nodes),
+        config={"stream": stream or STREAM, **extra_cfg},
+    )
+
+
+def scheduled_of(h, ns, gang_name):
+    gang = h.store.get(PodGang.KIND, ns, gang_name)
+    if gang is None:
+        return None
+    return get_condition(gang.status.conditions, SCHEDULED)
+
+
+def drive_until_sheds(h, rounds=6):
+    """Manager passes at ONE virtual instant until the front sheds —
+    settle() would run the whole shed->readmit->bind lifecycle to
+    completion before we could observe the stamps."""
+    sheds = h.cluster.metrics.counter("grove_stream_shed_total")
+    for _ in range(rounds):
+        h.manager.run_once()
+        if sheds.total() > 0:
+            return
+    raise AssertionError("flood never shed")
+
+
+class TestSchedulerIntegration:
+    def test_gang_binds_through_the_window_with_queue_wait_traced(self):
+        h = stream_harness(tracing={"enabled": True})
+        h.apply(pcs("solo"))
+        h.settle()
+        # sub-batch arrival at one instant: parked on the window timer
+        assert scheduled_of(h, "default", "solo-0") is None
+        h.advance(1.0)
+        cond = scheduled_of(h, "default", "solo-0")
+        assert cond is not None and cond.status == "True"
+        from grove_tpu.observability.tracing import GangTimeline
+
+        tls = GangTimeline(h.cluster.tracer.finished).timelines()
+        tl = tls["default/solo-0"]
+        # the stream_admit point surfaces the measured queue wait
+        assert tl["queue_wait"] is not None
+        assert tl["queue_wait"] > 0.0
+
+    def test_flood_sheds_structured_and_fully_recovers(self):
+        h = stream_harness()
+        n = 30  # 3x the queue cap, arriving at one instant
+        for i in range(n):
+            h.apply(pcs(f"burst-{i:02d}"))
+        drive_until_sheds(h)
+        m = h.cluster.metrics
+        sheds = m.counter("grove_stream_shed_total")
+        assert sheds.total() > 0
+        # shed gangs carry the structured condition while shed
+        conds = [scheduled_of(h, "default", f"burst-{i:02d}-0")
+                 for i in range(n)]
+        stamped = [c for c in conds if c is not None
+                   and c.status == "False" and c.reason == DEADLINE]
+        assert stamped, "sheds must stamp DeadlineExceeded"
+        assert any("stream admission shed" in c.message for c in stamped)
+        # the unplaced counter rode the same structured reason
+        unplaced = m.counter("grove_scheduler_unplaced_total")
+        assert unplaced.value(reason=DEADLINE) > 0
+        # explain answers "why was my gang shed" with the stream funnel
+        explained = []
+        for i in range(n):
+            got = h.cluster.decisions.explain(
+                "default", f"burst-{i:02d}-0"
+            )
+            if got is None:
+                continue
+            for rec in got["records"]:
+                detail = rec.get("detail") or {}
+                if detail.get("code") == DEADLINE:
+                    explained.append(detail)
+        assert explained
+        funnel = explained[0]["funnel"]["stream"]
+        assert funnel["detail"]
+        assert funnel["band"] == "best-effort"  # no tenancy configured
+        # per-band shed counter pinned (no tenancy: no tenant label)
+        assert sheds.value(tenant="", band="best-effort") == \
+            sheds.total()
+        # recovery: drain windows + re-admissions; EVERY gang binds
+        h.settle()
+        for _ in range(40):
+            h.advance(1.0)
+        conds = [scheduled_of(h, "default", f"burst-{i:02d}-0")
+                 for i in range(n)]
+        assert all(c is not None and c.status == "True" for c in conds)
+        front = h.scheduler.stream
+        assert front.queue_depth() == 0
+        assert front.shed_registry_size() == 0
+        # the lifecycle actually cycled through re-admission
+        assert m.counter("grove_stream_readmitted_total").total() > 0
+
+    def test_tenant_attribution_rides_the_shed_counters(self):
+        # tenant resolution falls back to namespace == tenant name
+        h = stream_harness(
+            stream={**STREAM, "queue_cap_gangs": 6},
+            tenancy={
+                "enabled": True,
+                "tenants": [
+                    {"name": "gold", "guaranteed": {"cpu": 500.0},
+                     "burst": {"cpu": 600.0}},
+                    {"name": "spot", "guaranteed": {"cpu": 500.0},
+                     "burst": {"cpu": 600.0}},
+                ],
+            },
+        )
+        for i in range(6):
+            h.apply(pcs(f"g-{i}", ns="gold"))
+        for i in range(6):
+            h.apply(pcs(f"s-{i}", ns="spot"))
+        drive_until_sheds(h)
+        sheds = h.cluster.metrics.counter("grove_stream_shed_total")
+        tenants = {ls.get("tenant") for ls in sheds.label_sets()}
+        # overflow cuts the newest keys ((ns, name) order puts spot
+        # last), so the shed counters carry real tenant attribution
+        assert "spot" in tenants
+        # every gang still binds once the storm drains
+        h.settle()
+        for _ in range(40):
+            h.advance(1.0)
+        for ns, prefix in (("gold", "g"), ("spot", "s")):
+            for i in range(6):
+                c = scheduled_of(h, ns, f"{prefix}-{i}-0")
+                assert c is not None and c.status == "True", (ns, i)
+
+    def test_manager_restart_rebuilds_the_front_conservatively(self):
+        h = stream_harness()
+        for i in range(3):
+            h.apply(pcs(f"r-{i}"))
+        h.settle()
+        old_front = h.scheduler.stream
+        h._build_manager()  # the chaos crash-restart path
+        front = h.scheduler.stream
+        assert front is not old_front  # soft state: rebuilt, not copied
+        assert front.queue_depth() == 0
+        for _ in range(8):
+            h.advance(1.0)
+        for i in range(3):
+            c = scheduled_of(h, "default", f"r-{i}-0")
+            assert c is not None and c.status == "True"
+
+
+# -- chaos: burst storms and arrival stalls ------------------------------
+
+
+QUIET = dict(
+    write_fault_rate=0.0, conflict_burst_rate=0.0, stale_read_rate=0.0,
+    event_delay_rate=0.0, manager_crash_rate=0.0,
+    midflight_crash_rate=0.0, kubelet_stall_rate=0.0,
+    clock_jump_rate=0.0, compaction_rate=0.0, node_flap_rate=0.0,
+    heartbeat_loss_rate=0.0, domain_outage_rate=0.0,
+    drain_storm_rate=0.0,
+)
+
+
+def chaos_workload():
+    return pcs("base", pods=4)
+
+
+def baseline_fingerprint(config):
+    """The fault-free fixpoint a chaotic streaming run must converge
+    back to (storm workloads are deleted on disarm, so the base
+    workload alone defines it)."""
+    from grove_tpu.chaos import settled_fingerprint
+
+    h = Harness(nodes=make_nodes(24), config=config)
+    h.apply(chaos_workload())
+    h.settle()
+    for _ in range(8):
+        h.advance(2.0)
+    return settled_fingerprint(h.store)
+
+
+@pytest.mark.chaos
+class TestChaos:
+    def test_burst_storm_sheds_and_converges_to_fault_free_fixpoint(self):
+        from grove_tpu.chaos import (
+            ChaosHarness,
+            FaultPlan,
+            check_invariants,
+            settled_fingerprint,
+        )
+
+        config = {"stream": {**STREAM, "queue_cap_gangs": 12}}
+        plan = FaultPlan(seed=7, chaos_steps=6, burst_storm_rate=1.0,
+                         **QUIET)
+        ch = ChaosHarness(plan, nodes=make_nodes(24), config=config)
+        ch.apply(chaos_workload())
+        ch.run_chaos()
+        assert plan.counts.get("burst_storm", 0) >= 1
+        m = ch.harness.cluster.metrics
+        # the storm SHED (structured backpressure), it did not wedge
+        assert m.counter("grove_stream_shed_total").total() > 0
+        front = ch.harness.scheduler.stream
+        assert front.queue_depth() == 0
+        assert front.shed_registry_size() == 0
+        assert check_invariants(ch.raw_store) == []
+        assert settled_fingerprint(ch.raw_store) == \
+            baseline_fingerprint(config)
+
+    def test_arrival_stall_resolves_without_wedging(self):
+        from grove_tpu.chaos import (
+            ChaosHarness,
+            FaultPlan,
+            check_invariants,
+            settled_fingerprint,
+        )
+
+        config = {"stream": dict(STREAM)}
+        plan = FaultPlan(seed=11, chaos_steps=8,
+                         arrival_stall_rate=0.6, **QUIET)
+        ch = ChaosHarness(plan, nodes=make_nodes(24), config=config)
+        ch.apply(chaos_workload())
+        ch.run_chaos()
+        assert plan.counts.get("arrival_stall", 0) >= 1
+        front = ch.harness.scheduler.stream
+        assert front.debug_state()["stalled_until"] is None  # cleared
+        assert front.queue_depth() == 0
+        assert check_invariants(ch.raw_store) == []
+        assert settled_fingerprint(ch.raw_store) == \
+            baseline_fingerprint(config)
+
+    def test_storm_rates_are_capability_guarded_without_stream(self):
+        # rates ARMED but no stream configured: the capability guard
+        # must return before ANY draw, leaving the seed's draw sequence
+        # — and the converged state — bit-identical to the rate-0 plan
+        from grove_tpu.chaos import (
+            ChaosHarness,
+            FaultPlan,
+            settled_fingerprint,
+        )
+
+        outcomes = []
+        for rates in ({}, {"burst_storm_rate": 0.9,
+                           "arrival_stall_rate": 0.9}):
+            plan = FaultPlan(seed=13, chaos_steps=8, **rates)
+            ch = ChaosHarness(plan, nodes=make_nodes(24))
+            ch.apply(chaos_workload())
+            ch.run_chaos()
+            assert "burst_storm" not in plan.counts
+            assert "arrival_stall" not in plan.counts
+            outcomes.append(
+                (dict(plan.counts), settled_fingerprint(ch.raw_store))
+            )
+        assert outcomes[0] == outcomes[1]
+
+    def test_new_fault_rates_default_zero_and_stay_out_of_the_mix(self):
+        from grove_tpu.chaos import FaultPlan
+
+        plan = FaultPlan.from_seed(5)
+        assert plan.burst_storm_rate == 0.0
+        assert plan.arrival_stall_rate == 0.0
