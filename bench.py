@@ -4323,6 +4323,33 @@ def bench_stream(args) -> int:
 
     stream_max, round_max = max_rate("stream"), max_rate("round")
     top = rungs[-1]
+    # the bench verdicts ride the SLO scorecard schema (one verdict
+    # vocabulary across bench, chaos, and the live engine — ROADMAP
+    # item 3): each contract is a static_entry whose breach/ok verdict
+    # IS the exit-code decision below
+    from grove_tpu.observability.slo import (
+        VERDICT_BREACH, compose_scorecard, static_entry,
+    )
+    base_p99 = rungs[0]["stream"]["p99_bind_seconds"]
+    card = compose_scorecard([
+        static_entry(
+            "stream-base-p99", "bind_latency_p99", base_p99,
+            threshold=slo, unit="seconds",
+            offered_gangs_per_sec=rates[0],
+        ),
+        static_entry(
+            "stream-max-rate", "sustained_rate", stream_max,
+            threshold=round_max, unit="gangs/sec", higher_is_better=True,
+            round_max_gangs_per_sec=round_max,
+        ),
+        static_entry(
+            "stream-sheds", "shed_count",
+            float(top["stream"].get("front_sheds", 0)),
+            unit="gangs", readmitted=top["stream"].get(
+                "front_readmitted", 0
+            ),
+        ),
+    ])
     out = {
         "metric": f"streaming admission max sustained rate at p99 <= "
         f"{slo:g}s SLO ({num_nodes} nodes, Poisson + 10x bursts)",
@@ -4337,27 +4364,25 @@ def bench_stream(args) -> int:
         "rungs": rungs,
         "top_rung_stream_p99": top["stream"]["p99_bind_seconds"],
         "top_rung_round_p99": top["round"]["p99_bind_seconds"],
+        "scorecard": card,
         "backend": __import__("jax").default_backend(),
         "engine": "single",
     }
     print(json.dumps(out))
-    ok = True
-    if rungs[0]["stream"]["p99_bind_seconds"] > slo:
-        ok = False
+    by_name = {e["slo"]: e for e in card["slos"]}
+    if by_name["stream-base-p99"]["verdict"] == VERDICT_BREACH:
         print(
-            f"STREAM BENCH FAILURE: p99 "
-            f"{rungs[0]['stream']['p99_bind_seconds']}s > SLO {slo}s at "
+            f"STREAM BENCH FAILURE: p99 {base_p99}s > SLO {slo}s at "
             f"the base rate {rates[0]:g} gangs/s",
             file=sys.stderr,
         )
-    if stream_max < round_max:
-        ok = False
+    if by_name["stream-max-rate"]["verdict"] == VERDICT_BREACH:
         print(
             f"STREAM BENCH FAILURE: stream sustains {stream_max:g} "
             f"gangs/s at SLO but round-draining sustains {round_max:g}",
             file=sys.stderr,
         )
-    return 0 if ok else 1
+    return 0 if card["verdict"] != VERDICT_BREACH else 1
 
 
 if __name__ == "__main__":

@@ -348,6 +348,69 @@ class StreamConfig:
 
 
 @dataclass
+class SLOConfig:
+    """Continuous SLO evaluation (observability/slo.py): a windowed
+    sampler over the metrics registry plus multi-window multi-burn-rate
+    alerting, swept on the autoscaler/defrag cadence by
+    `Harness.maybe_slo_sweep`. Two window pairs per objective: the
+    "page" pair (short fast windows, high burn threshold) catches a 10x
+    burst in seconds; the "ticket" pair (long windows, low threshold)
+    catches a slow leak before the error budget exhausts. An alert
+    trips when BOTH windows of a pair burn over the pair's threshold,
+    and resolves once the short window recovers.
+
+      enabled                  off by default — evaluation-only, but the
+                               sweep cadence and alert Events are a
+                               deliberate opt-in
+      sync_interval_seconds    sweep cadence on the virtual clock
+                               (Harness.maybe_slo_sweep early-returns
+                               inside it, like maybe_autoscale)
+      budget_window_seconds    the error-budget accounting window; must
+                               cover the longest alert window
+      max_samples_per_series   bound on every per-series sample ring
+                               (virtual-time keyed; oldest evicted)
+      pending_for_seconds      a tripped alert sits `pending` this long
+                               before `firing` (0 still requires one
+                               confirming sweep)
+      page_short_seconds       page pair: short window
+      page_long_seconds        page pair: long window
+      page_burn_threshold      page pair: burn-rate trip point (14.4 =
+                               2% of a 30-day budget in one hour,
+                               scaled to whatever budget window)
+      ticket_short_seconds     ticket pair: short window
+      ticket_long_seconds      ticket pair: long window
+      ticket_burn_threshold    ticket pair: burn-rate trip point
+      history_limit            bounded alert-transition history kept
+                               for the scorecard
+      objectives               declarative SLO objects; empty means the
+                               built-in defaults (per-tenant bind p99,
+                               starvation, shed rate, placement drift,
+                               failover wall). Each entry is a mapping
+                               with `name`, `kind`, `target` in (0,1),
+                               plus the kind's parameter:
+                               bind_latency_p99→threshold_seconds
+                               (+per_tenant), starvation→
+                               max_starved_seconds, shed_rate→
+                               ceiling_per_second, placement_drift→
+                               band, failover_wall→max_failovers
+    """
+
+    enabled: bool = False
+    sync_interval_seconds: float = 15.0
+    budget_window_seconds: float = 3600.0
+    max_samples_per_series: int = 512
+    pending_for_seconds: float = 0.0
+    page_short_seconds: float = 60.0
+    page_long_seconds: float = 300.0
+    page_burn_threshold: float = 14.4
+    ticket_short_seconds: float = 300.0
+    ticket_long_seconds: float = 1800.0
+    ticket_burn_threshold: float = 3.0
+    history_limit: int = 256
+    objectives: list[dict] = field(default_factory=list)
+
+
+@dataclass
 class AutoscalerConfig:
     """k8s HPA controller knobs (controller/autoscaler.py).
 
@@ -624,6 +687,7 @@ class OperatorConfig:
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     defrag: DefragConfig = field(default_factory=DefragConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     authorization: AuthorizationConfig = field(default_factory=AuthorizationConfig)
@@ -673,6 +737,7 @@ _TYPES = {
     "TenancyConfig": TenancyConfig,
     "DefragConfig": DefragConfig,
     "StreamConfig": StreamConfig,
+    "SLOConfig": SLOConfig,
     "AutoscalerConfig": AutoscalerConfig,
     "ServingConfig": ServingConfig,
     "AuthorizationConfig": AuthorizationConfig,
@@ -846,6 +911,7 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     errs += _validate_tenancy(cfg.tenancy)
     errs += _validate_defrag(cfg.defrag)
     errs += _validate_stream(cfg.stream)
+    errs += _validate_slo(cfg.slo)
 
     le = cfg.leader_election
     if not isinstance(le.enabled, bool):
@@ -1341,6 +1407,103 @@ def _validate_stream(st: StreamConfig) -> list[str]:
             "config.stream.readmit_depth_fraction: must be < "
             "brownout_depth_fraction (shed/re-admit hysteresis)"
         )
+    return errs
+
+
+#: the objective kinds observability/slo.py can evaluate, each with its
+#: required threshold parameter (validated here so a typo'd objective
+#: fails at config load, not mid-sweep)
+_SLO_OBJECTIVE_KINDS = {
+    "bind_latency_p99": "threshold_seconds",
+    "starvation": "max_starved_seconds",
+    "shed_rate": "ceiling_per_second",
+    "placement_drift": "band",
+    "failover_wall": "max_failovers",
+}
+
+
+def _validate_slo(sl: SLOConfig) -> list[str]:
+    """Aggregated semantic validation of the SLO-evaluation block."""
+    errs: list[str] = []
+    if not isinstance(sl.enabled, bool):
+        errs.append("config.slo.enabled: must be a bool")
+    for f in (
+        "sync_interval_seconds",
+        "budget_window_seconds",
+        "page_short_seconds",
+        "page_long_seconds",
+        "page_burn_threshold",
+        "ticket_short_seconds",
+        "ticket_long_seconds",
+        "ticket_burn_threshold",
+    ):
+        v = getattr(sl, f)
+        if not _num(v) or v <= 0:
+            errs.append(f"config.slo.{f}: must be > 0")
+    for short_f, long_f in (
+        ("page_short_seconds", "page_long_seconds"),
+        ("ticket_short_seconds", "ticket_long_seconds"),
+    ):
+        short, long_ = getattr(sl, short_f), getattr(sl, long_f)
+        if _num(short) and _num(long_) and 0 < long_ < short:
+            # the short window exists to confirm/resolve fast; a pair
+            # with long < short inverts both roles
+            errs.append(f"config.slo.{long_f}: must be >= {short_f}")
+    if (
+        _num(sl.budget_window_seconds)
+        and _num(sl.ticket_long_seconds)
+        and 0 < sl.budget_window_seconds < sl.ticket_long_seconds
+    ):
+        errs.append(
+            "config.slo.budget_window_seconds: must be >= "
+            "ticket_long_seconds (budget accounting must cover the "
+            "slowest alert window)"
+        )
+    if not _num(sl.pending_for_seconds) or sl.pending_for_seconds < 0:
+        errs.append("config.slo.pending_for_seconds: must be >= 0")
+    for f in ("max_samples_per_series", "history_limit"):
+        v = getattr(sl, f)
+        if not _int(v) or v < 1:
+            errs.append(f"config.slo.{f}: must be an int >= 1")
+    if not isinstance(sl.objectives, list):
+        errs.append("config.slo.objectives: must be a list of mappings")
+        return errs
+    seen: set[str] = set()
+    for i, obj in enumerate(sl.objectives):
+        path = f"config.slo.objectives[{i}]"
+        if not isinstance(obj, dict):
+            errs.append(f"{path}: expected mapping, got {type(obj).__name__}")
+            continue
+        name = obj.get("name")
+        if not isinstance(name, str) or not name:
+            errs.append(f"{path}.name: must be a non-empty string")
+        elif name in seen:
+            errs.append(f"{path}.name: duplicate objective {name!r}")
+        else:
+            seen.add(name)
+        kind = obj.get("kind")
+        if kind not in _SLO_OBJECTIVE_KINDS:
+            errs.append(
+                f"{path}.kind: unknown kind {kind!r} (want one of "
+                f"{sorted(_SLO_OBJECTIVE_KINDS)})"
+            )
+            continue
+        target = obj.get("target", 0.99)
+        if not _num(target) or not (0 < target < 1):
+            errs.append(f"{path}.target: must be in (0, 1)")
+        param = _SLO_OBJECTIVE_KINDS[kind]
+        if param in obj:
+            v = obj[param]
+            if kind == "failover_wall":
+                if not _int(v) or v < 0:
+                    errs.append(f"{path}.{param}: must be an int >= 0")
+            elif not _num(v) or v <= 0:
+                errs.append(f"{path}.{param}: must be > 0")
+        known = {"name", "kind", "target", "per_tenant", param}
+        for key in sorted(set(obj) - known):
+            errs.append(f"{path}.{key}: unknown field")
+        if "per_tenant" in obj and not isinstance(obj["per_tenant"], bool):
+            errs.append(f"{path}.per_tenant: must be a bool")
     return errs
 
 

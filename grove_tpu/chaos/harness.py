@@ -615,6 +615,26 @@ class ChaosHarness:
         except ManagerCrash:
             self.restart_manager()
 
+    # -- SLO evaluation through the storm --------------------------------------
+    @property
+    def _slo(self):
+        """The cluster's SLOEngine when config.slo.enabled, else None
+        (the sweep cadence is skipped entirely — capability-guarded
+        like defrag, so pre-existing seeds replay identically)."""
+        return getattr(self.harness.cluster, "slo", None)
+
+    def _chaos_slo(self) -> None:
+        """The SLO evaluation loop keeps its cadence through the storm
+        (slo-enabled runs only): this is where burst_storm/tenant_skew/
+        promote_standby faults must drive alerts pending->firing. The
+        sweep's only store writes are advisory Events, routed through
+        the RAW store so evaluation consumes ZERO fault-plan draws —
+        a seed replays bit-identically with SLO evaluation on or off."""
+        try:
+            self.harness.maybe_slo_sweep(store=self.raw_store)
+        except ManagerCrash:  # defensive parity with the other sweeps
+            self.restart_manager()
+
     def _drain_serving(self) -> None:
         """Post-disarm serving drain: let every stabilization-window
         entry from the spike era expire, then sweep on the sync cadence
@@ -990,6 +1010,11 @@ class ChaosHarness:
                     # the defrag sync loop likewise keeps its cadence
                     # through the storm (no-op without defrag)
                     self._chaos_defrag()
+                if self._slo is not None:
+                    # SLO evaluation likewise sweeps through the storm —
+                    # alerts must FIRE during the fault, not at the
+                    # postmortem (no-op without config.slo)
+                    self._chaos_slo()
                 self._tick_node_faults()
                 if self._durable is not None:
                     self._durable.tick_stall()
@@ -1155,6 +1180,11 @@ class ChaosHarness:
             # a recovery happened
             "recoveries": list(self.recovery_stats),
             "faults_injected": dict(sorted(self.plan.counts.items())),
+            # the SLO scorecard rides every wedged postmortem when the
+            # engine is on: which budgets the fault burned and which
+            # alerts were live when the run wedged
+            **({"slo_scorecard": self.harness.slo_scorecard()}
+               if self._slo is not None else {}),
         }
 
     def dump_flight(self, path: str | None = None) -> dict[str, Any]:

@@ -143,6 +143,20 @@ class Cluster:
         from ..tenancy import TenancyManager
 
         self.tenancy = TenancyManager(self.config.tenancy, metrics=self.metrics)
+        # Continuous SLO evaluation (observability/slo.py): cluster-owned
+        # soft state like the DecisionLog — sample rings, alert states
+        # and history survive manager rebuilds, cold_restart() and
+        # promote_standby(); a genuinely new process re-warms from its
+        # first sweep. Gated on config (an absent engine means
+        # Harness.maybe_slo_sweep and the chaos hook are no-ops, which
+        # is also what keeps pre-existing chaos seeds bit-identical).
+        self.slo = None
+        if self.config.slo.enabled:
+            from ..observability.slo import SLOEngine
+
+            self.slo = SLOEngine(
+                self.config.slo, metrics=self.metrics, clock=self.clock
+            )
         self.logger = Logger(
             level=self.config.log.level, format=self.config.log.format
         )

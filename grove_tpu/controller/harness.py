@@ -420,6 +420,56 @@ class Harness:
             self.settle()
         return True
 
+    def slo_sweep(self, store=None):
+        """One SLO evaluation sweep, no settle (evaluation-only: the
+        only store writes are advisory alert Events). Runs as the
+        operator identity and, under HA, only on the leader. `store`
+        lets the chaos driver route Events through the raw store so
+        sweeps consume zero fault-plan draws (seed replay stays
+        bit-identical with SLO evaluation on or off). Returns the sweep
+        stats dict, or None when disabled or standing by."""
+        engine = getattr(self.cluster, "slo", None)
+        if engine is None:
+            return None
+        if self.elector is not None:
+            with self.store.impersonate(
+                self.manager.identity or self.store.actor
+            ):
+                if not self.elector.try_acquire():
+                    return None  # standing by: the leader sweeps
+        with self.store.impersonate(
+            self.manager.identity or self.store.actor
+        ):
+            return engine.sweep(
+                store if store is not None else self.store,
+                tenancy=self.cluster.tenancy,
+            )
+
+    def maybe_slo_sweep(self, store=None) -> bool:
+        """The periodic SLO sync (the maybe_autoscale/maybe_defrag
+        cadence shape): sweep when at least `slo.sync_interval_seconds`
+        of virtual time passed since the last one. Long-run drivers
+        (bench, the chaos loop) call this every step so the cadence is
+        governed by the validated config, not the driver's step size."""
+        engine = getattr(self.cluster, "slo", None)
+        if engine is None:
+            return False
+        if (
+            self.clock.now() - engine.last_sync
+            < self.config.slo.sync_interval_seconds
+        ):
+            return False
+        return self.slo_sweep(store=store) is not None
+
+    def slo_scorecard(self) -> dict:
+        """The per-tenant SLO scorecard JSON (ROADMAP item 3's artifact;
+        also surfaced via debug_dump()["slo"], the gRPC Debug service,
+        and chaos wedged postmortems)."""
+        engine = getattr(self.cluster, "slo", None)
+        if engine is None:
+            return {"enabled": False}
+        return engine.scorecard()
+
     def apply(self, pcs: PodCliqueSet):
         return self.store.create(pcs)
 

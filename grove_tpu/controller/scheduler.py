@@ -699,6 +699,15 @@ class GangScheduler:
                 scores[f"{ns}/{name}"] = round(float(s), 4)
         return scores
 
+    def _export_starved(self) -> None:
+        """The standing starvation gauge (what the SLO engine's
+        max-starved-seconds objective reads; debug_state carries the
+        gang names)."""
+        self.metrics.gauge(
+            "grove_scheduler_starved_gangs",
+            "gangs still waiting on capacity after the last pass",
+        ).set(float(len(self._starved)))
+
     def export_placement_score(self, mean: float) -> None:
         """The standing fleet-quality gauge (what the defrag threshold
         and the long-churn drift gate read outside any bench)."""
@@ -720,6 +729,7 @@ class GangScheduler:
         dirty_scheduled: list[PodGang] = []
         blocked_pending = False
         score_sum, score_n = 0.0, 0
+        oldest_pending: Optional[float] = None
         pod_bucket = self.store.kind_bucket(Pod.KIND)
         for gang in self.store.scan(PodGang.KIND):
             if gang.metadata.deletion_timestamp is not None:
@@ -738,6 +748,9 @@ class GangScheduler:
                         examine.add(key)
             elif self._gang_ready_to_schedule(gang, pod_bucket=pod_bucket):
                 backlog_keys.append(key)
+                created = gang.metadata.creation_timestamp
+                if oldest_pending is None or created < oldest_pending:
+                    oldest_pending = created
             elif self._any_referenced_pod_bound(gang, pod_bucket):
                 # a PENDING gang with bound referenced pods is a committed
                 # bind whose Scheduled ack was lost (the manager died — or
@@ -761,6 +774,17 @@ class GangScheduler:
         # simply no data (debug_state reports None for the same state)
         if score_n:
             self.export_placement_score(score_sum / score_n)
+        # how long the oldest READY backlog gang has waited, as a standing
+        # gauge (0.0 = empty backlog). Starvation that never binds leaves
+        # no latency observation — this is the signal the SLO engine's
+        # max-starved-seconds objective reads while the gang still waits.
+        self.metrics.gauge(
+            "grove_scheduler_oldest_pending_seconds",
+            "age of the oldest ready-to-schedule gang still unplaced",
+        ).set(
+            max(0.0, self.store.clock.now() - oldest_pending)
+            if oldest_pending is not None else 0.0
+        )
         # streaming admission (grove_tpu/streaming): partition the
         # backlog into this round's micro-batch, the waiters whose
         # window is still open, and the sheds — the AUTHORITATIVE plan
@@ -798,6 +822,7 @@ class GangScheduler:
             self._count_dispatch("abandoned")
         if not needs_solve:
             self._starved = set()  # examined: nothing left unbound
+            self._export_starved()
             self._update_phases(examine)
             return Result(requeue_after=_min_requeue(
                 self.retry_seconds if blocked_pending else None,
@@ -855,6 +880,7 @@ class GangScheduler:
             for g in dirty_scheduled
             if self._has_unbound_referenced_pod(g)
         }
+        self._export_starved()
         if self._starved:
             requeue = self.retry_seconds
         # the full examine set: a previously-starved gang whose pods were
@@ -1950,10 +1976,24 @@ class GangScheduler:
             "grove_scheduler_gangs_scheduled_total", "gangs bound to nodes"
         ).inc()
         # control-plane bind latency: gang creation -> bind (virtual clock)
+        bind_latency = (
+            self.store.clock.now() - gang.metadata.creation_timestamp
+        )
         self.metrics.histogram(
             "grove_scheduler_gang_bind_latency_seconds",
             "virtual seconds from PodGang creation to bind",
-        ).observe(self.store.clock.now() - gang.metadata.creation_timestamp)
+        ).observe(bind_latency)
+        if self.tenancy is not None and self.tenancy.enabled:
+            # the per-tenant series the SLO engine's p99 objective reads;
+            # tenancy reconciles torn-down tenants' series out of the
+            # exposition (tenancy/queues._export_metrics)
+            tenant = self.tenancy.tenant_of_gang(gang)
+            if tenant is not None:
+                self.metrics.histogram(
+                    "grove_scheduler_tenant_bind_latency_seconds",
+                    "virtual seconds from PodGang creation to bind, "
+                    "per tenant",
+                ).observe(bind_latency, tenant=tenant)
         if self.tracer.enabled:
             # the GangTimeline anchor: created_at + pod count let the
             # reconstructor decompose this gang's bind latency into

@@ -23,6 +23,7 @@ from .explain import (
 )
 from .logging import Logger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .slo import SLOEngine
 
 __all__ = [
     "ClusterEvent",
@@ -34,6 +35,7 @@ __all__ = [
     "Histogram",
     "Logger",
     "MetricsRegistry",
+    "SLOEngine",
     "UnsatCode",
     "UnsatDiagnosis",
     "diagnose_unplaced",

@@ -154,6 +154,13 @@ def harness_dump(harness) -> dict[str, Any]:
         # routing verdict — the runbook's first stop for "which cluster
         # owns this gang, and did the router ever admit it"
         out["federation"] = federation.debug_state()
+    slo = getattr(harness.cluster, "slo", None)
+    if slo is not None:
+        # the continuous SLO evaluator (observability/slo.py): the
+        # per-tenant scorecard — budgets, burn rates, alert states and
+        # transition history (render with
+        # python -m grove_tpu.observability.slo)
+        out["slo"] = slo.scorecard()
     return out
 
 

@@ -132,6 +132,48 @@ class Histogram:
         self._sums.clear()
         self._rng.clear()
 
+    def remove(self, **labels: str) -> bool:
+        """Drop one label series from the exposition (Counter/Gauge
+        parity). Only remove a series whose OWNING OBJECT is gone — a
+        torn-down tenant's latency series — never to reset a live one.
+        Returns whether the series existed."""
+        key = _label_key(labels)
+        existed = self._counts.pop(key, None) is not None
+        existed = (self._series.pop(key, None) is not None) or existed
+        self._sums.pop(key, None)
+        self._rng.pop(key, None)
+        self._dirty.discard(key)
+        return existed
+
+    def label_sets(self) -> list[dict[str, str]]:
+        """The label set of every live series (Counter/Gauge parity:
+        public enumeration for owners reconciling per-object series)."""
+        return [dict(key) for key in self._counts]
+
+    def is_estimated(self, **labels: str) -> bool:
+        """Whether percentiles for this label series are reservoir
+        estimates rather than exact: True once more observations have
+        arrived than the series retains (past `max_observations`).
+        Consumers that alert on percentiles should widen their
+        confidence band when this flips."""
+        key = _label_key(labels)
+        return self._counts.get(key, 0) > len(self._series.get(key, ()))
+
+    def count_over(self, threshold: float, **labels: str) -> int:
+        """Observations strictly above `threshold` in one label series.
+        Exact below the retention cap; past it, the retained reservoir
+        is a uniform sample so the count is scaled up by the true/
+        retained ratio (check `is_estimated` to know which you got)."""
+        key = _label_key(labels)
+        obs = self._series.get(key)
+        if not obs:
+            return 0
+        retained_over = sum(1 for v in obs if v > threshold)
+        total = self._counts.get(key, 0)
+        if total <= len(obs):
+            return retained_over
+        return round(retained_over * (total / len(obs)))
+
     def _obs_for(self, labels: dict[str, str] | None) -> list[float]:
         return self._series.get(_label_key(labels), [])
 
@@ -214,11 +256,15 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {name} summary")
                 for key in sorted(m._series):
                     labels = dict(key)
+                    # quantiles past the retention cap are reservoir
+                    # estimates — say so in the exposition rather than
+                    # letting scrapers silently trust a sample
+                    estimated = m.is_estimated(**labels)
                     for q in (50, 90, 99):
-                        qk = _fmt_labels(
-                            tuple(sorted({**labels,
-                                          "quantile": f"0.{q}"}.items()))
-                        )
+                        qlabels = {**labels, "quantile": f"0.{q}"}
+                        if estimated:
+                            qlabels["estimated"] = "true"
+                        qk = _fmt_labels(tuple(sorted(qlabels.items())))
                         lines.append(f"{name}{qk} {m.percentile(q, **labels)}")
                     lines.append(
                         f"{name}_sum{_fmt_labels(key)} {m._sums[key]}"

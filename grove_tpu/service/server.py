@@ -46,10 +46,15 @@ class PlacementService:
     """Holds one engine per registered topology epoch (bounded)."""
 
     def __init__(self, engine_cls=PlacementEngine, max_epochs: int = 4,
-                 tracer=None, **engine_kwargs):
+                 tracer=None, slo=None, **engine_kwargs):
         self.engine_cls = engine_cls
         self.engine_kwargs = engine_kwargs
         self.max_epochs = max_epochs
+        #: optional observability.slo.SLOEngine (or anything with a
+        #: scorecard() -> dict): when the embedding process runs the SLO
+        #: evaluator, the Debug RPC serves its scorecard alongside
+        #: tracing/explain. Injection-only — the service never sweeps.
+        self.slo = slo
         #: observability.tracing span tracer, shared with every engine
         #: this service builds (engine.fused — or encode/device/repair
         #: on the split path — spans land in it; the Debug RPC reports
@@ -176,6 +181,13 @@ class PlacementService:
             # occupancy + the latest record of every still-unplaced gang
             # (render with python -m grove_tpu.observability.explain)
             "explain": self.decisions.summary(),
+            # same shape as harness.debug_dump()["slo"]: the per-tenant
+            # scorecard when an SLOEngine was injected (render with
+            # python -m grove_tpu.observability.slo)
+            "slo": (
+                self.slo.scorecard() if self.slo is not None
+                else {"enabled": False}
+            ),
         }).encode()
 
 

@@ -80,6 +80,7 @@ class TenantQueue:
         "name", "guaranteed", "burst", "weight", "tier", "parent",
         "disruption_budget", "children", "usage", "dominant_share",
         "entitlement", "deficit", "burst_eligible", "active",
+        "conditions",
     )
 
     def __init__(self, spec: dict, default_tier: str):
@@ -109,6 +110,10 @@ class TenantQueue:
         self.burst_eligible: bool = False
         #: usage > 0 or pending gangs this round
         self.active: bool = False
+        #: DisruptionTarget-style conditions stamped by external
+        #: observers (the SLO engine's `SLOViolation`, api/meta
+        #: Condition objects) — in-memory, surfaced via debug_state
+        self.conditions: list = []
 
 
 class DisruptionLedger:
@@ -544,6 +549,16 @@ class TenancyManager:
             for labels in g.label_sets():
                 if labels.get("tenant") not in live:
                     g.remove(**labels)
+        # same hygiene for the scheduler's per-tenant bind-latency
+        # histogram: a torn-down tenant's latency series (and its
+        # quantile lines) must leave the exposition with the tenant
+        latency_h = self.metrics.get(
+            "grove_scheduler_tenant_bind_latency_seconds"
+        )
+        if latency_h is not None:
+            for labels in latency_h.label_sets():
+                if labels.get("tenant") not in live:
+                    latency_h.remove(**labels)
         for name, q in self.queues.items():
             share_g.set(q.dominant_share, tenant=name)
             deficit_g.set(q.deficit, tenant=name)
@@ -587,6 +602,16 @@ class TenancyManager:
                     "burst_eligible": q.burst_eligible,
                     "disruption_budget": q.disruption_budget,
                     "usage": [round(float(v), 4) for v in q.usage],
+                    "conditions": [
+                        {
+                            "type": c.type,
+                            "status": c.status,
+                            "reason": c.reason,
+                            "message": c.message,
+                            "last_transition_time": c.last_transition_time,
+                        }
+                        for c in q.conditions
+                    ],
                 }
                 for name, q in sorted(self.queues.items())
             },

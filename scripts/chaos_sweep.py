@@ -219,6 +219,43 @@ STREAM_CONFIG = {
 }
 
 
+#: SLO config for --slo sweeps: the continuous evaluator swept on a
+#: cadence TIGHTER than the fault plan's 2-second steps aggregate (4s =
+#: every other step), with window pairs scaled to the 80-virtual-second
+#: storm so a single bad sweep trips pending and the next confirming
+#: sweep fires — alerts must fire DURING the fault window, and the
+#: short windows must forget the fault within a few clean sweeps so
+#: resolution lands during the post-settle drain. Objectives cover the
+#: burst_storm/tenant_skew shed path, the backlog starvation path, and
+#: the promote_standby/process-crash failover path.
+SLO_CONFIG = {
+    "slo": {
+        "enabled": True,
+        "sync_interval_seconds": 4.0,
+        "budget_window_seconds": 600.0,
+        "page_short_seconds": 8.0,
+        "page_long_seconds": 24.0,
+        "page_burn_threshold": 5.0,
+        "ticket_short_seconds": 24.0,
+        "ticket_long_seconds": 80.0,
+        "ticket_burn_threshold": 2.0,
+        "objectives": [
+            {"name": "bind-p99", "kind": "bind_latency_p99",
+             "target": 0.98, "threshold_seconds": 30.0,
+             "per_tenant": True},
+            {"name": "shed-rate", "kind": "shed_rate",
+             "target": 0.98, "ceiling_per_second": 0.25},
+            {"name": "starvation", "kind": "starvation",
+             "target": 0.98, "max_starved_seconds": 30.0},
+            {"name": "placement-drift", "kind": "placement_drift",
+             "target": 0.95, "band": 0.4},
+            {"name": "failover-wall", "kind": "failover_wall",
+             "target": 0.98, "max_failovers": 0},
+        ],
+    }
+}
+
+
 #: federation config for --federation sweeps: a 3-member federation with
 #: a SHORT outage window (a seeded cluster_partition of a few 2-second
 #: steps can outlive it, so the healed-zombie fence path is actually on
@@ -248,7 +285,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
              serving: bool = False,
              hierarchical: bool = False,
              defrag: bool = False,
-             stream: bool = False) -> dict:
+             stream: bool = False,
+             slo: bool = False) -> dict:
     overrides = {"tenant_skew_rate": 0.35} if tenant_skew else {}
     if stream:
         # the streaming-admission fault axis: seeded ~10x burst storms
@@ -341,6 +379,11 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         config = {**config, **DEFRAG_CONFIG}
     if stream:
         config = {**config, **STREAM_CONFIG}
+    if slo:
+        # evaluation-only: the engine's Events ride the raw store (zero
+        # fault-plan draws), so composing --slo changes no seed's
+        # workload trajectory — the shared fault-free baseline holds
+        config = {**config, **SLO_CONFIG}
     if shards > 1:
         config = {**config, "controllers": {"shards": shards}}
     if wal_tmp is not None:
@@ -364,7 +407,7 @@ def run_seed(seed: int, nodes: int, baseline: dict,
         return _run_seed_inner(
             seed, nodes, baseline, plan, config, trace_path,
             explain_dir, durability, serving, hierarchical, defrag,
-            replication, stream,
+            replication, stream, slo, tenant_skew,
         )
     finally:
         # exception-safe: a seed that raises out of harness construction
@@ -377,7 +420,8 @@ def run_seed(seed: int, nodes: int, baseline: dict,
 def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
                     explain_dir, durability, serving=False,
                     hierarchical=False, defrag=False,
-                    replication=False, stream=False) -> dict:
+                    replication=False, stream=False, slo=False,
+                    tenant_skew=False) -> dict:
     ch = ChaosHarness(
         plan, nodes=make_nodes(nodes), trace_path=trace_path,
         config=config or None,
@@ -449,6 +493,57 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
             result["error"] = (
                 "stream queue not drained at settle (depth="
                 f"{None if front is None else front.queue_depth()})"
+            )
+    if slo:
+        engine = ch.harness.cluster.slo
+        # capture BEFORE the resolve drain: these transitions happened
+        # while the plan was armed — the fire-during-fault half of the
+        # lifecycle invariant
+        fired = [
+            h for h in engine.history if h["to"] == "firing"
+        ] if engine is not None else []
+        sync = SLO_CONFIG["slo"]["sync_interval_seconds"]
+        if engine is not None and error is None:
+            # resolve drain: the faults are gone and the workload is
+            # settled, so every firing alert's short window must forget
+            # the storm within a bounded number of clean sweeps
+            for _ in range(80):
+                if not engine.firing():
+                    break
+                ch.harness.advance(sync)
+                ch.harness.maybe_slo_sweep()
+        still_firing = engine.firing() if engine is not None else []
+        result["slo"] = {
+            "alerts_fired": len(fired),
+            "slos_fired": sorted({
+                (h["slo"], h["tenant"] or "") for h in fired
+            }),
+            "firing_after_settle": len(still_firing),
+            "transitions": len(engine.history) if engine is not None else 0,
+        }
+        # the scorecard itself is the CI artifact (--scorecard pops it
+        # into one JSON per sweep); keep the per-seed result line lean
+        result["slo_scorecard"] = (
+            engine.scorecard() if engine is not None else {"enabled": False}
+        )
+        if error is None and engine is None:
+            result["ok"] = False
+            result["error"] = "slo: engine missing despite --slo config"
+        elif error is None and (stream or tenant_skew) and not fired:
+            # the storm axes shed/starve by construction — a sweep where
+            # no alert ever fired means the evaluator missed the fault
+            result["ok"] = False
+            result["error"] = "slo: no alert fired during the fault phase"
+        elif error is None and still_firing:
+            result["ok"] = False
+            result["error"] = (
+                "slo: alerts still firing after settle: "
+                + ", ".join(
+                    f"{a['slo']}"
+                    + (f"[{a['tenant']}]" if a["tenant"] else "")
+                    + f"/{a['severity']}"
+                    for a in still_firing
+                )
             )
     if replication:
         result["standby_promotions"] = ch.standby_promotions
@@ -785,6 +880,26 @@ def main(argv=None) -> int:
                          "the hold); convergence is checked against the "
                          "fault-free fixpoint under the SAME config and "
                          "the queue must end the run drained")
+    ap.add_argument("--slo", action="store_true",
+                    help="run the continuous SLO evaluator "
+                         "(observability/slo.py) through every storm on "
+                         "a tight sweep cadence and make the alert "
+                         "lifecycle a per-seed invariant: with a storm "
+                         "axis armed (--stream / --tenant-skew) at "
+                         "least one alert must transition "
+                         "pending->firing DURING the fault, and every "
+                         "firing alert must resolve within a bounded "
+                         "post-settle drain. Evaluation consumes zero "
+                         "fault-plan draws (Events ride the raw store), "
+                         "so seeds replay bit-identically with or "
+                         "without it")
+    ap.add_argument("--scorecard", dest="scorecard_path", default=None,
+                    metavar="PATH",
+                    help="with --slo: write every seed's final SLO "
+                         "scorecard as one JSON document "
+                         "({'seeds': {seed: card}}) — the CI artifact; "
+                         "render with python -m "
+                         "grove_tpu.observability.slo")
     ap.add_argument("--federation", action="store_true",
                     help="sweep the FEDERATION fault axis instead of the "
                          "single-cluster matrix: a 3-member federation "
@@ -894,6 +1009,7 @@ def main(argv=None) -> int:
 
     results = []
     failed = []
+    scorecards = {}
     for seed in range(args.start, args.start + args.seeds):
         result = run_seed(seed, args.nodes, baseline, trace_dir=trace_dir,
                           explain_dir=explain_dir,
@@ -905,11 +1021,21 @@ def main(argv=None) -> int:
                           serving=args.serving,
                           hierarchical=args.hierarchical,
                           defrag=args.defrag,
-                          stream=args.stream)
+                          stream=args.stream,
+                          slo=args.slo)
+        # the full scorecard is an artifact, not a log line — pop it
+        # off the printed result and collect it for --scorecard
+        card = result.pop("slo_scorecard", None)
+        if card is not None:
+            scorecards[str(seed)] = card
         print(json.dumps(result), flush=True)
         results.append(result)
         if not result["ok"]:
             failed.append(seed)
+    if args.scorecard_path and scorecards:
+        with open(args.scorecard_path, "w") as fh:
+            json.dump({"seeds": scorecards}, fh, indent=2)
+            fh.write("\n")
     summary = {
         "swept": args.seeds,
         "start": args.start,
@@ -922,6 +1048,7 @@ def main(argv=None) -> int:
         "hierarchical": args.hierarchical,
         "defrag": args.defrag,
         "stream": args.stream,
+        "slo": args.slo,
         "failed_seeds": failed,
         "ok": not failed,
     }

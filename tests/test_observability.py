@@ -548,3 +548,165 @@ class TestEventDedupCollision:
         assert a != b
         # stable across calls (it IS the store key)
         assert a == EventRecorder.dedup_name("Pod", "pod-a-b", "c")
+
+
+class TestHistogramSeriesHygiene:
+    """Satellite (PR 18): Histogram gains remove()/label_sets()
+    (Counter/Gauge parity) so per-tenant latency series can be
+    reconciled away with their owning tenant."""
+
+    def test_remove_and_label_sets_parity(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        h = Histogram("h", max_observations=16)
+        h.observe(1.0, tenant="a")
+        h.observe(2.0, tenant="b")
+        assert sorted(ls["tenant"] for ls in h.label_sets()) == ["a", "b"]
+        assert h.remove(tenant="a") is True
+        assert h.remove(tenant="a") is False, "second remove: gone"
+        assert [ls["tenant"] for ls in h.label_sets()] == ["b"]
+        # every accumulator dropped, not just the exposition
+        assert h.series_count(tenant="a") == 0
+        assert h.percentile(50, tenant="a") == 0.0
+        assert h.count == 1 and h.sum == 2.0
+
+    def test_removed_series_leaves_exposition(self):
+        from grove_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        h = r.histogram("grove_lat", "help")
+        h.observe(1.0, tenant="gone")
+        h.observe(2.0, tenant="kept")
+        h.remove(tenant="gone")
+        text = r.render()
+        assert 'tenant="kept"' in text
+        assert 'tenant="gone"' not in text
+
+    def test_tenant_teardown_drops_latency_series(self):
+        """The tenancy export applies the established
+        label_sets/remove pattern to the per-tenant bind-latency
+        histogram: a removed tenant's series leaves /metrics."""
+        from grove_tpu.observability import MetricsRegistry
+        from grove_tpu.tenancy import TenancyManager
+
+        from test_solver import cluster
+        from test_tenancy import tenancy_cfg
+
+        registry = MetricsRegistry()
+        m = TenancyManager(
+            tenancy_cfg([
+                {"name": "t-live", "guaranteed": {"cpu": 4.0}},
+                {"name": "t-dead", "guaranteed": {"cpu": 4.0}},
+            ]),
+            metrics=registry,
+        )
+        hist = registry.histogram(
+            "grove_scheduler_tenant_bind_latency_seconds", "help"
+        )
+        hist.observe(0.5, tenant="t-live")
+        hist.observe(0.7, tenant="t-dead")
+        snap = cluster()
+        h = Harness(nodes=make_nodes(4))
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        assert sorted(
+            ls["tenant"] for ls in hist.label_sets()
+        ) == ["t-dead", "t-live"]
+        m.configure(tenancy_cfg([
+            {"name": "t-live", "guaranteed": {"cpu": 4.0}},
+        ]))
+        m.refresh_and_export(
+            h.store, snap, h.cluster.pod_demand_fn(snap.resource_names)
+        )
+        assert [ls["tenant"] for ls in hist.label_sets()] == ["t-live"]
+        assert 't-dead' not in registry.render()
+
+
+class TestHistogramEstimation:
+    """Satellite (PR 18): percentiles past the downsampling cap are
+    estimates and must SAY so — is_estimated() programmatically and an
+    estimated="true" exposition label on the quantile lines."""
+
+    def test_is_estimated_flips_at_cap(self):
+        from grove_tpu.observability.metrics import Histogram
+
+        h = Histogram("h", max_observations=64)
+        for v in range(64):
+            h.observe(float(v), k="a")
+        assert h.is_estimated(k="a") is False, "at the cap: still exact"
+        h.observe(64.0, k="a")
+        assert h.is_estimated(k="a") is True
+        assert h.is_estimated(k="missing") is False
+
+    def test_estimated_label_rendered_past_cap_only(self):
+        from grove_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        h = r.histogram("h", "help")
+        h.max_observations = 8
+        for v in range(8):
+            h.observe(float(v), tier="exact")
+        for v in range(20):
+            h.observe(float(v), tier="est")
+        text = r.render()
+        assert 'h{estimated="true",quantile="0.99",tier="est"}' in text
+        assert 'estimated="true",quantile="0.50",tier="est"' in text
+        # the exact series carries NO estimated label
+        assert 'tier="exact"' in text
+        for line in text.splitlines():
+            if 'tier="exact"' in line:
+                assert "estimated" not in line
+        # _sum/_count lines never carry it (they stay exact throughout)
+        for line in text.splitlines():
+            if line.startswith(("h_sum", "h_count")):
+                assert "estimated" not in line
+
+    def test_estimated_label_escapes_with_user_labels(self):
+        from grove_tpu.observability.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        h = r.histogram("h", "help")
+        h.max_observations = 4
+        for v in range(9):
+            h.observe(float(v), tier='we"ird')
+        text = r.render()
+        # one formatting path: estimated + quantile + escaped user label
+        assert ('h{estimated="true",quantile="0.50",tier="we\\"ird"}'
+                in text)
+
+    def test_reservoir_percentile_within_band_of_exact(self):
+        """Seeded stream at 20x the cap: the reservoir estimate must
+        land within a pinned band of the exact percentile (the
+        deterministic LCG makes the band assertable, not flaky)."""
+        from grove_tpu.observability.metrics import Histogram
+
+        cap = 256
+        n = 20 * cap
+        h = Histogram("h", max_observations=cap)
+        # seeded LCG stream (values in [0, 1000))
+        x = 12345
+        exact = []
+        for _ in range(n):
+            x = (x * 6364136223846793005 + 1442695040888963407) % (1 << 64)
+            v = (x >> 33) % 1000
+            exact.append(float(v))
+            h.observe(float(v))
+        assert h.is_estimated() is True
+        exact.sort()
+
+        def exact_pct(q):
+            idx = min(n - 1, max(0, round(q / 100 * (n - 1))))
+            return exact[idx]
+
+        # pinned accuracy bands on the value scale (range 0..999): a
+        # 256-sample uniform reservoir holds percentiles well inside
+        # +/-10% of range for the mid quantiles, +/-5% at the tail
+        assert abs(h.percentile(50) - exact_pct(50)) <= 100.0
+        assert abs(h.percentile(90) - exact_pct(90)) <= 100.0
+        assert abs(h.percentile(99) - exact_pct(99)) <= 50.0
+        # count_over scales the retained count by true/retained and
+        # must land within the same kind of band
+        true_over = sum(1 for v in exact if v > 500.0)
+        est_over = h.count_over(500.0)
+        assert abs(est_over - true_over) <= 0.15 * n

@@ -1,0 +1,532 @@
+"""SLO engine (grove_tpu/observability/slo.py): SLOConfig validation,
+exact error-budget arithmetic, the multi-window burn-rate alert state
+machine (pending -> firing -> resolved, with Events / counters / tenant
+queue conditions), sampler-ring bounds, soft-state survival across
+cold_restart, re-warm counter baselining, sweep cadence gating, the
+scorecard surfaces (debug_dump, gRPC Debug, CLI), the shared verdict
+vocabulary, and chaos interplay (alerts fire DURING the fault and
+resolve after settle; seeds replay bit-identically with SLO on or off).
+"""
+
+import json
+
+import pytest
+
+from grove_tpu.api.config import load_operator_config
+from grove_tpu.api.meta import get_condition
+from grove_tpu.api.validation import ValidationError
+from grove_tpu.chaos import ChaosHarness, FaultPlan, settled_fingerprint
+from grove_tpu.cluster import make_nodes
+from grove_tpu.cluster.clock import SimClock
+from grove_tpu.controller import Harness
+from grove_tpu.observability.metrics import MetricsRegistry
+from grove_tpu.observability.slo import (
+    ALERT_FIRING,
+    ALERT_INACTIVE,
+    ALERT_PENDING,
+    ALERT_RESOLVED,
+    SLO_VIOLATION_CONDITION,
+    VERDICT_BREACH,
+    VERDICT_BURNING,
+    VERDICT_OK,
+    SLOEngine,
+    compose_scorecard,
+    main as slo_main,
+    render_scorecard,
+    static_entry,
+    worst_verdict,
+)
+from grove_tpu.service.server import PlacementService
+
+from test_chaos import NODES, chaos_workload, quiet
+
+#: tight windows sized to the 5s test sweep cadence. page_short equals
+#: the cadence on purpose: the short window then holds exactly one SLI
+#: sample, which makes trip/untrip transitions single-sweep-precise.
+SLO_BASE = {
+    "enabled": True,
+    "sync_interval_seconds": 5.0,
+    "budget_window_seconds": 120.0,
+    "pending_for_seconds": 0.0,
+    "page_short_seconds": 5.0,
+    "page_long_seconds": 30.0,
+    "page_burn_threshold": 5.0,
+    "ticket_short_seconds": 30.0,
+    "ticket_long_seconds": 90.0,
+    "ticket_burn_threshold": 2.0,
+}
+
+SHED_OBJECTIVE = {
+    "name": "shed-ceiling", "kind": "shed_rate",
+    "target": 0.9, "ceiling_per_second": 1.0,
+}
+
+
+def slo_cfg(**over):
+    return load_operator_config({"slo": {**SLO_BASE, **over}}).slo
+
+
+def engine(**over):
+    """A bare engine on its own registry + virtual clock (no Harness)."""
+    registry = MetricsRegistry()
+    clock = SimClock()
+    return SLOEngine(slo_cfg(**over), registry, clock), registry, clock
+
+
+# -- config validation --------------------------------------------------------
+
+class TestSLOConfigValidation:
+    def test_disabled_by_default(self):
+        cfg = load_operator_config({}).slo
+        assert cfg.enabled is False
+        # defaults are themselves valid: enabling is a one-line change
+        load_operator_config({"slo": {"enabled": True}})
+
+    def test_valid_block_round_trips(self):
+        cfg = slo_cfg(objectives=[SHED_OBJECTIVE])
+        assert cfg.enabled
+        assert cfg.sync_interval_seconds == 5.0
+        assert cfg.objectives == [SHED_OBJECTIVE]
+
+    @pytest.mark.parametrize("over,needle", [
+        ({"sync_interval_seconds": 0}, "sync_interval_seconds"),
+        ({"page_burn_threshold": -1.0}, "page_burn_threshold"),
+        # inverted window pair: the long window must cover the short
+        ({"page_short_seconds": 40.0}, "page_long_seconds"),
+        ({"ticket_short_seconds": 91.0}, "ticket_long_seconds"),
+        # budget accounting must cover the slowest alert window
+        ({"budget_window_seconds": 50.0}, "budget_window_seconds"),
+        ({"pending_for_seconds": -1.0}, "pending_for_seconds"),
+        ({"max_samples_per_series": 0}, "max_samples_per_series"),
+        ({"history_limit": 0}, "history_limit"),
+        ({"objectives": "nope"}, "objectives: must be a list"),
+        ({"objectives": [{"kind": "shed_rate"}]}, "name"),
+        ({"objectives": [SHED_OBJECTIVE, SHED_OBJECTIVE]}, "duplicate"),
+        ({"objectives": [{"name": "x", "kind": "wat"}]}, "unknown kind"),
+        ({"objectives": [{"name": "x", "kind": "shed_rate",
+                          "target": 1.5}]}, "target"),
+        ({"objectives": [{"name": "x", "kind": "shed_rate",
+                          "ceiling_per_second": 0}]}, "ceiling_per_second"),
+        ({"objectives": [{"name": "x", "kind": "shed_rate",
+                          "typo_field": 1}]}, "unknown field"),
+        ({"objectives": [{"name": "x", "kind": "shed_rate",
+                          "per_tenant": "yes"}]}, "per_tenant"),
+        ({"objectives": [{"name": "x", "kind": "failover_wall",
+                          "max_failovers": -1}]}, "max_failovers"),
+    ])
+    def test_invalid_blocks_rejected(self, over, needle):
+        with pytest.raises(ValidationError, match=needle):
+            slo_cfg(**over)
+
+
+# -- budget arithmetic (acceptance: sums exactly) -----------------------------
+
+class TestBudgetArithmetic:
+    def run_sweeps(self, eng, registry, clock, bad_at):
+        """10 sweeps at 5s cadence; shed hard during the sweeps in
+        `bad_at` (rate 2.0/s over the 1.0/s ceiling -> one bad unit)."""
+        sheds = registry.counter("grove_stream_shed_total", "")
+        for i in range(10):
+            if i > 0:
+                clock.advance(5.0)
+            if i in bad_at:
+                sheds.inc(10.0)
+            eng.sweep()
+
+    def test_budget_sums_exactly(self):
+        eng, registry, clock = engine(objectives=[SHED_OBJECTIVE])
+        self.run_sweeps(eng, registry, clock, bad_at={4, 5})
+        (entry,) = eng.scorecard()["slos"]
+        s = entry["samples"]
+        # probe SLI: one unit per sweep, and good + bad == total exactly
+        assert s == {"good": 8.0, "bad": 2.0, "total": 10.0}
+        b = entry["error_budget"]
+        # target 0.9 over 10 units allows exactly 1 bad unit; 2 spent
+        assert b["allowed_bad"] == pytest.approx(1.0)
+        assert b["spent_bad"] == 2.0
+        assert b["spent_fraction"] == pytest.approx(2.0)
+        assert b["remaining_fraction"] == pytest.approx(-1.0)
+        assert b["remaining_clamped"] == 0.0
+        assert entry["verdict"] == VERDICT_BREACH
+
+    def test_zero_traffic_spends_nothing(self):
+        eng, registry, clock = engine(objectives=[
+            {"name": "bind-p99", "kind": "bind_latency_p99",
+             "target": 0.9, "threshold_seconds": 1.0},
+        ])
+        for _ in range(3):
+            eng.sweep()
+            clock.advance(5.0)
+        (entry,) = eng.scorecard()["slos"]
+        # a ratio SLI with no events has an empty budget, not a spent one
+        assert entry["samples"]["total"] == 0
+        assert entry["error_budget"]["spent_fraction"] == 0.0
+        assert entry["error_budget"]["remaining_fraction"] == 1.0
+        assert entry["verdict"] == VERDICT_OK
+
+    def test_clean_run_keeps_full_budget(self):
+        eng, registry, clock = engine(objectives=[SHED_OBJECTIVE])
+        self.run_sweeps(eng, registry, clock, bad_at=set())
+        (entry,) = eng.scorecard()["slos"]
+        assert entry["samples"] == {"good": 10.0, "bad": 0.0, "total": 10.0}
+        assert entry["error_budget"]["remaining_fraction"] == 1.0
+        assert entry["verdict"] == VERDICT_OK
+        g = registry.get("grove_slo_error_budget_remaining")
+        assert g.value(slo="shed-ceiling") == 1.0
+
+
+# -- alert state machine ------------------------------------------------------
+
+class TestAlertStateMachine:
+    def page_state(self, eng):
+        return eng._alerts[("shed-ceiling", None, "page")]["state"]
+
+    def test_pending_firing_resolved_lifecycle(self):
+        eng, registry, clock = engine(objectives=[SHED_OBJECTIVE])
+        sheds = registry.counter("grove_stream_shed_total", "")
+        eng.sweep()  # t=0 baseline
+        for _ in range(2):  # t=5, t=10: sustained over-ceiling shedding
+            clock.advance(5.0)
+            sheds.inc(10.0)
+            eng.sweep()
+        assert self.page_state(eng) == ALERT_FIRING
+        assert eng.firing()  # and it is visible to the chaos drain gate
+        c = registry.get("grove_slo_alerts_total")
+        assert c.value(slo="shed-ceiling", severity="page") == 1.0
+        for _ in range(2):  # recovery: the short page window forgets fast
+            clock.advance(5.0)
+            eng.sweep()
+        assert self.page_state(eng) == ALERT_RESOLVED
+        # the ticket pair's slower short window (30s) lags by design —
+        # a few more quiet sweeps age the bad samples out of it
+        for _ in range(6):
+            if not eng.firing():
+                break
+            clock.advance(5.0)
+            eng.sweep()
+        assert eng.firing() == []
+        states = [
+            (h["severity"], h["from"], h["to"]) for h in eng.history
+            if h["severity"] == "page"
+        ]
+        assert states == [
+            ("page", ALERT_INACTIVE, ALERT_PENDING),
+            ("page", ALERT_PENDING, ALERT_FIRING),
+            ("page", ALERT_FIRING, ALERT_RESOLVED),
+        ]
+
+    def test_one_sample_spike_never_pages(self):
+        # pending_for 0 still demands one strictly-later confirming
+        # sweep: a single bad interval goes pending and falls back
+        eng, registry, clock = engine(objectives=[SHED_OBJECTIVE])
+        sheds = registry.counter("grove_stream_shed_total", "")
+        eng.sweep()
+        clock.advance(5.0)
+        sheds.inc(10.0)
+        eng.sweep()
+        assert self.page_state(eng) == ALERT_PENDING
+        clock.advance(5.0)
+        eng.sweep()  # quiet interval: the spike never confirmed
+        assert self.page_state(eng) == ALERT_INACTIVE
+        c = registry.get("grove_slo_alerts_total")
+        page_firings = (
+            c.value(slo="shed-ceiling", severity="page") if c else 0.0
+        )
+        assert page_firings == 0.0
+        assert [h["to"] for h in eng.history if h["severity"] == "page"] == [
+            ALERT_PENDING, ALERT_INACTIVE,
+        ]
+
+    def test_burning_entry_verdict(self):
+        # a wide budget window keeps allowed_bad above the burst the
+        # page pair needs to trip: burning, not yet a breach
+        eng, registry, clock = engine(
+            objectives=[SHED_OBJECTIVE], budget_window_seconds=600.0,
+        )
+        sheds = registry.counter("grove_stream_shed_total", "")
+        for i in range(30):  # a long good history
+            clock.advance(5.0)
+            eng.sweep()
+        for _ in range(3):  # burst until the 30s page_long window trips
+            sheds.inc(10.0)
+            clock.advance(5.0)
+            eng.sweep()
+        (entry,) = eng.scorecard()["slos"]
+        assert entry["alerts"]["page"]["state"] == ALERT_PENDING
+        b = entry["error_budget"]
+        assert b["spent_bad"] == 3.0 and b["spent_bad"] < b["allowed_bad"]
+        assert entry["verdict"] == VERDICT_BURNING
+
+    def test_rewarm_baselines_cumulative_counters(self):
+        # a genuinely new process re-warms: first sight of a cumulative
+        # counter baselines it (delta 0) — restarts never manufacture
+        # alerts out of pre-existing totals
+        registry = MetricsRegistry()
+        registry.counter("grove_stream_shed_total", "").inc(1e6)
+        clock = SimClock(start=500.0)
+        eng = SLOEngine(slo_cfg(objectives=[SHED_OBJECTIVE]), registry, clock)
+        for _ in range(3):
+            eng.sweep()
+            clock.advance(5.0)
+        assert eng.firing() == []
+        assert list(eng.history) == []
+        (entry,) = eng.scorecard()["slos"]
+        assert entry["samples"]["bad"] == 0.0
+
+    def test_sampler_rings_stay_bounded(self):
+        eng, registry, clock = engine(
+            objectives=[SHED_OBJECTIVE], max_samples_per_series=8,
+        )
+        for _ in range(40):
+            eng.sweep()
+            clock.advance(5.0)
+        assert all(len(r) <= 8 for r in eng._sli.values())
+        assert all(len(r) <= 8 for r in eng._rings.values())
+
+
+# -- harness integration: events, conditions, cadence, surfaces ---------------
+
+TENANT_SLO_CONFIG = {
+    "tenancy": {
+        "enabled": True,
+        "tenants": [{"name": "acme", "guaranteed": {"cpu": 4.0}}],
+    },
+    "slo": {
+        **SLO_BASE,
+        "objectives": [
+            {"name": "bind-p99", "kind": "bind_latency_p99",
+             "target": 0.9, "threshold_seconds": 1.0, "per_tenant": True},
+        ],
+    },
+}
+
+
+class TestHarnessIntegration:
+    def slow_harness(self):
+        h = Harness(nodes=make_nodes(4), config=TENANT_SLO_CONFIG)
+        assert h.cluster.slo is not None
+        return h
+
+    def observe_slow_binds(self, h, n=10):
+        hist = h.cluster.metrics.histogram(
+            "grove_scheduler_tenant_bind_latency_seconds", ""
+        )
+        for _ in range(n):
+            hist.observe(5.0, tenant="acme")
+
+    def test_alert_emits_events_and_stamps_queue_condition(self):
+        h = self.slow_harness()
+        h.slo_sweep()  # baseline
+        for _ in range(2):
+            h.clock.advance(5.0)
+            self.observe_slow_binds(h)
+            h.slo_sweep()
+        firing = h.cluster.slo.firing()
+        assert {(f["slo"], f["tenant"]) for f in firing} == {
+            ("bind-p99", "acme"),
+        }
+        q = h.cluster.tenancy.queues["acme"]
+        cond = get_condition(q.conditions, SLO_VIOLATION_CONDITION)
+        assert cond is not None and cond.status == "True"
+        reasons = {e.reason for e in h.store.scan("Event")}
+        assert "SLOBurnRate" in reasons
+        # recovery: quiet sweeps resolve (the ticket pair's 30s short
+        # window lags the page's), clear the condition, and emit the
+        # recovered Event
+        for _ in range(8):
+            if not h.cluster.slo.firing():
+                break
+            h.clock.advance(5.0)
+            h.slo_sweep()
+        assert h.cluster.slo.firing() == []
+        cond = get_condition(q.conditions, SLO_VIOLATION_CONDITION)
+        assert cond.status == "False"
+        assert "SLORecovered" in {e.reason for e in h.store.scan("Event")}
+
+    def test_maybe_slo_sweep_honors_cadence(self):
+        h = self.slow_harness()
+        assert h.maybe_slo_sweep() is True  # first call always sweeps
+        assert h.maybe_slo_sweep() is False  # inside the interval
+        h.clock.advance(4.9)
+        assert h.maybe_slo_sweep() is False
+        h.clock.advance(0.2)
+        assert h.maybe_slo_sweep() is True
+
+    def test_disabled_harness_has_no_engine(self):
+        h = Harness(nodes=make_nodes(2))
+        assert getattr(h.cluster, "slo", None) is None
+        assert h.slo_sweep() is None
+        assert h.maybe_slo_sweep() is False
+        assert h.slo_scorecard() == {"enabled": False}
+        assert "slo" not in h.debug_dump()
+
+    def test_scorecard_surfaces(self):
+        h = self.slow_harness()
+        h.slo_sweep()
+        card = h.slo_scorecard()
+        assert card["enabled"] and card["source"] == "engine"
+        assert [e["slo"] for e in card["slos"]] == ["bind-p99"]
+        assert h.debug_dump()["slo"] == card
+        # the gRPC Debug service serves the same scorecard (injection
+        # only; callable without a server)
+        svc = PlacementService(slo=h.cluster.slo)
+        dump = json.loads(PlacementService.debug(svc, b""))
+        assert dump["slo"]["enabled"] is True
+        assert [e["slo"] for e in dump["slo"]["slos"]] == ["bind-p99"]
+        json.dumps(card)  # JSON-safe end to end
+
+    def test_engine_survives_cold_restart(self, tmp_path):
+        config = {
+            **TENANT_SLO_CONFIG,
+            "durability": {
+                "fsync": "never", "snapshot_interval_seconds": 30.0,
+                "wal_max_bytes": 65536, "wal_dir": str(tmp_path / "wal"),
+            },
+        }
+        h = Harness(nodes=make_nodes(4), config=config)
+        eng = h.cluster.slo
+        h.slo_sweep()
+        for _ in range(2):
+            h.clock.advance(5.0)
+            self.observe_slow_binds(h)
+            h.slo_sweep()
+        history_before = list(eng.history)
+        assert eng.firing()
+        stats = h.cold_restart()
+        assert stats["outcome"] == "clean"
+        # soft state: the engine object rides the cluster through the
+        # restart with rings, alert state and history intact
+        assert h.cluster.slo is eng
+        assert list(eng.history) == history_before
+        # and post-restart sweeps still work (Events now target the
+        # recovered store) — quiet intervals resolve the alert
+        for _ in range(8):
+            if not eng.firing():
+                break
+            h.clock.advance(5.0)
+            h.slo_sweep()
+        assert eng.firing() == []
+
+
+# -- shared verdict vocabulary (bench rides the same schema) ------------------
+
+class TestVerdictVocabulary:
+    def test_worst_verdict_ranks(self):
+        assert worst_verdict([]) == VERDICT_OK
+        assert worst_verdict([VERDICT_OK, VERDICT_BURNING]) == VERDICT_BURNING
+        assert worst_verdict(
+            [VERDICT_BURNING, VERDICT_BREACH, VERDICT_OK]
+        ) == VERDICT_BREACH
+
+    def test_static_entry_thresholds(self):
+        bad = static_entry("p99", "bind_latency_p99", 31.0, threshold=30.0,
+                           unit="seconds")
+        assert bad["verdict"] == VERDICT_BREACH
+        ok = static_entry("p99", "bind_latency_p99", 29.0, threshold=30.0)
+        assert ok["verdict"] == VERDICT_OK
+        # higher_is_better flips the comparison (sustained-rate floors)
+        rate = static_entry("rate", "sustained_rate", 4.0, threshold=5.0,
+                            higher_is_better=True)
+        assert rate["verdict"] == VERDICT_BREACH
+
+    def test_compose_scorecard_envelope(self):
+        card = compose_scorecard([
+            static_entry("a", "shed_count", 0.0),
+            static_entry("b", "bind_latency_p99", 2.0, threshold=1.0),
+        ])
+        assert card["source"] == "static"
+        assert card["verdict"] == VERDICT_BREACH
+        rendered = render_scorecard(card)
+        assert "BREACH" in rendered and "a" in rendered
+
+    def test_cli_renders_scorecard_files(self, tmp_path, capsys):
+        h = Harness(nodes=make_nodes(2), config={"slo": SLO_BASE})
+        h.slo_sweep()
+        path = tmp_path / "card.json"
+        path.write_text(json.dumps({"seeds": {"0": h.slo_scorecard()}}))
+        assert slo_main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "== 0 ==" in out and "verdict=" in out
+        assert slo_main([str(path), "--json"]) == 0
+        json.loads(capsys.readouterr().out)
+        assert slo_main(["--demo"]) == 0
+
+
+# -- chaos interplay (acceptance: lifecycle under fault, bit-identity) --------
+
+#: chaos-sized SLO config (scripts/chaos_sweep.py SLO_CONFIG shape):
+#: windows sized to the 2s chaos step and the post-storm drain
+CHAOS_SLO = {
+    "enabled": True,
+    "sync_interval_seconds": 4.0,
+    "budget_window_seconds": 600.0,
+    "pending_for_seconds": 0.0,
+    "page_short_seconds": 8.0,
+    "page_long_seconds": 24.0,
+    "page_burn_threshold": 5.0,
+    "ticket_short_seconds": 24.0,
+    "ticket_long_seconds": 80.0,
+    "ticket_burn_threshold": 2.0,
+    "objectives": [
+        # wall sized to the plain chaos workload's 80s storm: it places
+        # fast when healthy, so 10s of backlog is already a real stall
+        # (scripts/chaos_sweep.py gates the production 30s wall against
+        # its bigger storm workloads)
+        {"name": "starvation", "kind": "starvation",
+         "target": 0.98, "max_starved_seconds": 10.0},
+        {"name": "failover-wall", "kind": "failover_wall",
+         "target": 0.999, "max_failovers": 0},
+    ],
+}
+
+CHAOS_SLO_SEED = 3
+
+
+def run_chaos_seed(seed, slo):
+    ch = quiet(ChaosHarness(
+        FaultPlan.from_seed(seed),
+        nodes=make_nodes(NODES),
+        config={"slo": CHAOS_SLO} if slo else None,
+    ))
+    ch.apply(chaos_workload())
+    ch.run_chaos()
+    return ch
+
+
+@pytest.mark.chaos
+class TestChaosInterplay:
+    def test_seed_replays_bit_identically_with_slo_enabled(self):
+        """The acceptance invariant: SLO sweeps consume ZERO fault-plan
+        draws (Events ride the raw store), so a pre-existing seed's
+        fault sequence and settled state are bit-identical with the
+        evaluator on or off."""
+        plain = run_chaos_seed(CHAOS_SLO_SEED, slo=False)
+        with_slo = run_chaos_seed(CHAOS_SLO_SEED, slo=True)
+        assert with_slo.plan.counts == plain.plan.counts
+        assert with_slo.manager_restarts == plain.manager_restarts
+        assert settled_fingerprint(with_slo.raw_store) == (
+            settled_fingerprint(plain.raw_store)
+        )
+
+    def test_alerts_fire_during_fault_and_resolve_after_settle(self):
+        """The lifecycle gate: a violated SLO's alert must reach firing
+        DURING the storm (sweeps run through it on their cadence), and
+        the post-settle drain must resolve every one."""
+        ch = run_chaos_seed(CHAOS_SLO_SEED, slo=True)
+        eng = ch.harness.cluster.slo
+        fired = [h for h in eng.history if h["to"] == ALERT_FIRING]
+        assert fired, "no alert fired during the fault storm"
+        # drain on the sweep cadence until every alert resolves
+        for _ in range(80):
+            if not eng.firing():
+                break
+            ch.clock.advance(4.0)
+            ch.harness.slo_sweep(store=ch.raw_store)
+        assert eng.firing() == [], (
+            f"alerts failed to resolve after settle: {eng.firing()}"
+        )
+        resolved = [h for h in eng.history if h["to"] == ALERT_RESOLVED]
+        assert resolved
+        # and the postmortem artifact reflects the episode
+        card = ch.harness.slo_scorecard()
+        assert card["alert_history"]
