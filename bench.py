@@ -220,7 +220,7 @@ def main() -> int:
                     "over a mesh of ALL visible devices (1-device mesh on a "
                     "single chip; virtual CPU mesh under "
                     "xla_force_host_platform_device_count)")
-    ap.add_argument("--engine", choices=("fused", "delta", "full"),
+    ap.add_argument("--engine", choices=("fused", "delta", "full", "pallas"),
                     default="fused",
                     help="solve-path regime of the measured engine: "
                     "'fused' (the default, the deployed configuration) "
@@ -234,7 +234,11 @@ def main() -> int:
                     "engines run with the incremental re-solve OFF (a "
                     "repeated identical backlog would degenerate into "
                     "the zero-dispatch reuse tier); the incremental "
-                    "dirty-tick probes below measure it explicitly")
+                    "dirty-tick probes below measure it explicitly; "
+                    "'pallas' is the fused regime with the Pallas "
+                    "scoring kernel + on-device commit forced on "
+                    "(interpret-lowered off-TPU) and adds an "
+                    "interleaved kernel-vs-XLA device-seconds A/B")
     ap.add_argument("--equivalence", action="store_true",
                     help="instead of benchmarking, solve every scenario "
                     "(plain, grouped, dispatch/adopt + staled dispatch, "
@@ -505,7 +509,14 @@ def main() -> int:
     from grove_tpu.observability import MetricsRegistry
 
     state_cache = args.engine != "full"
-    fused = args.engine == "fused"
+    fused = args.engine in ("fused", "pallas")
+    # the pallas regime is the fused discipline with the kernel tiers
+    # forced on (the flat sharded mesh ignores them — its shard_map
+    # program is a documented capability miss)
+    pallas_knobs = (
+        {"pallas_core": True, "device_commit": True}
+        if args.engine == "pallas" else {}
+    )
     if args.sharded:
         from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
 
@@ -515,12 +526,16 @@ def main() -> int:
             kw.setdefault("state_cache", state_cache)
             kw.setdefault("fused", fused)
             kw.setdefault("incremental", False)
+            for k, v in pallas_knobs.items():
+                kw.setdefault(k, v)
             return ShardedPlacementEngine(snapshot, mesh, **kw)
     else:
         def mk_engine(**kw):
             kw.setdefault("state_cache", state_cache)
             kw.setdefault("fused", fused)
             kw.setdefault("incremental", False)
+            for k, v in pallas_knobs.items():
+                kw.setdefault(k, v)
             return PlacementEngine(snapshot, **kw)
 
     if args.equivalence:
@@ -691,8 +706,12 @@ def main() -> int:
     # fused: exactly one; incremental reuse: zero).
     disp = ds.get("dispatches", {})
     split["dispatches_by_kind"] = dict(disp)
+    # tier kinds attribute a launch already counted under its base kind
+    # (fused/split/incremental) — excluded so this stays a LAUNCH count
     split["dispatches_per_solve"] = round(
-        sum(disp.values()) / max(args.iters, 1), 2
+        sum(v for k, v in disp.items()
+            if k not in ("pallas", "device_commit"))
+        / max(args.iters, 1), 2
     )
 
     # Fused-vs-split A/B on identical blocking solves: the same backlog
@@ -757,6 +776,54 @@ def main() -> int:
             "incremental_rows_per_tick": round(rows / TICKS, 1),
             "incremental_vs_full_speedup": round(engine_wall / tick, 2),
         })
+
+    # Pallas kernel-vs-XLA A/B (--engine pallas): the SAME backlog
+    # through the kernel-tier engine and the XLA fused engine,
+    # interleaved, comparing the per-solve DEVICE phase (score + commit
+    # scan + D2H of the packed result — the phase the kernel rewrites).
+    # Off-TPU the kernel runs interpret-lowered (reported, and much
+    # slower — the speedup gate is native-lowering-only); the fields
+    # always carry the tier/backend so the JSON is self-describing.
+    if args.engine == "pallas":
+        pal_eng = mk_engine()
+        xla_eng = mk_engine(pallas_core=False, device_commit=False)
+        pal_eng.solve(gangs, free=snapshot.free.copy())  # warm-up
+        xla_eng.solve(gangs, free=snapshot.free.copy())
+        dev_secs = {"pallas": [], "xla": []}
+
+        def timed_side(eng, side):
+            def run(_i):
+                t0 = time.perf_counter()
+                res = eng.solve(gangs, free=snapshot.free.copy())
+                dev_secs[side].append(res.stats.get("device_seconds", 0.0))
+                return time.perf_counter() - t0
+            return run
+
+        p_walls, x_walls = interleaved_ab(
+            timed_side(pal_eng, "pallas"), timed_side(xla_eng, "xla"),
+            max(3, args.iters // 2),
+        )
+        pal_ds = pal_eng.debug_summary()["device_state"]
+        inc_fields["pallas_ab"] = {
+            "kernel_tier": pal_ds["core_tier"],
+            "pallas_interpret": pal_ds["pallas_interpret"],
+            "device_commit": pal_ds["device_commit"],
+            "pallas_dispatches": pal_ds["dispatches"].get("pallas", 0),
+            "device_commit_dispatches": pal_ds["dispatches"].get(
+                "device_commit", 0
+            ),
+            "pallas_fallbacks": pal_ds["pallas_fallbacks"],
+            "pallas_device_p50_seconds": round(p50(dev_secs["pallas"]), 4),
+            "xla_device_p50_seconds": round(p50(dev_secs["xla"]), 4),
+            # > 1.0 = the kernel tier's device phase is cheaper
+            "device_seconds_speedup": round(
+                p50(dev_secs["xla"]) / max(p50(dev_secs["pallas"]), 1e-9),
+                3,
+            ),
+            **wall_stats(p_walls, "pallas_", suffix="bind_seconds"),
+            **wall_stats(x_walls, "xla_", suffix="bind_seconds"),
+            "interleaved": True,
+        }
 
     # Scale-ceiling probes (VERDICT r3 #8 + r4 #9): datapoints at 2x and
     # 4x the north star proving the bucketing/padding strategy and memory
@@ -943,7 +1010,16 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
     (score-equal vs flat — see section 7) and the WAVE-PARALLEL fine
     phase (section 8), which must stay BITWISE equal to the serial
     workers=0 wave driver, with its own never-ran-a-multi-domain-wave
-    vacuity guard."""
+    vacuity guard.
+
+    The PALLAS kernel tiers (section 9 + the pallas / pallas-commit
+    candidates) grow the n-way to four: the fp32 scoring kernel and the
+    on-device greedy commit are BITWISE candidates (same arithmetic,
+    same candidate walk), the hierarchical candidate runs its
+    sub-engines on the kernel tier, a bf16 run pins the documented
+    reduced-precision tie policy (placed set / unplaced codes /
+    committed totals invariant), and kernel-never-ran or
+    silent-fallback turns the gate vacuous -> nonzero exit."""
     import dataclasses
 
     eng_f = mk_engine(state_cache=False, fused=False, incremental=False)
@@ -954,6 +1030,21 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
                            fused=True, incremental=False),
         "inc": mk_engine(state_cache=True, state_verify=True,
                          fused=True, incremental=True),
+        # the kernel tiers (PR: one-kernel solve): the fused program
+        # with the Pallas fp32 scoring kernel, then additionally the
+        # on-device greedy commit — both BITWISE against the reference
+        # (fp32 kernel replicates the XLA arithmetic op-for-op; the
+        # commit scan replays the candidate walk at aggregate
+        # granularity, conflicts fall to the same serial net). On a
+        # flat sharded mesh the knobs resolve off (capability miss) and
+        # these rows degenerate into fused re-runs — the kernel
+        # coverage guards below are gated accordingly.
+        "pallas": mk_engine(state_cache=True, state_verify=True,
+                            fused=True, incremental=False,
+                            pallas_core=True),
+        "pallas-commit": mk_engine(state_cache=True, state_verify=True,
+                                   fused=True, incremental=False,
+                                   pallas_core=True, device_commit=True),
     }
     rng = np.random.default_rng(7)
     n = snapshot.num_nodes
@@ -1202,8 +1293,13 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
     #    bitwise tiers above.
     from grove_tpu.observability.explain import unsat_code
 
+    # the hierarchical candidate ALSO runs the kernel tier (where it
+    # resolves on): its per-domain sub-engines inherit pallas_core, so
+    # the dirty-tick/churn scenarios below double as the hierarchical
+    # kernel-equivalence coverage
     eng_h = mk_engine(hierarchical=True, state_cache=True,
-                      state_verify=True, fused=True, incremental=True)
+                      state_verify=True, fused=True, incremental=True,
+                      pallas_core=True)
     hier_pruned = 0
     hier_solves = 0
 
@@ -1471,6 +1567,68 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         failures.append("wave: the workers=0 reference resolved a "
                         "nonzero wave width")
 
+    # 9) reduced-precision tie policy (pallas_precision="bf16"): the
+    #    kernel accumulates the score chain in bf16, so values may move
+    #    by a quantization epsilon and re-rank exact-tie neighbors —
+    #    the pin is NOT bitwise. The documented+pinned contract
+    #    (docs/scheduling.md): feasibility masks stay fp32-exact in
+    #    both tiers and the host repair backstops every candidate walk
+    #    with the complete serial net, so the PLACED SET, the unplaced
+    #    reason codes, and the committed per-resource totals are
+    #    invariant; only within-epsilon candidate order may shift.
+    eng_bf = mk_engine(state_cache=True, fused=True, incremental=False,
+                       pallas_core=True, pallas_precision="bf16")
+    if eng_bf.pallas_core:
+        free_c, free_f = snapshot.free.copy(), snapshot.free.copy()
+        res_f = eng_f.solve(gangs, free=free_f)
+        res_c = eng_bf.solve(gangs, free=free_c)
+        solves += 1
+        if sorted(res_c.placed) != sorted(res_f.placed):
+            failures.append("bf16-tie-policy: placed sets differ")
+        for gname, reason_f in res_f.unplaced.items():
+            if unsat_code(res_c.unplaced.get(gname)) != unsat_code(
+                reason_f
+            ):
+                failures.append(
+                    f"bf16-tie-policy: {gname} unplaced code differs"
+                )
+        if not np.allclose(
+            free_c.sum(axis=0), free_f.sum(axis=0), rtol=1e-5, atol=1e-3
+        ):
+            failures.append(
+                "bf16-tie-policy: committed per-resource totals diverge"
+            )
+
+    # kernel-tier coverage: where the knobs resolved ON, the tiers must
+    # have actually run (and never silently fallen back) — a vacuous
+    # pass must not read as kernel equivalence. On a flat sharded mesh
+    # the knobs resolve off by design (capability miss) and only the
+    # hierarchical sub-engine guard below applies.
+    pal_ds = candidates["pallas"].debug_summary()["device_state"]
+    pc_ds = candidates["pallas-commit"].debug_summary()["device_state"]
+    for nm in ("pallas", "pallas-commit"):
+        nds = candidates[nm].debug_summary()["device_state"]
+        if nds["pallas_fallbacks"]:
+            failures.append(
+                f"{nm}: kernel launch fell back to XLA "
+                f"({nds['pallas_fallbacks']}x)"
+            )
+    if candidates["pallas"].pallas_core and (
+        pal_ds["dispatches"].get("pallas", 0) == 0
+    ):
+        failures.append("coverage: the pallas kernel tier never ran — "
+                        "the four-way gate is vacuous")
+    if candidates["pallas-commit"].device_commit and (
+        pc_ds["dispatches"].get("device_commit", 0) == 0
+    ):
+        failures.append("coverage: the on-device commit tier never ran "
+                        "— the four-way gate is vacuous")
+    if eng_h._hier_pallas_core and (
+        hier_ds["device_state"]["dispatches"].get("pallas", 0) == 0
+    ):
+        failures.append("coverage: the hierarchical sub-engines never "
+                        "ran the kernel tier")
+
     # the gate is only meaningful if the incremental tiers actually ran
     inc_ds = candidates["inc"].debug_summary()["device_state"]
     if check_paths and inc_ds["dispatches"]["incremental"] == 0:
@@ -1482,8 +1640,8 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
 
     ds = candidates["delta"].debug_summary()["device_state"]
     out = {
-        "metric": "delta/fused/incremental vs full placement equivalence "
-        f"({args.gangs} x 8-pod gangs, {args.nodes} nodes)",
+        "metric": "delta/fused/incremental/pallas vs full placement "
+        f"equivalence ({args.gangs} x 8-pod gangs, {args.nodes} nodes)",
         "value": len(failures),
         "unit": "divergences",
         "vs_baseline": 0.0,
@@ -1501,6 +1659,15 @@ def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
         "hier_incremental_dispatches": (
             hier_ds["device_state"]["dispatches"]["incremental"]
         ),
+        "pallas_kernel_tier": pal_ds["core_tier"],
+        "pallas_dispatches": pal_ds["dispatches"].get("pallas", 0),
+        "device_commit_dispatches": pc_ds["dispatches"].get(
+            "device_commit", 0
+        ),
+        "hier_pallas_dispatches": (
+            hier_ds["device_state"]["dispatches"].get("pallas", 0)
+        ),
+        "bf16_tie_policy_checked": bool(eng_bf.pallas_core),
         "engine": "sharded" if args.sharded else "single",
         "backend": __import__("jax").default_backend(),
     }
@@ -1715,6 +1882,54 @@ def bench_scale_tier(args) -> int:
         f_walls = [w for w in f_walls if w is not None]
     else:
         hf_walls, f_walls = [], []
+
+    # phase C (--engine pallas): kernel-vs-XLA on the FINE phase — two
+    # fresh hierarchical engines (kernel tiers on vs off) over the same
+    # dirty-ticked backlog stream, back-to-back per tick, comparing the
+    # per-solve hier_fine_seconds (the phase whose sub-engine launches
+    # the kernel rewrites). Interpret-lowered off-TPU, reported as such.
+    pallas_fine = {}
+    if args.engine == "pallas":
+        hp = mk(hierarchical=True, hier_parallel_workers=args.wave_workers,
+                pallas_core=True, device_commit=True)
+        hx = mk(hierarchical=True, hier_parallel_workers=args.wave_workers)
+        hp.decisions = None
+        hx.decisions = None
+        for eng in (hp, hx):  # warm: compile + shards + one dirty tick
+            eng.solve(state["backlog"], free=snapshot.free.copy())
+        state["backlog"] = dirty_tick(state["backlog"], 2000)
+        for eng in (hp, hx):
+            eng.solve(state["backlog"], free=snapshot.free.copy())
+        fine_c = {"pallas": [], "xla": []}
+
+        def run_kernel_side(side, eng):
+            res = eng.solve(state["backlog"], free=snapshot.free.copy())
+            fine_c[side].append(res.stats.get("hier_fine_seconds", 0.0))
+
+        for rep in range(repeats + repeats % 2):
+            state["backlog"] = dirty_tick(state["backlog"], 2001 + rep)
+            order = (("pallas", hp), ("xla", hx))
+            for side, eng in (order if rep % 2 == 0 else order[::-1]):
+                run_kernel_side(side, eng)
+        hp_ds = hp.debug_summary()["device_state"]
+        pallas_fine = {
+            "pallas_fine_ab": {
+                "kernel_tier": hp_ds["core_tier"],
+                "pallas_interpret": hp_ds["pallas_interpret"],
+                "pallas_dispatches": hp_ds["dispatches"].get("pallas", 0),
+                "device_commit_dispatches": hp_ds["dispatches"].get(
+                    "device_commit", 0
+                ),
+                "pallas_fallbacks": hp_ds["pallas_fallbacks"],
+                **wall_stats(fine_c["pallas"], "pallas_fine_"),
+                **wall_stats(fine_c["xla"], "xla_fine_"),
+                "fine_device_speedup": round(
+                    p50(fine_c["xla"]) / max(p50(fine_c["pallas"]), 1e-9),
+                    3,
+                ),
+                "interleaved": True,
+            }
+        }
     placed = state["placed"]
     ds = hier.debug_summary()
     disp = ds["device_state"]["dispatches"]
@@ -1741,6 +1956,13 @@ def bench_scale_tier(args) -> int:
             "multi-domain wave — the wave A/B is vacuous"
         )
     local_devices = len(mesh.local_devices) if mesh is not None else 1
+    if pallas_fine:
+        pab = pallas_fine["pallas_fine_ab"]
+        if pab["kernel_tier"] != "xla" and pab["pallas_dispatches"] == 0:
+            failures.append(
+                "coverage: --engine pallas fine phase never launched the "
+                "kernel tier — the pallas A/B is vacuous"
+            )
     if wave_workers >= 1 and local_devices >= 2 and fine_speedup <= 1.0:
         # the mesh gate (ROADMAP item 1 follow-up): with the domains
         # round-robined across >= 2 devices, dispatch-all/collect-in-
@@ -1804,6 +2026,7 @@ def bench_scale_tier(args) -> int:
             "bind_speedup_p50": round(p50(s_walls) / tier_p50, 2),
             "interleaved": True,
         },
+        **pallas_fine,
         "dispatches_by_kind": dict(disp),
         "incremental_rows": ds["device_state"]["incremental_rows"],
         "reuse_hits": ds["device_state"]["reuse_hits"],

@@ -190,6 +190,30 @@ class SolverConfig:
     # fan-out on sharded engines); 0 = the serial one-domain-at-a-time
     # fine phase.
     hier_parallel_workers: int | None = None
+    # Pallas execution tier of the scoring core (solver/pallas_core.py):
+    # the [G, D] value tensor computed by a tiled kernel (mask +
+    # per-level score + slack reduce fused per tile) instead of the XLA
+    # elementwise chain. None = auto — on only where pallas lowers
+    # NATIVELY for the backend (TPU); CPU auto-resolves OFF so tests and
+    # chaos seeds replay bit-identically, and an explicit True on CPU
+    # runs the kernel interpreted (equivalence smokes). Any capability
+    # miss at launch falls back permanently to the XLA fused path.
+    pallas_core: bool | None = None
+    # On-device greedy commit over the packed top-k (pure lax, no pallas
+    # dependency): the fine-solve D2H ships one committed (value,
+    # domain) pair per gang instead of the [G, 2K] candidate list, and
+    # host repair does conflict-only work (aggregate-infeasible
+    # candidates are provably exact-infeasible, so the skip is sound;
+    # node-granularity conflicts still fall to the serial exactness
+    # net). Same auto default as pallas_core.
+    device_commit: bool | None = None
+    # Score accumulation dtype of the kernel tier: "fp32" is bit-equal
+    # to the XLA path; "bf16" accumulates the slack/value arithmetic in
+    # bfloat16 — coarser quanta that may merge near-ties WITHIN a level
+    # band (cross-level ordering is preserved). bf16 ships only under
+    # the equivalence gate's documented tie policy (docs/scheduling.md
+    # "One-kernel solve").
+    pallas_precision: str = "fp32"
 
 
 #: built-in priority-tier ladder seeded as PriorityClass objects when
@@ -906,6 +930,23 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
         errs.append(
             "config.solver.hier_parallel_workers: must be None (auto) or "
             "an int >= 0 (0 = serial fine solves)"
+        )
+    if sv.pallas_core is not None and not isinstance(sv.pallas_core, bool):
+        errs.append(
+            "config.solver.pallas_core: must be None (auto: on where "
+            "pallas lowers natively) or a bool"
+        )
+    if sv.device_commit is not None and not isinstance(
+        sv.device_commit, bool
+    ):
+        errs.append(
+            "config.solver.device_commit: must be None (auto: follows "
+            "the kernel tier's native capability) or a bool"
+        )
+    if sv.pallas_precision not in ("fp32", "bf16"):
+        errs.append(
+            "config.solver.pallas_precision: must be 'fp32' (bit-equal) "
+            "or 'bf16' (documented tie policy; equivalence-gated)"
         )
 
     errs += _validate_tenancy(cfg.tenancy)

@@ -136,6 +136,20 @@ class GangScheduler:
             self._engine_kwargs["hier_min_nodes"] = (
                 cfg.solver.hierarchical_min_nodes
             )
+        # Pallas kernel tier + on-device commit (solver/pallas_core.py),
+        # same capability gating; the engine resolves the auto defaults
+        # against the backend's actual pallas capability and falls back
+        # to the XLA fused path on any miss
+        if accepts_kwarg(engine_cls, "pallas_core"):
+            self._engine_kwargs["pallas_core"] = cfg.solver.pallas_core
+        if accepts_kwarg(engine_cls, "device_commit"):
+            self._engine_kwargs["device_commit"] = (
+                cfg.solver.device_commit
+            )
+        if accepts_kwarg(engine_cls, "pallas_precision"):
+            self._engine_kwargs["pallas_precision"] = (
+                cfg.solver.pallas_precision
+            )
         if accepts_kwarg(engine_cls, "hier_parallel_workers"):
             # wave-parallel fine solves (engine.py _run_wave): the
             # dispatch-all/collect-in-order width of the hierarchical

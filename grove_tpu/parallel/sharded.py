@@ -182,6 +182,18 @@ class ShardedPlacementEngine(PlacementEngine):
         #: once (self._hier_incremental, captured by the base __init__
         #: before this override, is what sub-engines inherit).
         self.incremental = False
+        #: the Pallas kernel tier and on-device commit are likewise
+        #: single-device only on the flat path: the mesh's scoring runs
+        #:  the shard_map program below (its own XLA pipeline), so the
+        #: kernel tier here is a CAPABILITY MISS and the engine keeps
+        #: the XLA fused behavior. The domain-sharded HIERARCHY is where
+        #: both knobs apply on a mesh: each coarse domain's sub-engine
+        #: is a whole single-device PlacementEngine and inherits the
+        #: requested knobs (self._hier_pallas_core /
+        #: self._hier_device_commit, captured by the base __init__
+        #: before this override).
+        self.pallas_core = False
+        self.device_commit = False
         self.mesh = mesh
         self._fn = sharded_score_fn(
             mesh,

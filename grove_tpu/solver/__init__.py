@@ -22,6 +22,7 @@ search strategy differs.
 """
 
 from .fit import place_gang_in_domain, placement_score_for_nodes
+from .pallas_core import pallas_capability
 from .problem import SolverGang, encode_podgangs
 from .result import GangPlacement, SolveResult
 from .serial import solve_serial
@@ -33,6 +34,7 @@ __all__ = [
     "SolveResult",
     "SolverGang",
     "encode_podgangs",
+    "pallas_capability",
     "place_gang_in_domain",
     "placement_score_for_nodes",
     "solve_serial",

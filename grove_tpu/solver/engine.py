@@ -87,6 +87,12 @@ from .hierarchy import (
     coarse_admissible,
     coarse_assign,
 )
+from .pallas_core import (
+    device_commit_scan,
+    interpret_default,
+    pallas_capability,
+    pallas_value,
+)
 from .problem import SolverGang
 from .result import GangPlacement, SolveResult
 from .serial import _place_one, gang_sort_key, stamp_fairness
@@ -275,7 +281,8 @@ def commit_scan(value, dom_free, anc_ids, total_demand, top_k: int,
 
 def _score_core(free, gdom, dom_level, anc_ids, gang_pack, u_pack,
                 elig_masks, cap_scale, *, num_domains, top_k, chunk,
-                num_res):
+                num_res, pallas_tier=None, pallas_interpret=False,
+                device_commit=False):
     """Shared device scoring body of every program variant (split, fused,
     incremental): value tensor + commit scan from the masked free state
     and the unpacked gang rows. Per-row arithmetic is deliberately
@@ -283,7 +290,16 @@ def _score_core(free, gdom, dom_level, anc_ids, gang_pack, u_pack,
     which is what lets the incremental program reuse cached value rows
     bit-equal across solves. Returns (packed top-k, value [G, D],
     total_demand [G, R]) — the latter two stay device-resident on the
-    fused path as the incremental re-solve's caches."""
+    fused path as the incremental re-solve's caches.
+
+    `pallas_tier` ("fp32" | "bf16" | None) swaps the value tensor onto
+    the tiled Pallas kernel (solver/pallas_core.py; fp32 is bit-equal to
+    the XLA path, bf16 is the documented-tie-policy precision tier);
+    `device_commit` re-walks the packed top-k on-device so `packed`
+    carries ONE committed (value, domain) pair per gang — [G, 2] instead
+    of [G, 2K] — and the host repair does conflict-only work. All three
+    are jit-statics: each (tier, commit) combination is its own compiled
+    program."""
     r = num_res
     total_demand = gang_pack[:, :r]
     required_level = gang_pack[:, r].astype(jnp.int32)
@@ -306,13 +322,24 @@ def _score_core(free, gdom, dom_level, anc_ids, gang_pack, u_pack,
         free[None, :, :] + 1e-6 >= u_sig_demand[:, None, :], axis=-1
     ).astype(jnp.float32) * elig_masks[u_sig_mask]          # [U, N]
     cnt_fit = (node_fits @ m)[sig_idx].min(axis=1)          # [G, D]
-    value = value_from_aggregates(
-        dom_free, cnt_fit, dom_level, total_demand, required_level,
-        preferred_level, valid, cap_scale, fairness,
-    )
+    if pallas_tier:
+        value = pallas_value(
+            dom_free, cnt_fit, dom_level, total_demand, required_level,
+            preferred_level, valid, cap_scale, fairness,
+            precision=pallas_tier, interpret=pallas_interpret,
+        )
+    else:
+        value = value_from_aggregates(
+            dom_free, cnt_fit, dom_level, total_demand, required_level,
+            preferred_level, valid, cap_scale, fairness,
+        )
     top_val, top_dom = commit_scan(
         value, dom_free, anc_ids, total_demand, top_k, chunk
     )
+    if device_commit:
+        top_val, top_dom = device_commit_scan(
+            top_val, top_dom, dom_free, anc_ids, total_demand
+        )
     # Pack both outputs into ONE array: a host fetch through the dev
     # tunnel has large fixed latency, so results ship in a single
     # transfer (domain ids < 2^24 are exact in f32).
@@ -324,7 +351,8 @@ def _score_core(free, gdom, dom_level, anc_ids, gang_pack, u_pack,
     jax.jit,
     static_argnames=(
         "num_domains", "top_k", "chunk", "num_res", "num_gangs",
-        "num_sigs", "sig_width",
+        "num_sigs", "sig_width", "pallas_tier", "pallas_interpret",
+        "device_commit",
     ),
 )
 def _device_score(
@@ -350,6 +378,9 @@ def _device_score(
     num_gangs: int,
     num_sigs: int,
     sig_width: int,
+    pallas_tier: str | None = None,
+    pallas_interpret: bool = False,
+    device_commit: bool = False,
 ):
     """SPLIT scoring program (the pre-fused path, kept for `fused=False`
     engines and the bench A/B): score only — free-state delta uploads run
@@ -361,7 +392,8 @@ def _device_score(
     packed, _, _ = _score_core(
         free, gdom, dom_level, anc_ids, gang_pack, u_pack, elig_masks,
         cap_scale, num_domains=num_domains, top_k=top_k, chunk=chunk,
-        num_res=r,
+        num_res=r, pallas_tier=pallas_tier,
+        pallas_interpret=pallas_interpret, device_commit=device_commit,
     )
     return packed
 
@@ -380,6 +412,8 @@ def _fused_score_impl(
     *,
     num_domains: int, top_k: int, chunk: int, num_res: int,
     num_gangs: int, num_sigs: int, sig_width: int, num_upd: int,
+    pallas_tier: str | None = None, pallas_interpret: bool = False,
+    device_commit: bool = False,
 ):
     """FUSED program: staged delta apply -> score -> commit scan in one
     launch. Returns (free', packed, value, total_demand); free' replaces
@@ -398,14 +432,16 @@ def _fused_score_impl(
     packed, value, total_demand = _score_core(
         free, gdom, dom_level, anc_ids, gang_pack, u_pack, elig_masks,
         cap_scale, num_domains=num_domains, top_k=top_k, chunk=chunk,
-        num_res=r,
+        num_res=r, pallas_tier=pallas_tier,
+        pallas_interpret=pallas_interpret, device_commit=device_commit,
     )
     return free, packed, value, total_demand
 
 
 _FUSED_STATICS = (
     "num_domains", "top_k", "chunk", "num_res", "num_gangs", "num_sigs",
-    "sig_width", "num_upd",
+    "sig_width", "num_upd", "pallas_tier", "pallas_interpret",
+    "device_commit",
 )
 _fused_score = jax.jit(_fused_score_impl, static_argnames=_FUSED_STATICS)
 #: donated variant: the stale resident free buffer aliases into the
@@ -421,6 +457,7 @@ _fused_score_donated = jax.jit(
     static_argnames=(
         "num_domains", "top_k", "chunk", "num_res", "num_gangs",
         "cache_rows", "num_dirty", "num_sigs", "sig_width",
+        "pallas_tier", "pallas_interpret", "device_commit",
     ),
 )
 def _inc_score(
@@ -439,7 +476,8 @@ def _inc_score(
     *,
     num_domains: int, top_k: int, chunk: int, num_res: int,
     num_gangs: int, cache_rows: int, num_dirty: int, num_sigs: int,
-    sig_width: int,
+    sig_width: int, pallas_tier: str | None = None,
+    pallas_interpret: bool = False, device_commit: bool = False,
 ):
     """INCREMENTAL dirty-row re-solve: gather unchanged gangs' value rows
     from the resident cache through `perm`, re-score only the dirty rows
@@ -482,15 +520,28 @@ def _inc_score(
         free[None, :, :] + 1e-6 >= u_sig_demand[:, None, :], axis=-1
     ).astype(jnp.float32) * elig_masks[u_sig_mask]          # [U', N]
     cnt_fit_d = (node_fits @ m)[sig_idx_d].min(axis=1)      # [K, D]
-    value_d = value_from_aggregates(
-        dom_free, cnt_fit_d, dom_level, td_d, req_d, pref_d, valid_d,
-        cap_scale, fair_d,
-    )
+    if pallas_tier:
+        # same tier as the full program so cached + re-scored rows mix
+        # consistently (fp32: both bit-equal to XLA; bf16: both bf16)
+        value_d = pallas_value(
+            dom_free, cnt_fit_d, dom_level, td_d, req_d, pref_d, valid_d,
+            cap_scale, fair_d, precision=pallas_tier,
+            interpret=pallas_interpret,
+        )
+    else:
+        value_d = value_from_aggregates(
+            dom_free, cnt_fit_d, dom_level, td_d, req_d, pref_d, valid_d,
+            cap_scale, fair_d,
+        )
     value_new = value_base.at[dirty_pos].set(value_d, mode="drop")
     td_new = td_base.at[dirty_pos].set(td_d, mode="drop")
     top_val, top_dom = commit_scan(
         value_new, dom_free, anc_ids, td_new, top_k, chunk
     )
+    if device_commit:
+        top_val, top_dom = device_commit_scan(
+            top_val, top_dom, dom_free, anc_ids, td_new
+        )
     packed = jnp.concatenate([top_val, top_dom.astype(jnp.float32)], axis=1)
     return packed, value_new, td_new
 
@@ -680,6 +731,9 @@ class PlacementEngine:
         hier_min_nodes: int = 0,
         hier_parallel_workers: int | None = None,
         device=None,
+        pallas_core: bool | None = None,
+        device_commit: bool | None = None,
+        pallas_precision: str = "fp32",
     ):
         self.snapshot = snapshot
         self.space = DomainSpace(snapshot)
@@ -760,6 +814,40 @@ class PlacementEngine:
         #: configuration degrades to the full fused solve, never to an
         #: unsound re-score.
         self.incremental = incremental and fused and state_cache
+        #: Pallas execution tier (solver/pallas_core.py): the value
+        #: tensor computed by the tiled kernel instead of the XLA fused
+        #: elementwise chain. None = auto — on only where pallas lowers
+        #: natively for the backend (TPU); an explicit True on CPU runs
+        #: the kernel INTERPRETED (tests/CI equivalence; slow). False,
+        #: or pallas missing entirely, keeps the XLA fused path.
+        if pallas_precision not in ("fp32", "bf16"):
+            raise ValueError(
+                "pallas_precision must be 'fp32' or 'bf16', got "
+                f"{pallas_precision!r}"
+            )
+        cap = pallas_capability()
+        if pallas_core is None:
+            self.pallas_core = cap == "native"
+        else:
+            self.pallas_core = bool(pallas_core) and cap is not None
+        self._pallas_interpret = interpret_default()
+        #: on-device greedy commit over the packed top-k (pure lax, no
+        #: pallas dependency): the D2H ships one committed (value,
+        #: domain) pair per gang instead of the [G, 2K] candidate list,
+        #: and host repair becomes conflict-only. Same auto default as
+        #: the kernel tier so CPU tests/chaos seeds replay bit-identical
+        #: with default knobs.
+        if device_commit is None:
+            self.device_commit = cap == "native"
+        else:
+            self.device_commit = bool(device_commit)
+        #: score accumulation dtype of the kernel tier: "fp32" is
+        #: bit-equal to XLA; "bf16" is the reduced-precision tier that
+        #: ships only under the equivalence gate's documented tie policy
+        self.pallas_precision = pallas_precision
+        #: capability-miss fallbacks taken (kernel launch failed to
+        #: lower/compile; the engine permanently reverted to XLA fused)
+        self._pallas_fallbacks = 0
         #: staged delta rows awaiting the next fused dispatch:
         #: {row index -> new masked row values}. Merged across syncs
         #: (a re-staged row keeps only its latest values); superseded by
@@ -773,6 +861,9 @@ class PlacementEngine:
         self._last_begin: dict = {}
         #: device-program launch counters by path kind, mirrored to the
         #: grove_solver_dispatches_total metric and debug_summary
+        # tier kinds ("pallas", "device_commit") appear lazily on first
+        # count: tier attribution of a launch already counted under its
+        # base kind above, not an extra launch (docs/observability.md)
         self._dispatches = {
             "fused": 0, "split": 0, "incremental": 0, "whatif": 0,
         }
@@ -806,6 +897,11 @@ class PlacementEngine:
         #: forces its own (flat-path) incremental off — sub-engines are
         #: single-device, so the mesh restriction does not apply to them
         self._hier_incremental = self.incremental
+        #: ditto for the kernel tier: captured before the mesh engine
+        #: forces its flat-path pallas/device-commit off — domain-sharded
+        #: sub-engines are single-device, so they inherit the request
+        self._hier_pallas_core = self.pallas_core
+        self._hier_device_commit = self.device_commit
         self._hier: HierarchyState | None = None
         #: rows the last _sync_free observed changed (None = full
         #: upload / unknown scope) — fanned out to the hierarchy's
@@ -1114,12 +1210,65 @@ class PlacementEngine:
         always exactly one launch per solve."""
         if n <= 0:
             return
-        self._dispatches[kind] += n
+        self._dispatches[kind] = self._dispatches.get(kind, 0) + n
         if self.metrics is not None:
             self.metrics.counter(
                 "grove_solver_dispatches_total",
                 "device program launches by solve path kind",
             ).inc(float(n), kind=kind)
+
+    def _kernel_tier(self) -> str:
+        """Active scoring-core tier, the debug/span vocabulary: "xla" or
+        "pallas-<precision>"."""
+        if self.pallas_core:
+            return "pallas-" + self.pallas_precision
+        return "xla"
+
+    def _score_statics(self) -> dict:
+        """Per-launch kernel-tier statics for the scoring programs, read
+        FRESH at every launch so a capability-miss fallback (which flips
+        the flags) retraces onto the plain XLA program."""
+        return {
+            "pallas_tier": (
+                self.pallas_precision if self.pallas_core else None
+            ),
+            "pallas_interpret": self._pallas_interpret,
+            "device_commit": self.device_commit,
+        }
+
+    def _guard_kernel(self, launch):
+        """Run a scoring launch; any failure while the Pallas tier or the
+        on-device commit is active is treated as a capability miss — the
+        engine permanently falls back to the XLA fused path (and tells
+        its future hierarchy sub-engines to do the same), counts the
+        fallback, and relaunches. With both tiers off this is a plain
+        call: real errors surface unchanged."""
+        if not (self.pallas_core or self.device_commit):
+            return launch()
+        try:
+            return launch()
+        except Exception:
+            self._pallas_fallbacks += 1
+            self.pallas_core = False
+            self.device_commit = False
+            self._hier_pallas_core = False
+            self._hier_device_commit = False
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "grove_solver_pallas_fallbacks_total",
+                    "kernel-tier capability misses that reverted the "
+                    "engine to the XLA fused path",
+                ).inc()
+            return launch()
+
+    def _count_kernel_tiers(self) -> None:
+        """Attribute the launch that just ran to its kernel tiers (the
+        base kind — fused/split/incremental — is counted by the caller;
+        these are tier attributions of the SAME launch)."""
+        if self.pallas_core:
+            self._count_dispatch_kind("pallas")
+        if self.device_commit:
+            self._count_dispatch_kind("device_commit")
 
     def _count_inc_rows(self, rows: int) -> None:
         self._inc_rows_total += rows
@@ -1222,6 +1371,8 @@ class PlacementEngine:
                 lb = self._last_begin
                 dsp.set(
                     path=lb.get("path"), rows=lb.get("rows"),
+                    kernel=lb.get("kernel", "xla"),
+                    device_commit=bool(lb.get("commit")),
                     encode_seconds=round(time.perf_counter() - t0, 6),
                 )
         keep_free = not self.state_cache or self.state_verify
@@ -1350,10 +1501,12 @@ class PlacementEngine:
                 self._ensure_statics()
             )
             g_pad, r = enc.total_demand.shape
-            _, packed, _, _ = _fused_score(
+            io_dev = self._to_device(io)
+            masks_dev = self._masks_to_device(elig_masks)
+            _, packed, _, _ = self._guard_kernel(lambda: _fused_score(
                 st.dev, gdom_d, dom_level_d, anc_ids_d,
-                self._to_device(io),
-                self._masks_to_device(elig_masks),
+                io_dev,
+                masks_dev,
                 cap_scale_d,
                 num_domains=self.space.num_domains,
                 top_k=min(self.top_k, self.space.num_domains),
@@ -1363,8 +1516,14 @@ class PlacementEngine:
                 num_sigs=u_sig_demand.shape[0],
                 sig_width=sig_idx.shape[1],
                 num_upd=0 if upd is None else upd.shape[0],
-            )
+                # kernel tier rides along (what-if scores must rank like
+                # the real solve's), but device_commit NEVER does: the
+                # defrag caller consumes the full top-k alternates list
+                **dict(self._score_statics(), device_commit=False),
+            ))
             self._count_dispatch_kind("whatif")
+            if self.pallas_core:
+                self._count_dispatch_kind("pallas")
             self._count_bytes("whatif", io.nbytes)
             packed = np.asarray(packed)
             self._count_bytes("results", packed.nbytes)
@@ -1421,6 +1580,9 @@ class PlacementEngine:
             fused=self.fused,
             incremental=self._hier_incremental,
             device=self._sub_device(shard.dom),
+            pallas_core=self._hier_pallas_core,
+            device_commit=self._hier_device_commit,
+            pallas_precision=self.pallas_precision,
         )
         # the parent records placements/diagnoses at ITS level; letting
         # every sub-engine ring-record too would double-count each gang
@@ -2076,6 +2238,10 @@ class PlacementEngine:
             if self.fused:
                 fsp.set(
                     path=path,
+                    # engine.kernel attrs: which scoring core ran and
+                    # whether the commit scan shipped placements
+                    kernel=self._kernel_tier(),
+                    device_commit=self.device_commit,
                     encode_seconds=round(result.stats["encode_seconds"], 6),
                     device_seconds=round(result.stats["device_seconds"], 6),
                     repair_seconds=round(result.stats["repair_seconds"], 6),
@@ -2441,15 +2607,18 @@ class PlacementEngine:
             if jax.default_backend() == "cpu"
             else _fused_score_donated
         )
-        free2, packed, value, td = fn(
-            self._state.dev,
-            gdom_d, dom_level_d, anc_ids_d,
+        io_dev = self._io_to_device(
             # the staged-delta block was already counted as state_delta
             # at stage time — discount it here so the per-kind transport
             # counters stay disjoint (their sum is total traffic)
-            self._io_to_device(io, discount=0 if upd is None
-                               else upd.nbytes),
-            self._masks_to_device(elig_masks),
+            io, discount=0 if upd is None else upd.nbytes
+        )
+        masks_dev = self._masks_to_device(elig_masks)
+        free2, packed, value, td = self._guard_kernel(lambda: fn(
+            self._state.dev,
+            gdom_d, dom_level_d, anc_ids_d,
+            io_dev,
+            masks_dev,
             cap_scale_d,
             num_domains=self.space.num_domains,
             top_k=min(self.top_k, self.space.num_domains),
@@ -2459,13 +2628,18 @@ class PlacementEngine:
             num_sigs=u_pad,
             sig_width=s_pad,
             num_upd=k_upd,
-        )
+            **self._score_statics(),
+        ))
         # the donated stale buffer is gone; the post-delta state is the
         # resident free from here on (also on the CPU/no-delta path,
         # where free2 is content-identical)
         self._state.dev = free2
         self._count_dispatch_kind("fused")
-        self._last_begin = {"path": "fused", "rows": len(enc.keys)}
+        self._count_kernel_tiers()
+        self._last_begin = {
+            "path": "fused", "rows": len(enc.keys),
+            "kernel": self._kernel_tier(), "commit": self.device_commit,
+        }
         cache = None
         if self.incremental:
             cache = IncrementalCache(
@@ -2548,7 +2722,7 @@ class PlacementEngine:
         )
         if m_padd > 1:
             self._count_bytes("masks", d_masks.nbytes)
-        packed, value_new, td_new = _inc_score(
+        packed, value_new, td_new = self._guard_kernel(lambda: _inc_score(
             self._state.dev,
             inc.value_dev,
             inc.td_dev,
@@ -2564,10 +2738,15 @@ class PlacementEngine:
             num_dirty=nd_pad,
             num_sigs=u_padd,
             sig_width=s_padd,
-        )
+            **self._score_statics(),
+        ))
         self._count_dispatch_kind("incremental")
+        self._count_kernel_tiers()
         self._count_inc_rows(len(dirty))
-        self._last_begin = {"path": "incremental", "rows": len(dirty)}
+        self._last_begin = {
+            "path": "incremental", "rows": len(dirty),
+            "kernel": self._kernel_tier(), "commit": self.device_commit,
+        }
         cache = IncrementalCache(
             self._state.epoch,
             {k: i for i, k in enumerate(enc.keys)},
@@ -2626,13 +2805,15 @@ class PlacementEngine:
         s_pad = sig_idx.shape[1]
         u_pad = u_sig_demand.shape[0]
         io = self._build_io(enc)
-        packed = _device_score(
+        io_dev = self._io_to_device(io)
+        masks_dev = self._masks_to_device(elig_masks)
+        packed = self._guard_kernel(lambda: _device_score(
             self._state.dev,
             gdom_d,
             dom_level_d,
             anc_ids_d,
-            self._io_to_device(io),
-            self._masks_to_device(elig_masks),
+            io_dev,
+            masks_dev,
             cap_scale_d,
             num_domains=self.space.num_domains,
             top_k=min(self.top_k, self.space.num_domains),
@@ -2641,9 +2822,14 @@ class PlacementEngine:
             num_gangs=g_pad,
             num_sigs=u_pad,
             sig_width=s_pad,
-        )
+            **self._score_statics(),
+        ))
         self._count_dispatch_kind("split")
-        self._last_begin = {"path": "split", "rows": len(enc.keys)}
+        self._count_kernel_tiers()
+        self._last_begin = {
+            "path": "split", "rows": len(enc.keys),
+            "kernel": self._kernel_tier(), "commit": self.device_commit,
+        }
         packed.copy_to_host_async()
         return packed
 
@@ -2706,6 +2892,15 @@ class PlacementEngine:
                 # next to the per-upload transport story above
                 "fused": self.fused,
                 "incremental": self.incremental,
+                # active scoring-core tier ("xla" | "pallas-fp32" |
+                # "pallas-bf16") + the on-device commit knob and the
+                # capability-miss fallback count (PR 19)
+                "core_tier": self._kernel_tier(),
+                "pallas_interpret": bool(
+                    self.pallas_core and self._pallas_interpret
+                ),
+                "device_commit": self.device_commit,
+                "pallas_fallbacks": self._pallas_fallbacks,
                 "dispatches": dict(self._dispatches),
                 "incremental_rows": self._inc_rows_total,
                 "reuse_hits": self._inc_reuse_hits,
@@ -2775,6 +2970,15 @@ class PlacementEngine:
                     pre-resident behavior, kept for A/B reporting). The
                     timed round includes the host mask-and-copy — that
                     cost is intrinsic to the full-upload regime.
+          "commit"— the warm regime with the ON-DEVICE COMMIT forced on
+                    for the probe's launches: the D2H ships one
+                    committed (value, domain) pair per gang instead of
+                    the [G, 2K] candidate list, so
+                    device_transport_seconds here measures the SHRUNKEN
+                    payload. The result additionally reports both
+                    payload sizes (candidates vs placements bytes) so
+                    the split is a number, not prose. The engine's own
+                    device_commit knob is restored afterwards.
 
         `free` is mutated in place in delta mode — pass a copy.
         """
@@ -2785,6 +2989,10 @@ class PlacementEngine:
         enc = self._encode_arrays(order)
         rng = np.random.default_rng(seed)
         n = self.snapshot.num_nodes
+        warm_like = mode in ("warm", "commit")
+        saved_commit = self.device_commit
+        if mode == "commit":
+            self.device_commit = True
 
         def mutate():
             """Seeded free-state churn, applied OUTSIDE the timed window."""
@@ -2806,43 +3014,59 @@ class PlacementEngine:
             # reuse tier. defer follows the engine's dispatch discipline:
             # a fused engine's delta rides the fused launch (the cost
             # under study there), a split engine's pays its own scatter.
-            if mode != "warm":
+            if not warm_like:
                 self._sync_free(free, defer=self.fused)
             return self._device_end(
                 self._device_begin(enc, allow_incremental=False)
             )
 
-        # warm-up: compile + device-resident statics + state
-        self._sync_free(free)
-        timed_round()
-        r_walls = []
-        for _ in range(3):
-            mutate()
-            t0 = time.perf_counter()
+        try:
+            # warm-up: compile + device-resident statics + state
+            self._sync_free(free)
             timed_round()
-            r_walls.append(time.perf_counter() - t0)
-        r = sorted(r_walls)[1]
-        t0 = time.perf_counter()
-        token = None
-        for _ in range(iters):
-            # mutate() inside this window is a seeded row draw + a few
-            # row writes — microseconds next to a round; the O(N*R)
-            # mask/diff never runs here (warm syncs nothing, delta
-            # diffs only the declared rows)
-            mutate()
-            if mode != "warm":
-                self._sync_free(free, defer=self.fused)
-            token = self._device_begin(enc, allow_incremental=False)
-        self._device_end(token)
-        total = time.perf_counter() - t0
+            r_walls = []
+            for _ in range(3):
+                mutate()
+                t0 = time.perf_counter()
+                timed_round()
+                r_walls.append(time.perf_counter() - t0)
+            r = sorted(r_walls)[1]
+            t0 = time.perf_counter()
+            token = None
+            for _ in range(iters):
+                # mutate() inside this window is a seeded row draw + a
+                # few row writes — microseconds next to a round; the
+                # O(N*R) mask/diff never runs here (warm syncs nothing,
+                # delta diffs only the declared rows)
+                mutate()
+                if not warm_like:
+                    self._sync_free(free, defer=self.fused)
+                token = self._device_begin(enc, allow_incremental=False)
+            self._device_end(token)
+            total = time.perf_counter() - t0
+            # what the launches ACTUALLY ran (post-capability-guard; the
+            # mesh engine's shard program ignores the knob entirely and
+            # reports no commit key at all)
+            active_commit = bool(self._last_begin.get("commit"))
+        finally:
+            self.device_commit = saved_commit
         compute = max(0.0, (total - r) / max(iters - 1, 1))
-        return {
+        out = {
             "device_roundtrip_seconds": round(r, 4),
             "device_compute_seconds": round(compute, 4),
             "device_transport_seconds": round(max(0.0, r - compute), 4),
             "device_split_iters": iters,
             "device_split_mode": mode,
+            "device_core_tier": self._kernel_tier(),
         }
+        if mode == "commit":
+            k_eff = min(self.top_k, self.space.num_domains)
+            # f32 payload bytes per result fetch: candidate list vs the
+            # committed placements the on-device commit ships instead
+            out["device_result_bytes_candidates"] = enc.g_pad * 2 * k_eff * 4
+            out["device_result_bytes_placements"] = enc.g_pad * 2 * 4
+            out["device_commit_active"] = bool(active_commit)
+        return out
 
     def _mk_placement(self, gang: SolverGang, assign: np.ndarray) -> GangPlacement:
         return GangPlacement(

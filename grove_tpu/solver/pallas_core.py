@@ -1,0 +1,273 @@
+"""Pallas execution tier for the scoring core (ROADMAP item 1).
+
+Two pieces, both optional and both falling back to the XLA fused path on
+any capability miss:
+
+- `pallas_value`: the [G, D] value tensor computed by a tiled Pallas
+  kernel — mask + per-level score + per-resource slack reduce fused in
+  one pass over (gang-chunk x domain-tile) grid cells, with the domain
+  aggregates and gang rows VMEM-resident per tile. In fp32 the kernel
+  evaluates EXACTLY the arithmetic of `value_from_aggregates` in the
+  same operation order, so its output is bit-equal to the XLA path
+  (gated by `bench.py --equivalence`'s pallas tier and
+  tests/test_pallas_core.py). The optional bf16 precision accumulates
+  the slack/value arithmetic in bfloat16 — coarser score quanta that may
+  merge near-ties WITHIN one level band; the 2.5-per-level lexicographic
+  dominance survives (small level scores are exactly representable), so
+  cross-level ordering is unchanged. bf16 ships only where the
+  equivalence gate proves the backlog's ties are preserved, or under the
+  documented tie policy (docs/scheduling.md "One-kernel solve").
+
+- `device_commit_scan`: the greedy commit moved on-device — a
+  sequential `lax.scan` over gangs in priority order that re-walks each
+  gang's packed top-k against a residual aggregate-capacity mirror and
+  commits the FIRST residually-feasible candidate up its ancestor
+  chain. The fine-solve D2H then ships one (value, domain) placement
+  per gang — [G, 2] instead of the [G, 2K] candidate list — and the
+  host repair tries exactly the committed domain, falling to the serial
+  exactness net only on node-granularity conflicts the aggregates
+  cannot see. Because an aggregate-infeasible candidate can never place
+  exactly (domain aggregate = sum of member node free), skipping it
+  on-device is sound: on conflict-free backlogs the committed choice is
+  provably the same domain the host candidate walk would land on, and
+  placements stay bit-equal to the XLA fused path.
+
+The module gates its own pallas import: where `jax.experimental.pallas`
+is missing or cannot lower for the backend, `pallas_capability()`
+reports it and the engine keeps the XLA fused path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:  # gated: pallas is an experimental namespace and may be absent
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover - import-time capability miss
+    pl = None
+
+_NEG = -1e9
+
+#: lane-aligned domain tile (f32 TPU tiling is (8, 128); the minor
+#: dimension of every VMEM block in the kernel is the domain axis)
+_DOMAIN_TILE = 128
+#: gang-chunk ceiling per grid cell; backlogs bucket to powers of two,
+#: so any bucket either fits one cell or divides into aligned chunks
+_GANG_TILE = 128
+
+
+def pallas_capability() -> str | None:
+    """How the Pallas tier can run on the default backend, probed once:
+
+    - "native":    pallas lowers for this backend (TPU) — compiled kernels
+    - "interpret": pallas is importable but does not lower here (CPU) —
+                   the interpreter runs the kernel op-by-op (tests/CI)
+    - None:        pallas is not importable — the tier is unavailable
+
+    The result is cached per process; `reset_capability_cache()` (tests)
+    clears it.
+    """
+    global _CAPABILITY
+    if _CAPABILITY is not _UNPROBED:
+        return _CAPABILITY
+    if pl is None:
+        _CAPABILITY = None
+        return None
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - no backend at all
+        _CAPABILITY = None
+        return None
+    _CAPABILITY = "native" if backend == "tpu" else "interpret"
+    return _CAPABILITY
+
+
+_UNPROBED = object()
+_CAPABILITY = _UNPROBED
+
+
+def reset_capability_cache() -> None:
+    """Forget the probed capability (tests monkeypatching the backend)."""
+    global _CAPABILITY
+    _CAPABILITY = _UNPROBED
+
+
+def _pad_to(x, size: int, axis: int, fill=0.0):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def _value_kernel(r: int, precision: str):
+    """Kernel body for one (gang-chunk, domain-tile) grid cell.
+
+    Refs:
+      dp_ref  [R+1, TD]  domain pack: free rows 0..R-1 | level row R
+      gp_ref  [TG, R+4]  gang pack: demand 0..R-1 | required | preferred
+                         | valid | fairness
+      cf_ref  [TG, TD]   cnt_fit tile
+      cap_ref [1, R]     cap_scale (SMEM)
+      o_ref   [TG, TD]   value tile out
+    """
+    acc = jnp.bfloat16 if precision == "bf16" else jnp.float32
+
+    def kernel(dp_ref, gp_ref, cf_ref, cap_ref, o_ref):
+        dlev = dp_ref[r : r + 1, :]                      # [1, TD]
+        req = gp_ref[:, r : r + 1]                       # [TG, 1]
+        pref = gp_ref[:, r + 1 : r + 2]
+        validc = gp_ref[:, r + 2 : r + 3]
+        fair = gp_ref[:, r + 3 : r + 4]
+        allowed = dlev >= req                            # [TG, TD]
+        # identical op order to value_from_aggregates: the fp32 tier is
+        # bit-equal to the XLA path by construction, not by luck
+        level_score = acc(2.5) * (dlev.astype(acc) + acc(2.0))
+        pref_bonus = (dlev >= pref).astype(acc)
+        slack = None
+        for res in range(r):
+            dfr = dp_ref[res : res + 1, :].astype(acc)   # [1, TD]
+            tdr = gp_ref[:, res : res + 1].astype(acc)   # [TG, 1]
+            cur = (dfr - tdr) / cap_ref[0, res].astype(acc)
+            slack = cur if slack is None else jnp.maximum(slack, cur)
+        slack = slack / (acc(1.0) + jnp.abs(slack))
+        value = level_score + acc(1.0) * pref_bonus - acc(0.5) * slack
+        value = value + fair.astype(acc)
+        mask = (cf_ref[:, :] >= 1.0) & allowed & (validc > 0.5)
+        o_ref[:, :] = jnp.where(
+            mask, value.astype(jnp.float32), jnp.float32(_NEG)
+        )
+
+    return kernel
+
+
+def pallas_value(
+    dom_free,         # f32 [D, R] aggregate free per domain
+    cnt_fit,          # f32 [G, D] #nodes per domain fitting the max pod
+    dom_level,        # i32 [D]
+    total_demand,     # f32 [G, R]
+    required_level,   # i32 [G]
+    preferred_level,  # i32 [G]
+    valid,            # bool [G]
+    cap_scale,        # f32 [R]
+    fairness,         # f32 [G]
+    *,
+    precision: str = "fp32",
+    interpret: bool = False,
+):
+    """value[G, D] via the tiled Pallas kernel — the drop-in for
+    `value_from_aggregates` on the kernel tier (same signature semantics;
+    fairness is required here because every engine path passes it).
+
+    Tiling: the domain axis pads to 128-lane tiles, the gang axis to the
+    power-of-two chunk (backlogs are already power-of-two buckets, so
+    gang padding is normally zero). Padded domain columns carry
+    cnt_fit = 0 and padded gang rows valid = 0 — both land on the _NEG
+    mask branch, so the slice-back is exact.
+    """
+    if pl is None:  # capability miss surfaced to the engine's guard
+        raise RuntimeError("jax.experimental.pallas is unavailable")
+    if precision not in ("fp32", "bf16"):
+        raise ValueError(f"unknown pallas precision: {precision!r}")
+    g, d = cnt_fit.shape
+    r = dom_free.shape[1]
+    tg = _GANG_TILE
+    while tg > g:
+        tg //= 2
+    tg = max(tg, 1)
+    g_pad = -(-g // tg) * tg
+    d_pad = -(-d // _DOMAIN_TILE) * _DOMAIN_TILE
+
+    dpack = jnp.concatenate(
+        [dom_free.T, dom_level.astype(jnp.float32)[None, :]], axis=0
+    )  # [R+1, D]
+    dpack = _pad_to(dpack, d_pad, axis=1)
+    gpack = jnp.concatenate(
+        [
+            total_demand,
+            required_level.astype(jnp.float32)[:, None],
+            preferred_level.astype(jnp.float32)[:, None],
+            valid.astype(jnp.float32)[:, None],
+            fairness[:, None],
+        ],
+        axis=1,
+    )  # [G, R+4]
+    gpack = _pad_to(gpack, g_pad, axis=0)
+    cf = _pad_to(_pad_to(cnt_fit, d_pad, axis=1), g_pad, axis=0)
+
+    grid = (g_pad // tg, d_pad // _DOMAIN_TILE)
+    value = pl.pallas_call(
+        _value_kernel(r, precision),
+        out_shape=jax.ShapeDtypeStruct((g_pad, d_pad), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r + 1, _DOMAIN_TILE), lambda i, j: (0, j)),
+            pl.BlockSpec((tg, r + 4), lambda i, j: (i, 0)),
+            pl.BlockSpec((tg, _DOMAIN_TILE), lambda i, j: (i, j)),
+            pl.BlockSpec((1, r), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tg, _DOMAIN_TILE), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(dpack, gpack, cf, cap_scale[None, :])
+    return value[:g, :d]
+
+
+def device_commit_scan(top_val, top_dom, dom_free, anc_ids, total_demand):
+    """Greedy on-device commit over the packed top-k: gangs in priority
+    order (= row order) each take the FIRST candidate that is still
+    residually feasible at aggregate granularity, committing demand up
+    the ancestor chain, exactly the walk the host repair performs —
+    minus node granularity, which is why conflicts (aggregate-feasible
+    but exact-infeasible domains) still fall to the host's serial net.
+
+    Returns ([G, 1] committed value, [G, 1] committed domain) — the
+    shrunken D2H payload. Rows with no feasible candidate carry _NEG
+    (the host goes straight to the exactness net, the same outcome the
+    candidate walk reaches after exhausting provably-infeasible
+    alternates). Feasibility uses the commit scan's `+ 1e-6` epsilon so
+    the two device passes agree on edge-exact fits.
+    """
+    top_val = jnp.asarray(top_val)
+    top_dom = jnp.asarray(top_dom)
+    dom_free = jnp.asarray(dom_free)
+    anc_ids = jnp.asarray(anc_ids)
+    total_demand = jnp.asarray(total_demand)
+    d = dom_free.shape[0]
+    resid0 = jnp.concatenate(
+        [dom_free, jnp.zeros((1, dom_free.shape[1]), jnp.float32)], axis=0
+    )
+
+    def step(resid, xs):
+        vals, doms, td = xs                              # [K], [K], [R]
+        cand = resid[doms]                               # [K, R]
+        fits = jnp.all(cand + 1e-6 >= td[None, :], axis=-1)
+        fits = fits & (vals > _NEG / 2)
+        k = jnp.argmax(fits)                             # first feasible
+        ok = jnp.any(fits)
+        choice = doms[k]                                 # always a real id
+        chain = jnp.where(ok, anc_ids[choice], d)        # [L+1]
+        resid = resid.at[chain].add(-td)
+        out_val = jnp.where(ok, vals[k], jnp.float32(_NEG))
+        return resid, (out_val, choice)
+
+    _, (cv, cd) = jax.lax.scan(
+        step, resid0, (top_val, top_dom, total_demand)
+    )
+    return cv[:, None], cd[:, None]
+
+
+def interpret_default() -> bool:
+    """Whether pallas_call must run interpreted on this backend."""
+    return pallas_capability() == "interpret"
+
+
+__all__ = [
+    "pallas_capability",
+    "reset_capability_cache",
+    "pallas_value",
+    "device_commit_scan",
+    "interpret_default",
+]

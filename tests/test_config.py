@@ -291,6 +291,9 @@ class TestConfigChangesBehavior:
             "hier_prune_level": None,
             "hier_min_nodes": 4096,
             "hier_parallel_workers": None,
+            "pallas_core": None,
+            "device_commit": None,
+            "pallas_precision": "fp32",
         }
         assert all(p.node_name for p in h.store.list(Pod.KIND))
 

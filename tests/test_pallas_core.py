@@ -1,0 +1,379 @@
+"""Pallas execution tier (solver/pallas_core.py + engine wiring).
+
+Everything here runs in INTERPRET mode: on the CPU test backend
+`pallas_capability()` returns "interpret", so `pallas_core=True` lowers
+the kernel through the pallas interpreter — same program, same fp32
+arithmetic, bit-equal to the XLA `value_from_aggregates` chain. The
+contract under test is the one docs/scheduling.md ("One-kernel solve")
+promises:
+
+  * fp32 kernel output is BIT-equal to the fused XLA scoring core, so
+    every downstream consumer (top-k, commit scan, repair, incremental
+    cache rows) is unperturbed;
+  * the on-device commit ships [G, 2] committed placements whose
+    conflict-free decode is bit-equal to the host candidate walk;
+  * any kernel-launch failure permanently falls back to the XLA path
+    (capability miss is a downgrade, never an error);
+  * the SolverConfig knobs validate, and the auto default stays OFF on
+    CPU so chaos seeds replay bit-identically.
+"""
+
+import numpy as np
+import pytest
+
+from grove_tpu.api.config import ValidationError, load_operator_config
+from grove_tpu.solver import PlacementEngine
+from grove_tpu.solver.engine import _NEG, value_from_aggregates
+from grove_tpu.solver.pallas_core import (
+    device_commit_scan,
+    interpret_default,
+    pallas_capability,
+    pallas_value,
+)
+
+from test_hierarchy import seeded_problem
+from test_solver import cluster, gang
+
+pytestmark = pytest.mark.skipif(
+    pallas_capability() is None, reason="pallas not importable"
+)
+
+
+def _rand_inputs(seed, g, d, r):
+    """A seeded [G, D] scoring instance with every edge the kernel must
+    mask: zero-cnt_fit columns, invalid rows, required levels no domain
+    satisfies, negative fairness offsets."""
+    rng = np.random.default_rng(seed)
+    dom_free = rng.uniform(0.0, 32.0, (d, r)).astype(np.float32)
+    cnt_fit = rng.integers(0, 3, (g, d)).astype(np.float32)
+    dom_level = rng.integers(-1, 3, d).astype(np.int32)
+    td = rng.uniform(0.0, 16.0, (g, r)).astype(np.float32)
+    req = rng.integers(-1, 4, g).astype(np.int32)  # 3 = unsatisfiable
+    pref = rng.integers(-1, 3, g).astype(np.int32)
+    valid = rng.random(g) > 0.2
+    cap = rng.uniform(1.0, 64.0, r).astype(np.float32)
+    fair = rng.uniform(-1.0, 1.0, g).astype(np.float32)
+    return dom_free, cnt_fit, dom_level, td, req, pref, valid, cap, fair
+
+
+def _both(seed, g, d, r, precision="fp32"):
+    args = _rand_inputs(seed, g, d, r)
+    ref = np.asarray(value_from_aggregates(*args))
+    out = np.asarray(
+        pallas_value(*args, precision=precision, interpret=True)
+    )
+    return ref, out
+
+
+def assert_same_placements(a, b):
+    assert sorted(a.placed) == sorted(b.placed)
+    for name in a.placed:
+        np.testing.assert_array_equal(
+            a.placed[name].node_indices, b.placed[name].node_indices
+        )
+    assert a.unplaced == b.unplaced
+
+
+class TestKernelParity:
+    """pallas_value vs value_from_aggregates, direct tensor-level."""
+
+    @pytest.mark.parametrize(
+        "g,d,r",
+        [
+            (8, 5, 3),     # smaller than one tile in both axes
+            (64, 300, 3),  # multi-tile domains, ragged last tile
+            (16, 129, 2),  # one-past-tile boundary column
+            (1, 1, 1),     # degenerate single cell
+            (128, 700, 4), # full gang tile, wide domain sweep
+        ],
+    )
+    def test_fp32_bit_equal(self, g, d, r):
+        for seed in (0, 7):
+            ref, out = _both(seed, g, d, r)
+            # bitwise: == on float arrays, no tolerance
+            np.testing.assert_array_equal(out, ref)
+
+    def test_masked_rows_and_columns_get_neg(self):
+        args = list(_rand_inputs(3, 12, 40, 3))
+        args[1][:, 5] = 0.0       # cnt_fit: no node in domain 5 fits
+        args[6][4] = False        # gang 4 invalid
+        args[4][9] = 99           # gang 9: required level > every domain
+        ref = np.asarray(value_from_aggregates(*args))
+        out = np.asarray(pallas_value(*args, interpret=True))
+        np.testing.assert_array_equal(out, ref)
+        assert np.all(out[:, 5] == _NEG)
+        assert np.all(out[4] == _NEG)
+        assert np.all(out[9] == _NEG)
+
+    def test_bf16_masks_exact_values_close(self):
+        """Reduced precision may move scores but NEVER the feasibility
+        mask: _NEG cells are placed by fp32 comparisons in both tiers."""
+        ref, out = _both(11, 32, 90, 3, precision="bf16")
+        np.testing.assert_array_equal(out == _NEG, ref == _NEG)
+        live = ref != _NEG
+        np.testing.assert_allclose(
+            out[live], ref[live], rtol=0.02, atol=0.05
+        )
+
+    def test_unknown_precision_rejected(self):
+        args = _rand_inputs(0, 4, 4, 2)
+        with pytest.raises(ValueError, match="precision"):
+            pallas_value(*args, precision="fp16", interpret=True)
+
+    def test_cpu_capability_is_interpret(self):
+        assert pallas_capability() == "interpret"
+        assert interpret_default() is True
+
+
+class TestDeviceCommitScan:
+    def test_matches_host_greedy_replay(self):
+        """The lax.scan commit is the same greedy walk a host replay of
+        the packed top-k performs: first residually-feasible candidate
+        wins, demand subtracts down the ancestor chain."""
+        rng = np.random.default_rng(5)
+        g, d, r, k = 20, 12, 3, 4
+        dom_free = rng.uniform(4.0, 30.0, (d, r)).astype(np.float32)
+        # flat ancestor table: self + dummy-row padding
+        anc = np.full((d, 3), d, dtype=np.int32)
+        anc[:, 0] = np.arange(d)
+        td = rng.uniform(1.0, 10.0, (g, r)).astype(np.float32)
+        top_dom = np.stack(
+            [rng.choice(d, size=k, replace=False) for _ in range(g)]
+        ).astype(np.int32)
+        top_val = rng.uniform(0.0, 5.0, (g, k)).astype(np.float32)
+        top_val[3] = _NEG  # one all-infeasible row
+
+        cv, cd = device_commit_scan(top_val, top_dom, dom_free, anc, td)
+        cv, cd = np.asarray(cv), np.asarray(cd)
+        assert cv.shape == (g, 1) and cd.shape == (g, 1)
+
+        resid = np.concatenate(
+            [dom_free, np.zeros((1, r), np.float32)]
+        )
+        for i in range(g):
+            want_v, want_d = _NEG, None
+            for j in range(k):
+                dj = int(top_dom[i, j])
+                if top_val[i, j] > _NEG / 2 and np.all(
+                    resid[dj] + 1e-6 >= td[i]
+                ):
+                    want_v, want_d = top_val[i, j], dj
+                    for a in anc[dj]:
+                        resid[a] -= td[i]
+                    break
+            assert cv[i, 0] == np.float32(want_v)
+            if want_d is not None:
+                assert cd[i, 0] == want_d
+
+    def test_engine_parity_conflict_free(self):
+        """On a backlog with aggregate == exact feasibility everywhere,
+        the shipped [G, 2] placements decode bit-equal to the host
+        candidate walk over the [G, 2K] list."""
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=16.0)
+        gangs = [
+            gang(f"g{i}", pods=2, cpu=2.0, required=i % 2 - 1,
+                 preferred=(i % 3) - 1, priority=float(i % 3))
+            for i in range(10)
+        ]
+        base = PlacementEngine(snap).solve(gangs, free=snap.free.copy())
+        eng = PlacementEngine(snap, device_commit=True)
+        assert eng.device_commit is True
+        res = eng.solve(gangs, free=snap.free.copy())
+        assert_same_placements(base, res)
+        assert res.num_placed == 10
+        disp = eng.debug_summary()["device_state"]["dispatches"]
+        assert disp.get("device_commit", 0) >= 1
+
+
+class TestEngineParity:
+    """Whole-solve parity: pallas tier on vs default XLA core."""
+
+    def test_flat_cold_and_warm_parity(self):
+        snap = cluster(blocks=2, racks=2, hosts=4, cpu=12.0)
+        gangs = [
+            gang(f"g{i}", pods=3, cpu=2.0, required=(i % 3) - 1,
+                 preferred=i % 2, priority=float(i % 2))
+            for i in range(8)
+        ]
+        base = PlacementEngine(snap)
+        eng = PlacementEngine(snap, pallas_core=True)
+        assert eng.pallas_core is True
+        for rnd in range(2):  # cold fused launch, then warm re-launch
+            free = snap.free.copy()
+            if rnd:  # perturb so the warm solve can't hit the
+                free[0] *= 0.5  # zero-dispatch reuse memo
+            a = base.solve(gangs, free=free.copy())
+            b = eng.solve(gangs, free=free.copy())
+            assert_same_placements(a, b)
+            assert a.mean_placement_score() == b.mean_placement_score()
+        disp = eng.debug_summary()["device_state"]["dispatches"]
+        assert disp.get("pallas", 0) >= 2
+
+    def test_tie_rows_parity(self):
+        """Identical gangs produce exact value ties; the seeded jitter
+        tie-break sits downstream of the kernel in both tiers, so the
+        resolution is bit-identical."""
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        gangs = [gang(f"twin{i}", pods=2, cpu=2.0) for i in range(6)]
+        a = PlacementEngine(snap).solve(gangs, free=snap.free.copy())
+        b = PlacementEngine(snap, pallas_core=True).solve(
+            gangs, free=snap.free.copy()
+        )
+        assert_same_placements(a, b)
+
+    @pytest.mark.parametrize("seed", [1, 4])
+    def test_seeded_backlog_parity(self, seed):
+        """Mixed seeded backlog incl. pod-eligibility masks and a
+        drained block (test_hierarchy.seeded_problem)."""
+        snap, free, gangs = seeded_problem(seed, num_gangs=16)
+        a = PlacementEngine(snap).solve(gangs, free=free.copy())
+        b = PlacementEngine(snap, pallas_core=True).solve(
+            gangs, free=free.copy()
+        )
+        assert_same_placements(a, b)
+
+    def test_hierarchical_sub_engines_inherit(self):
+        snap, free, gangs = seeded_problem(2, num_gangs=16)
+        a = PlacementEngine(snap, hierarchical=True).solve(
+            gangs, free=free.copy()
+        )
+        eng = PlacementEngine(
+            snap, hierarchical=True, pallas_core=True
+        )
+        b = eng.solve(gangs, free=free.copy())
+        assert_same_placements(a, b)
+        sub = next(iter(eng._hier.shards.values())).engine
+        assert sub.pallas_core is True
+
+    def test_whatif_scores_ride_kernel_tier(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
+        base = PlacementEngine(snap)
+        eng = PlacementEngine(snap, pallas_core=True, device_commit=True)
+        free = snap.free.copy()
+        a = base.whatif_scores(gangs, free)
+        b = eng.whatif_scores(gangs, free)
+        for va, vb in zip(a, b):
+            np.testing.assert_array_equal(va, vb)
+        disp = eng.debug_summary()["device_state"]["dispatches"]
+        # what-if rides the kernel but NEVER the device commit (defrag
+        # consumes the full alternates list)
+        assert disp.get("pallas", 0) >= 1
+        assert disp.get("device_commit", 0) == 0
+
+
+class TestCapabilityFallback:
+    def test_auto_default_off_on_cpu(self):
+        """Auto knobs resolve OFF where pallas does not lower natively —
+        chaos seeds on the CPU backend replay bit-identically."""
+        snap = cluster()
+        eng = PlacementEngine(snap)
+        assert eng.pallas_core is False
+        assert eng.device_commit is False
+        assert eng.debug_summary()["device_state"]["core_tier"] == "xla"
+
+    def test_capability_none_resolves_core_off(self, monkeypatch):
+        monkeypatch.setattr(
+            "grove_tpu.solver.engine.pallas_capability", lambda: None
+        )
+        eng = PlacementEngine(cluster(), pallas_core=True)
+        assert eng.pallas_core is False
+
+    def test_kernel_failure_falls_back_to_xla(self, monkeypatch):
+        """A launch failure with the kernel tier active downgrades the
+        engine to the XLA path permanently and re-runs the launch — the
+        solve still lands, bit-equal to the baseline."""
+        import jax
+
+        def boom(*a, **k):
+            raise RuntimeError("no pallas lowering for backend")
+
+        monkeypatch.setattr("grove_tpu.solver.engine.pallas_value", boom)
+        # the fused program may already be compiled for common test
+        # shapes with the pallas static: force a fresh trace so the
+        # patched kernel is actually reached
+        jax.clear_caches()
+        snap = cluster(blocks=3, racks=2, hosts=1, cpu=24.0)
+        gangs = [gang(f"g{i}", pods=2, cpu=3.0) for i in range(5)]
+        base = PlacementEngine(snap).solve(gangs, free=snap.free.copy())
+        eng = PlacementEngine(snap, pallas_core=True, device_commit=True)
+        res = eng.solve(gangs, free=snap.free.copy())
+        assert_same_placements(base, res)
+        assert eng._pallas_fallbacks == 1
+        assert eng.pallas_core is False
+        assert eng.device_commit is False
+        ds = eng.debug_summary()["device_state"]
+        assert ds["core_tier"] == "xla"
+        assert ds["pallas_fallbacks"] == 1
+        # subsequent solves stay on the downgraded path, no re-raise
+        res2 = eng.solve(gangs, free=snap.free.copy())
+        assert_same_placements(base, res2)
+        assert eng._pallas_fallbacks == 1
+
+
+class TestConfigKnobs:
+    def test_engine_rejects_unknown_precision(self):
+        with pytest.raises(ValueError, match="pallas_precision"):
+            PlacementEngine(cluster(), pallas_precision="fp16")
+
+    def test_config_accepts_valid_knobs(self):
+        cfg = load_operator_config(
+            {
+                "solver": {
+                    "pallas_core": True,
+                    "device_commit": False,
+                    "pallas_precision": "bf16",
+                }
+            }
+        )
+        assert cfg.solver.pallas_core is True
+        assert cfg.solver.device_commit is False
+        assert cfg.solver.pallas_precision == "bf16"
+
+    def test_config_auto_defaults_are_none(self):
+        cfg = load_operator_config({})
+        assert cfg.solver.pallas_core is None
+        assert cfg.solver.device_commit is None
+        assert cfg.solver.pallas_precision == "fp32"
+
+    def test_config_rejects_bad_knobs(self):
+        with pytest.raises(ValidationError) as exc:
+            load_operator_config(
+                {
+                    "solver": {
+                        "pallas_core": 1,
+                        "device_commit": "yes",
+                        "pallas_precision": "fp16",
+                    }
+                }
+            )
+        msg = str(exc.value)
+        assert "config.solver.pallas_core" in msg
+        assert "config.solver.device_commit" in msg
+        assert "config.solver.pallas_precision" in msg
+
+
+class TestObservabilitySurfaces:
+    def test_debug_summary_reports_tier(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        eng = PlacementEngine(snap, pallas_core=True, device_commit=True)
+        ds = eng.debug_summary()["device_state"]
+        assert ds["core_tier"] == "pallas-fp32"
+        assert ds["pallas_interpret"] is True
+        assert ds["device_commit"] is True
+        assert ds["pallas_fallbacks"] == 0
+
+    def test_measure_device_split_commit_mode(self):
+        snap = cluster(blocks=2, racks=2, hosts=2, cpu=8.0)
+        eng = PlacementEngine(snap)
+        gangs = [gang(f"g{i}", pods=2, cpu=2.0) for i in range(4)]
+        saved = eng.device_commit
+        out = eng.measure_device_split(gangs, iters=2, mode="commit")
+        assert eng.device_commit == saved  # knob restored
+        assert out["device_split_mode"] == "commit"
+        assert out["device_commit_active"] is True
+        assert out["device_core_tier"] == "xla"
+        cand = out["device_result_bytes_candidates"]
+        plc = out["device_result_bytes_placements"]
+        assert plc < cand
+        assert cand == plc * min(eng.top_k, eng.space.num_domains)
