@@ -286,8 +286,23 @@ def main() -> int:
                     "JSON (Perfetto / chrome://tracing loadable) with the "
                     "engine's encode/device/repair spans and the "
                     "control-plane benches' reconcile/solve spans. "
-                    "Tracing adds a little overhead — leave unset for "
-                    "record runs (see docs/observability.md)")
+                    "Composes with --stream (each rung's stream/round "
+                    "sides land as their own Perfetto process, with the "
+                    "fleet critical-path breakdown in the JSON and the "
+                    "telescoping regression gate on the exit code) and "
+                    "with --scale-tier (the wave and serial engines' "
+                    "coarse/fine spans plus causal flow arrows). Tracing "
+                    "adds a little overhead — leave unset for record "
+                    "runs (see docs/observability.md)")
+    ap.add_argument("--aggregate-overhead", action="store_true",
+                    help="add the always-on tracing tax probe: the "
+                    "controlplane settle workload with tracing OFF vs "
+                    "tracing.mode=aggregate (span ring skipped, bounded "
+                    "critical-path sketches only), interleaved p50; "
+                    "exits nonzero above the 5%% acceptance bound or if "
+                    "the aggregate side folded zero paths. A wall-ratio "
+                    "gate flakes on throttling hosts, so it only arms "
+                    "when this flag is passed explicitly")
     ap.add_argument("--tenants", type=int, default=0,
                     help="multi-tenant sustained-churn regime: drive a "
                     "Zipf-skewed gang arrival stream across N tenant "
@@ -919,6 +934,15 @@ def main() -> int:
                 partitions=args.partitions,
             ))
 
+    # always-on tracing tax probe (--aggregate-overhead): off vs
+    # tracing.mode=aggregate on the settle workload, <5% acceptance
+    agg_probe: dict = {}
+    agg_failures: list[str] = []
+    if args.aggregate_overhead:
+        agg_probe, agg_failures = bench_aggregate_overhead(
+            args.nodes, args.cp_replicas or 20,
+        )
+
     # Headline basis (r7, recorded so BENCH files stay self-describing,
     # like the r3 p99->p50 change): the fused regime's headline is the
     # dispatch/adopt steady state — the scheduler's DEPLOYED posture
@@ -969,7 +993,9 @@ def main() -> int:
         "engine": "sharded" if args.sharded else "single",
         **({"mesh": dict(mesh.shape)} if args.sharded else {}),
         **cp,
+        **agg_probe,
     }
+    trace_failures: list[str] = []
     if args.trace:
         from grove_tpu.observability.tracing import chrome_trace
 
@@ -978,8 +1004,28 @@ def main() -> int:
             fh.write("\n")
         n_spans = sum(len(v.finished) for v in trace_groups.values())
         print(f"wrote {n_spans} spans to {args.trace}", file=sys.stderr)
+        # the fleet latency breakdown over the traced control-plane
+        # sections, with the telescoping gate (the churn ring may have
+        # evicted early gangs' create spans, so only the bounded
+        # controlplane section arms the non-vacuity check)
+        breakdown: dict = {}
+        for lbl in ("controlplane", "churn"):
+            tr = trace_groups.get(lbl)
+            if tr is None:
+                continue
+            report, fails = _trace_critical_path(
+                tr, binds=1 if lbl == "controlplane" else 0, label=lbl,
+            )
+            breakdown[lbl] = report
+            trace_failures.extend(fails)
+        if breakdown:
+            out["critical_path_breakdown"] = breakdown
+            print(json.dumps({"critical_path_breakdown": breakdown}),
+                  file=sys.stderr)
     print(json.dumps(out))
-    return 0
+    for f in (*trace_failures, *agg_failures):
+        print(f"BENCH FAILURE: {f}", file=sys.stderr)
+    return 1 if (trace_failures or agg_failures) else 0
 
 
 def bench_equivalence(args, snapshot, gangs, mk_engine) -> int:
@@ -1720,6 +1766,19 @@ def bench_scale_tier(args) -> int:
     gangs = make_tier_gangs(num_gangs)
     registry = MetricsRegistry()
 
+    #: --trace composition: the wave and serial engines each trace into
+    #: their own group (own Perfetto process), so the export shows the
+    #: dispatch-all/collect-in-order overlap against the one-domain-at-
+    #: a-time serial fine phase side by side, with the causal flow
+    #: arrows (engine.hierarchical -> per-domain engine.fine_solve)
+    #: linking each coarse assignment to its fine solves. Walls measured
+    #: under --trace carry the tracing overhead — not record numbers.
+    trace_groups: dict = {}
+    if args.trace:
+        from grove_tpu.observability.tracing import Tracer
+
+        trace_groups = {"wave": Tracer(), "serial": Tracer()}
+
     if args.sharded:
         from grove_tpu.parallel import ShardedPlacementEngine, make_solver_mesh
 
@@ -1734,7 +1793,9 @@ def bench_scale_tier(args) -> int:
             return PlacementEngine(snapshot, **kw)
 
     hier = mk(hierarchical=True, metrics=registry,
-              hier_parallel_workers=args.wave_workers)
+              hier_parallel_workers=args.wave_workers,
+              **({"tracer": trace_groups["wave"]} if trace_groups
+                 else {}))
     # solver microbench: decision-ring recording off (the documented
     # opt-out) — at 20k gangs/solve the ring's LRU churn is a visible
     # constant the deployed path amortizes across its cluster-owned log
@@ -1746,7 +1807,9 @@ def bench_scale_tier(args) -> int:
     # else. Its own registry, so both sides pay the identical per-gang
     # metrics recording (an asymmetry here skews the bind-wall fields)
     hier_serial = mk(hierarchical=True, hier_parallel_workers=0,
-                     metrics=MetricsRegistry())
+                     metrics=MetricsRegistry(),
+                     **({"tracer": trace_groups["serial"]} if trace_groups
+                        else {}))
     hier_serial.decisions = None
     DIRTY = 8
 
@@ -2047,8 +2110,17 @@ def bench_scale_tier(args) -> int:
         ),
         "engine": "sharded" if args.sharded else "single",
         **({"mesh": dict(mesh.shape)} if mesh is not None else {}),
+        **({"traced": True} if trace_groups else {}),
         "backend": __import__("jax").default_backend(),
     }
+    if args.trace:
+        from grove_tpu.observability.tracing import chrome_trace
+
+        with open(args.trace, "w") as fh:
+            json.dump(chrome_trace(trace_groups), fh)
+            fh.write("\n")
+        n_spans = sum(len(v.finished) for v in trace_groups.values())
+        print(f"wrote {n_spans} spans to {args.trace}", file=sys.stderr)
     for f in failures:
         print(f"SCALE-TIER FAILURE: {f}", file=sys.stderr)
     print(json.dumps(out))
@@ -2138,6 +2210,120 @@ def bench_service(args) -> int:
             # next run's device acquisition (advisor r3)
             proc.kill()
             proc.wait(timeout=10)
+
+
+def _trace_critical_path(tracer, metrics=None, binds: int = 0,
+                         label: str = "trace") -> tuple[dict, list[str]]:
+    """One tracer's fleet critical-path breakdown plus the regression
+    gate: re-fold the retained ring and check (a) the telescoping
+    invariant — every COMPLETE reconstructed path's segments sum
+    exactly to its created->running total, the guarantee
+    observability/causal.py pins — and (b) non-vacuity — a side that
+    actually bound gangs must have reconstructed at least one path
+    (zero paths with binds means an instrumentation hop fell off a
+    subsystem). Returns (observatory report, failure strings)."""
+    from grove_tpu.observability.causal import CriticalPathFolder
+
+    failures: list[str] = []
+    paths: list[dict] = []
+    CriticalPathFolder(sink=paths.append).fold_all(tracer.finished)
+    for p in paths:
+        if not p["complete"]:
+            continue
+        drift = abs(sum(p["segments"].values()) - p["total"])
+        if drift > 1e-6:
+            failures.append(
+                f"{label}: gang {p['gang']} critical path does not "
+                f"telescope (drift {drift:.2e}s over {p['total']:.4f}s "
+                "total)"
+            )
+    if binds > 0 and not paths:
+        failures.append(
+            f"{label}: {binds} gangs bound but zero critical paths "
+            "reconstructed — the latency breakdown is vacuous"
+        )
+    return tracer.flush_critical_paths(metrics), failures
+
+
+def bench_aggregate_overhead(num_nodes: int, replicas: int,
+                             repeats: int = 5) -> tuple[dict, list[str]]:
+    """The always-on mode's tax (`tracing.mode: aggregate`): the same
+    apply+settle+delete workload on two harnesses — tracing off vs
+    aggregate — interleaved in alternating order, p50 per side, with
+    the <5% acceptance bound on the ratio. The aggregate side must also
+    have FOLDED paths (its observatory is the whole point; zero folded
+    paths would pass the wall gate vacuously). Returns (fields,
+    failures); main() arms the gate only under --aggregate-overhead
+    because a wall-ratio bound flakes on throttling hosts."""
+    from grove_tpu.cluster import make_nodes
+    from grove_tpu.controller import Harness
+    from grove_tpu.tuning import tune_gc
+
+    def mk_h(aggregate: bool) -> "Harness":
+        return Harness(
+            nodes=make_nodes(
+                num_nodes,
+                allocatable={"cpu": 32.0, "memory": 128.0, "tpu": 8.0},
+            ),
+            config=(
+                {"tracing": {"enabled": True, "mode": "aggregate"}}
+                if aggregate else None
+            ),
+        )
+
+    sides = {True: mk_h(True), False: mk_h(False)}
+    for h in sides.values():
+        h.settle()
+    tune_gc()
+    walls: dict[bool, list[float]] = {True: [], False: []}
+    seq = [0]
+
+    def run(aggregate: bool) -> None:
+        h = sides[aggregate]
+        name = f"aggov{seq[0]}"
+        seq[0] += 1
+        t0 = time.perf_counter()
+        h.apply(_churn_pcs(name, replicas))
+        h.settle()
+        walls[aggregate].append(time.perf_counter() - t0)
+        # delete + resettle OUTSIDE the timed window, so every repeat
+        # settles against the identical store population
+        h.store.delete("PodCliqueSet", "default", name)
+        h.settle()
+
+    run(True)   # warm: compile + caches on both sides, untimed
+    run(False)
+    walls = {True: [], False: []}
+    for rep in range(repeats):
+        for side in ((True, False) if rep % 2 == 0 else (False, True)):
+            run(side)
+    p50_agg, p50_off = p50(walls[True]), p50(walls[False])
+    overhead = p50_agg / p50_off - 1.0
+    paths_folded = sides[True].cluster.tracer.critical.paths
+    fields = {
+        "aggregate_overhead_fraction": round(overhead, 4),
+        "aggregate_settle_p50_seconds": round(p50_agg, 4),
+        "baseline_settle_p50_seconds": round(p50_off, 4),
+        "aggregate_paths_folded": paths_folded,
+        "aggregate_overhead_bound": 0.05,
+        "aggregate_overhead_ok": overhead <= 0.05,
+        "aggregate_overhead_repeats": repeats,
+        "aggregate_dominant_segment":
+            sides[True].cluster.tracer.critical.dominant(),
+    }
+    failures = []
+    if overhead > 0.05:
+        failures.append(
+            f"aggregate-mode overhead {overhead:.1%} exceeds the 5% "
+            f"acceptance bound (aggregate p50 {p50_agg:.4f}s vs off "
+            f"{p50_off:.4f}s over {repeats} interleaved repeats)"
+        )
+    if paths_folded == 0:
+        failures.append(
+            "aggregate-mode probe is vacuous: zero critical paths "
+            "folded — the observatory never saw a bind"
+        )
+    return fields, failures
 
 
 def bench_controlplane(
@@ -4494,6 +4680,13 @@ def bench_stream(args) -> int:
             }
         }
 
+    #: --trace composition: every rung's stream/round side gets its own
+    #: full-ring tracer (its own Perfetto process in the export), the
+    #: per-side fleet critical-path report rides in the rung dict, and
+    #: the telescoping/non-vacuity failures gate the exit code alongside
+    #: the SLO scorecard. Both sides trace, so the A/B stays symmetric.
+    trace_groups: dict = {}
+    trace_failures: list[str] = []
     rungs = []
     for rung_idx, rate in enumerate(rates):
         batch = max(1, int(round(rate * batch_dt)))
@@ -4504,13 +4697,16 @@ def bench_stream(args) -> int:
         )
 
         def measure(stream_on: bool):
+            cfg: dict = dict(stream_config(rate)) if stream_on else {}
+            if args.trace:
+                cfg["tracing"] = {"enabled": True}
             h = Harness(
                 nodes=make_nodes(
                     num_nodes,
                     allocatable={"cpu": 32.0, "memory": 128.0,
                                  "tpu": 8.0},
                 ),
-                config=stream_config(rate) if stream_on else None,
+                config=cfg or None,
             )
             h.settle()
             out = _stream_run(h, schedule, batch_dt, batch, population)
@@ -4524,6 +4720,16 @@ def bench_stream(args) -> int:
                     "grove_stream_readmitted_total",
                     "shed gangs re-admitted",
                 ).total())
+            if args.trace:
+                side = "stream" if stream_on else "round"
+                report, fails = _trace_critical_path(
+                    h.cluster.tracer, h.cluster.metrics,
+                    binds=out["bound"],
+                    label=f"{side} @ {rate:g} gangs/s",
+                )
+                out["critical_path"] = report
+                trace_failures.extend(fails)
+                trace_groups[f"{side}-{rate:g}gps"] = h.cluster.tracer
             return out
 
         (s_runs, r_runs) = interleaved_ab(
@@ -4591,6 +4797,26 @@ def bench_stream(args) -> int:
         "backend": __import__("jax").default_backend(),
         "engine": "single",
     }
+    if args.trace:
+        from grove_tpu.observability.tracing import chrome_trace
+
+        with open(args.trace, "w") as fh:
+            json.dump(chrome_trace(trace_groups), fh)
+            fh.write("\n")
+        n_spans = sum(len(v.finished) for v in trace_groups.values())
+        print(f"wrote {n_spans} spans to {args.trace}", file=sys.stderr)
+        # fleet breakdown at the TOP rung (the overload point the bench
+        # exists to characterize): stream vs round, where the latency
+        # went on each side — also echoed to stderr so a CI log shows
+        # the dominating segment without parsing the JSON
+        out["critical_path_breakdown"] = {
+            "offered_gangs_per_sec": rates[-1],
+            "stream": top["stream"].get("critical_path"),
+            "round": top["round"].get("critical_path"),
+        }
+        print(json.dumps(
+            {"critical_path_breakdown": out["critical_path_breakdown"]}
+        ), file=sys.stderr)
     print(json.dumps(out))
     by_name = {e["slo"]: e for e in card["slos"]}
     if by_name["stream-base-p99"]["verdict"] == VERDICT_BREACH:
@@ -4605,7 +4831,11 @@ def bench_stream(args) -> int:
             f"gangs/s at SLO but round-draining sustains {round_max:g}",
             file=sys.stderr,
         )
-    return 0 if card["verdict"] != VERDICT_BREACH else 1
+    for f in trace_failures:
+        print(f"STREAM BENCH FAILURE: {f}", file=sys.stderr)
+    if card["verdict"] == VERDICT_BREACH or trace_failures:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -536,8 +536,15 @@ class TracingConfig:
     (flight_recorder_capacity) — both fixed-memory at any run length."""
 
     enabled: bool = False
+    #: "full" retains spans in the ring (dumps, Chrome export, flight
+    #: feed); "aggregate" skips the ring and folds finished spans
+    #: straight into bounded critical-path sketches (O(1) memory — the
+    #: always-on production mode, observability/causal.py)
+    mode: str = "full"
     max_spans: int = 65536
     flight_recorder_capacity: int = 4096
+    #: slowest-gangs table size in the critical-path observatory
+    critical_path_top_k: int = 10
 
 
 @dataclass
@@ -1041,11 +1048,17 @@ def validate_operator_config(cfg: OperatorConfig) -> list[str]:
     tr = cfg.tracing
     if not isinstance(tr.enabled, bool):
         errs.append("config.tracing.enabled: must be a bool")
+    if tr.mode not in ("full", "aggregate"):
+        errs.append('config.tracing.mode: must be "full" or "aggregate"')
     if not _int(tr.max_spans) or tr.max_spans < 1:
         errs.append("config.tracing.max_spans: must be an int >= 1")
     if not _int(tr.flight_recorder_capacity) or tr.flight_recorder_capacity < 1:
         errs.append(
             "config.tracing.flight_recorder_capacity: must be an int >= 1"
+        )
+    if not _int(tr.critical_path_top_k) or tr.critical_path_top_k < 1:
+        errs.append(
+            "config.tracing.critical_path_top_k: must be an int >= 1"
         )
 
     du = cfg.durability

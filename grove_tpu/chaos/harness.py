@@ -1095,6 +1095,7 @@ class ChaosHarness:
         from ..api.podgang import PodGang, PodGangConditionType
 
         decisions = self.harness.cluster.decisions
+        tracer = self.harness.cluster.tracer
         sharded = self._sharded
         unscheduled = []
         for g in self.raw_store.scan(PodGang.KIND):
@@ -1116,6 +1117,16 @@ class ChaosHarness:
                         g.metadata.namespace, g.metadata.name
                     ),
                 }
+                if tracer.enabled:
+                    # the wedged gang's reconstructed (partial) critical
+                    # path next to its explain record: how long it has
+                    # been held/queued and behind which hop
+                    # (observability/causal.py)
+                    entry["critical_path"] = tracer.gang_path(
+                        f"{g.metadata.namespace}/{g.metadata.name}",
+                        created_at=g.metadata.creation_timestamp,
+                        now=self.clock.now(),
+                    )
                 if sharded is not None:
                     # the postmortem names the SHARD, not just the gang:
                     # its own key's owner plus the scheduler singleton's

@@ -303,31 +303,56 @@ class Cluster:
 
     # -- tracing ------------------------------------------------------------
     def enable_tracing(self, max_spans: int | None = None,
-                       flight_capacity: int | None = None):
+                       flight_capacity: int | None = None,
+                       mode: str | None = None):
         """Build and wire the span tracer + flight recorder (idempotent).
         Called from __init__ when config.tracing.enabled, and by harnesses
         that upgrade after construction (ChaosHarness always records a
         flight so a wedged seed leaves a postmortem). Must run BEFORE the
         controllers are built — they capture cluster.tracer at
-        construction (Harness._build_manager re-reads it on restart)."""
+        construction (Harness._build_manager re-reads it on restart).
+
+        mode "full" retains spans in the ring; "aggregate" folds finished
+        spans straight into bounded critical-path sketches (the always-on
+        observatory, observability/causal.py)."""
         if self.tracer.enabled:
             return self.tracer
-        from ..observability.tracing import FlightRecorder, Tracer
+        from ..observability.causal import CausalLedger
+        from ..observability.tracing import (
+            AggregateTracer, FlightRecorder, Tracer,
+        )
 
         tcfg = self.config.tracing
         self.flight = FlightRecorder(
             capacity=flight_capacity or tcfg.flight_recorder_capacity
         )
-        self.tracer = Tracer(
-            clock=self.clock,
-            max_spans=max_spans or tcfg.max_spans,
-            flight=self.flight,
-        )
+        if (mode or tcfg.mode) == "aggregate":
+            self.tracer = AggregateTracer(
+                clock=self.clock, metrics=self.metrics,
+                flight=self.flight, top_k=tcfg.critical_path_top_k,
+            )
+        else:
+            self.tracer = Tracer(
+                clock=self.clock,
+                max_spans=max_spans or tcfg.max_spans,
+                flight=self.flight,
+            )
+            self.tracer.critical.top_k = tcfg.critical_path_top_k
         self.kubelet.tracer = self.tracer
         # EventRecorder hook: recorders hold the store (possibly via the
         # chaos proxy, whose __getattr__ delegates), so the flight ring
         # rides as a store attribute rather than N constructor params
         self.store.flight_recorder = self.flight
+        # the causal token ledger + tracer ride the store the same way:
+        # every layer that already holds the store (controllers, shard
+        # workers, kubelet, federation members) can hand a token from
+        # the previous hop to the next span without new plumbing
+        self.store.causal = CausalLedger()
+        self.store.tracer = self.tracer
+        if self.slo is not None:
+            # a firing bind-latency SLO attaches its worst offenders'
+            # critical paths to the scorecard (observability/slo.py)
+            self.slo.path_source = self.tracer
         return self.tracer
 
     # -- HA replication (cluster/replication.py) -----------------------------

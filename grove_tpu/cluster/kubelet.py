@@ -370,11 +370,19 @@ class SimKubelet:
                    meta: dict) -> None:
         """Pod lifecycle trace point (pod_start / pod_ready — the latter
         IS the startup-barrier release when `barrier` is set). Gang-tagged
-        so GangTimeline can stitch per-gang startup phases."""
+        so GangTimeline can stitch per-gang startup phases; links the
+        gang's bind-emitted causal token so the kubelet hop joins the
+        gang's flow DAG (observability/causal.py)."""
         gang, node, barrier = meta.get((ns, pod_name), ("", "", False))
+        causal = {}
+        ledger = getattr(self.store, "causal", None)
+        if ledger is not None and gang:
+            tok = ledger.follow(("gang", ns, gang))
+            if tok is not None:
+                causal["causal_link"] = tok
         self.tracer.point(
             span_name, pod=f"{ns}/{pod_name}", namespace=ns, gang=gang,
-            node=node, barrier=barrier,
+            node=node, barrier=barrier, **causal,
         )
 
     def run_to_quiesce(self, max_ticks: int = 64) -> None:

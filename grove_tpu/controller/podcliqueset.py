@@ -728,6 +728,16 @@ class PodCliqueSetReconciler:
         tenant = pcs.metadata.labels.get(constants.LABEL_TENANT)
         if tenant:
             tenant_labels[constants.LABEL_TENANT] = tenant
+        # causal flow (observability/causal.py): each created gang emits
+        # its first token (linking the federation route's PCS token when
+        # one exists) — the head of the gang's critical-path DAG
+        tracer = getattr(self.store, "tracer", None)
+        if tracer is not None and not tracer.enabled:
+            tracer = None
+        ledger = (
+            getattr(self.store, "causal", None)
+            if tracer is not None else None
+        )
         deferred = False
         for gang_name, (replica, spec, extra_labels) in expected.items():
             pods_by_group = {}
@@ -775,6 +785,19 @@ class PodCliqueSetReconciler:
                     owned=True,
                 )
                 self._mark_own()
+                if tracer is not None:
+                    causal = {}
+                    if ledger is not None:
+                        link = ledger.follow(("pcs", ns, name))
+                        if link is not None:
+                            causal["causal_link"] = link
+                        causal["causal_emit"] = ledger.emit(
+                            ("gang", ns, gang_name)
+                        )
+                    tracer.point(
+                        "pcs.gang_create",
+                        gang=f"{ns}/{gang_name}", pcs=name, **causal,
+                    )
             elif existing.spec != spec:
                 fresh = self.store.get(PodGang.KIND, ns, gang_name)
                 fresh.spec = spec

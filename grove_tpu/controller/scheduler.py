@@ -280,6 +280,9 @@ class GangScheduler:
         #: its scores were computed against). Consumed (or discarded as
         #: stale) by the same round's _reconcile — see pre_round.
         self._pending = None
+        #: causal token the pending dispatch emitted (pre_round); the
+        #: adopting solve links it (observability/causal.py)
+        self._pending_token = None
         #: seqs of OUR OWN PodGang status writes (bind/evict/phase/
         #: unschedulable): gang-status output never feeds gang-status
         #: input (phases derive from POD state), so re-dirtying on our own
@@ -573,6 +576,7 @@ class GangScheduler:
         Any staleness falls back to a fresh synchronous solve."""
         with self.tracer.span("scheduler.pre_round") as sp:
             self._pending = None
+            self._pending_token = None
             seq0 = self.store.last_seq
             backlog_keys: list[tuple[str, str]] = []
             pod_bucket = self.store.kind_bucket(Pod.KIND)
@@ -627,6 +631,13 @@ class GangScheduler:
                 self._pending = (seq0, backlog_keys, backlog, encoded,
                                  dispatch, fairness)
                 sp.set(dispatched=True)
+                if self.tracer.enabled:
+                    # dispatch/collect causal edge: the adopting solve
+                    # links this token (flow arrow pre_round -> solve)
+                    from ..observability.causal import next_token
+
+                    self._pending_token = next_token()
+                    sp.set(causal_emit=self._pending_token)
 
     def reconcile(self, request: Request) -> Result:
         dirty, self._dirty = self._dirty, set()
@@ -854,6 +865,13 @@ class GangScheduler:
             self.retry_seconds if blocked_pending else None
         )
         if backlog_keys:
+            # causal ledger (observability/causal.py): admit/solve/bind
+            # hand one token per gang down the hop chain so the merged
+            # trace renders as connected flow arrows
+            ledger = (
+                getattr(self.store, "causal", None)
+                if self.tracer.enabled else None
+            )
             if stream_plan is not None:
                 # consume-time accounting, exactly once per solved batch
                 # (never in the speculative plan): per-gang queue-wait
@@ -862,6 +880,12 @@ class GangScheduler:
                 # leaves unplaced (its wait-to-first-solve was served)
                 now_v = self.store.clock.now()
                 for ns, name in backlog_keys:
+                    causal = {}
+                    if ledger is not None:
+                        prev, nxt = ledger.handoff(("gang", ns, name))
+                        if prev is not None:
+                            causal["causal_link"] = prev
+                        causal["causal_emit"] = nxt
                     self.tracer.point(
                         "scheduler.stream_admit",
                         gang=f"{ns}/{name}",
@@ -870,12 +894,23 @@ class GangScheduler:
                         ),
                         window=stream_plan.window_seconds,
                         brownout=stream_plan.brownout_level,
+                        **causal,
                     )
                 self.stream.consumed(
                     backlog_keys, stream_plan.waits, now_v
                 )
+            solve_causal = {}
+            if ledger is not None:
+                links = [
+                    t for t in (
+                        ledger.follow(("gang", ns, name))
+                        for ns, name in backlog_keys[:32]
+                    ) if t is not None
+                ]
+                if links:
+                    solve_causal["causal_link"] = links
             with self.tracer.span(
-                "scheduler.solve", gangs=len(backlog_keys)
+                "scheduler.solve", gangs=len(backlog_keys), **solve_causal
             ) as solve_sp:
                 if self._solve_backlog(
                     backlog_keys, snapshot, engine, free, demand_fn,
@@ -931,6 +966,10 @@ class GangScheduler:
             # one by construction: annotate() reads only store state, and
             # _dispatch_unaffected proved none of it moved.
             _, _, backlog, encoded, dispatch, fairness = pending
+            if self._pending_token is not None:
+                # the dispatch/collect causal edge: this solve consumes
+                # pre_round's in-flight device work
+                solve_sp.set(causal_link=self._pending_token)
         else:
             if pending is not None:
                 pending[4].cancel()  # stale: stop in-flight RPC work
@@ -1044,6 +1083,27 @@ class GangScheduler:
             "grove_scheduler_unplaced_total",
             "unplaced gang solve outcomes by structured reason code",
         ).inc(reason=code.value if code is not None else "Unknown")
+        if self.tracer.enabled:
+            # the critical-path "held" anchor: the LAST hold before a
+            # successful bind marks the release boundary, and a wedged
+            # gang's postmortem names this code as held_reason
+            # (observability/causal.py)
+            gns = gang.metadata.namespace
+            causal = {}
+            ledger = getattr(self.store, "causal", None)
+            if ledger is not None:
+                prev, nxt = ledger.handoff(
+                    ("gang", gns, gang.metadata.name)
+                )
+                if prev is not None:
+                    causal["causal_link"] = prev
+                causal["causal_emit"] = nxt
+            self.tracer.point(
+                "scheduler.hold",
+                gang=f"{gns}/{gang.metadata.name}",
+                code=code.value if code is not None else "Unknown",
+                **causal,
+            )
         before = clone(gang.status)
         prev = get_condition(
             gang.status.conditions, PodGangConditionType.SCHEDULED.value
@@ -2012,13 +2072,25 @@ class GangScheduler:
             # the GangTimeline anchor: created_at + pod count let the
             # reconstructor decompose this gang's bind latency into
             # queued/solving/binding and stitch the kubelet's startup
-            # points onto it (observability/tracing.py)
+            # points onto it (observability/tracing.py). The causal
+            # handoff links the admit/create hop behind this bind and
+            # emits the token the kubelet's pod points link.
+            causal = {}
+            ledger = getattr(self.store, "causal", None)
+            if ledger is not None:
+                prev, nxt = ledger.handoff(
+                    ("gang", ns, gang.metadata.name)
+                )
+                if prev is not None:
+                    causal["causal_link"] = prev
+                causal["causal_emit"] = nxt
             self.tracer.point(
                 "scheduler.bind",
                 gang=f"{ns}/{gang.metadata.name}",
                 created_at=gang.metadata.creation_timestamp,
                 pods=len(placement.pod_to_node),
                 score=round(placement.placement_score, 4),
+                **causal,
             )
         self.recorder.normal(
             gang,

@@ -343,6 +343,25 @@ class ShardWorker:
             gained = owned - self.owned
             self.owned.clear()
             self.owned.update(owned)
+            if gained and sharded.tracer.enabled:
+                # shard-handoff causal edge (observability/causal.py):
+                # link the tokens the previous owner's gain emitted and
+                # emit fresh ones, so a failover's ownership transfer
+                # renders as a flow arrow between the two workers'
+                # shard_step spans
+                ledger = getattr(sharded.store, "causal", None)
+                if ledger is not None:
+                    links = [
+                        t for t in (
+                            ledger.follow(("shard", s))
+                            for s in sorted(gained)
+                        ) if t is not None
+                    ]
+                    if links:
+                        sp.set(causal_link=links)
+                    sp.set(causal_emit=[
+                        ledger.emit(("shard", s)) for s in sorted(gained)
+                    ])
             if gained and self.manager.event_cursor > 0:
                 # new owner relists the gained shards (a cursor-0 manager
                 # is about to replay the whole log anyway) — through the

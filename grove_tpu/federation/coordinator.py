@@ -380,11 +380,31 @@ class FederationCoordinator:
                 "gangs every member cluster's coarse cuts eliminated",
             ).inc()
             return None
+        self._trace_route(cell, pcs)
         cell.harness.apply(pcs)
         self._routes[key] = cell.name
         self._unroutable.pop(key, None)
         self.journal.record_route(key[0], key[1], cell.name, "Routed")
         return cell.name
+
+    def _trace_route(self, cell, pcs) -> None:
+        """Causal head of a routed workload's flow DAG
+        (observability/causal.py): emit the PCS token into the MEMBER
+        cluster's ledger before delegating, so the member's
+        pcs.gang_create points link back to this routing decision and
+        the merged trace renders the federation hop as a flow arrow."""
+        tracer = getattr(cell.harness.cluster, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return
+        ns, name = pcs.metadata.namespace, pcs.metadata.name
+        causal = {}
+        ledger = getattr(cell.harness.cluster.store, "causal", None)
+        if ledger is not None:
+            causal["causal_emit"] = ledger.emit(("pcs", ns, name))
+        tracer.point(
+            "federation.route", pcs=f"{ns}/{name}", cluster=cell.name,
+            **causal,
+        )
 
     def _retry_unroutable(self) -> None:
         for key in sorted(self._unroutable):
@@ -396,6 +416,7 @@ class FederationCoordinator:
                     key[0], key[1], "", "NoFeasibleCluster", str(diag)
                 )
                 continue
+            self._trace_route(cell, pcs)
             cell.harness.apply(pcs)
             self._routes[key] = cell.name
             del self._unroutable[key]

@@ -183,6 +183,13 @@ def tracing_dump(cluster) -> dict[str, Any]:
     out = tracer.summary()
     if tracer.enabled:
         out["gang_timeline"] = tracer.flush_gang_phases(cluster.metrics)
+        # fleet critical-path decomposition (observability/causal.py):
+        # per-segment sketches + the top-K slowest gangs, each with its
+        # named dominating segment. Flushing observes every complete
+        # not-yet-counted path into
+        # grove_trace_critical_path_seconds{segment} (idempotent per
+        # bind, like the phase flush above).
+        out["critical_path"] = tracer.flush_critical_paths(cluster.metrics)
     return out
 
 

@@ -217,6 +217,11 @@ class SLOEngine:
         self.history: deque = deque(maxlen=cfg.history_limit)
         self._last_eval: dict[tuple, dict] = {}
         self._rec: Optional[tuple] = None
+        #: critical-path provider (a Tracer; set by
+        #: Cluster.enable_tracing): a firing bind-latency objective
+        #: attaches its worst offenders' reconstructed critical paths to
+        #: the scorecard so the alert names the dominating segment
+        self.path_source = None
 
     # -- sweep ------------------------------------------------------------
 
@@ -650,7 +655,7 @@ class SLOEngine:
         )
         for window, value in burns.items():
             burn_gauge.set(round(value, 6), window=window, **lab)
-        return {
+        entry = {
             "slo": obj.name,
             "kind": obj.kind,
             "tenant": tenant,
@@ -669,6 +674,21 @@ class SLOEngine:
             "current": current,
             "verdict": verdict,
         }
+        if (
+            obj.kind == "bind_latency_p99"
+            and verdict != VERDICT_OK
+            and self.path_source is not None
+            and getattr(self.path_source, "enabled", False)
+        ):
+            # the alert answers "where did the latency go": the fleet's
+            # dominating segment + the slowest gangs' decomposed paths
+            # (observability/causal.py; same surface debug_dump shows)
+            report = self.path_source.flush_critical_paths(self.metrics)
+            entry["critical_path"] = {
+                "dominant_segment": report.get("dominant_segment"),
+                "worst_offenders": list(report.get("top", ()))[:5],
+            }
+        return entry
 
     def _reconcile(self, live: set[tuple]) -> None:
         """Series hygiene: drop engine state and exported gauge series

@@ -11,7 +11,10 @@ loads in Perfetto (https://ui.perfetto.dev) or chrome://tracing; see
 docs/observability.md for the reading guide.
 
 --summary additionally prints the GangTimeline latency-decomposition
-report (per-phase virtual-second totals) to stderr.
+report (per-phase virtual-second totals) to stderr; --critical-path
+prints the fleet critical-path breakdown (observability/causal.py) plus
+every reconstructed per-gang path — the offline "where did the latency
+go" view over a dump from a run that is already over.
 """
 
 from __future__ import annotations
@@ -20,6 +23,7 @@ import argparse
 import json
 import sys
 
+from .causal import CriticalPathFolder, CriticalPathObservatory
 from .tracing import GangTimeline, Span, chrome_trace
 
 
@@ -46,6 +50,9 @@ def main(argv=None) -> int:
     ap.add_argument("--summary", action="store_true",
                     help="print the gang latency-decomposition report "
                     "to stderr")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="print the fleet critical-path breakdown and "
+                    "per-gang paths to stderr")
     args = ap.parse_args(argv)
 
     with open(args.input) as fh:
@@ -63,6 +70,17 @@ def main(argv=None) -> int:
     if args.summary and spans:
         report = GangTimeline(spans).report()
         print(json.dumps(report, indent=2), file=sys.stderr)
+
+    if args.critical_path and spans:
+        paths: list[dict] = []
+        folder = CriticalPathFolder(sink=paths.append)
+        folder.fold_all(spans)
+        obs = CriticalPathObservatory()
+        for p in paths:
+            obs.observe(p)
+        print(json.dumps(
+            {"critical_path": obs.report(), "paths": paths}, indent=2
+        ), file=sys.stderr)
 
     if args.out:
         with open(args.out, "w") as fh:

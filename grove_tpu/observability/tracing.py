@@ -47,6 +47,12 @@ import time
 from collections import deque
 from typing import Any, Iterable, Optional
 
+from .causal import (
+    CriticalPathFolder,
+    CriticalPathObservatory,
+    tokens_of,
+)
+
 TRACE_DUMP_FORMAT = "grove-trace/v1"
 FLIGHT_DUMP_FORMAT = "grove-flight/v1"
 
@@ -144,6 +150,7 @@ class NoopTracer:
 
     __slots__ = ()
     enabled = False
+    mode = "off"
     flight = None
     finished: tuple = ()
 
@@ -162,6 +169,13 @@ class NoopTracer:
 
     def flush_gang_phases(self, metrics) -> dict:
         return {}
+
+    def flush_critical_paths(self, metrics=None) -> dict:
+        return {}
+
+    def gang_path(self, key: str, created_at: float | None = None,
+                  now: float | None = None) -> Optional[dict]:
+        return None
 
 
 NOOP_TRACER = NoopTracer()
@@ -197,6 +211,7 @@ class Tracer:
     (deque maxlen) — fixed memory at any trace length."""
 
     enabled = True
+    mode = "full"
 
     def __init__(self, clock=None, max_spans: int = 65536, flight=None):
         #: anything with .now() -> float (SimClock); None = wall elapsed
@@ -212,6 +227,12 @@ class Tracer:
         #: (gang_key, bind_span_id) pairs already flushed to metrics —
         #: flush_gang_phases is idempotent per bind
         self._phases_flushed: set[tuple[str, int]] = set()
+        #: fleet critical-path aggregation (observability/causal.py);
+        #: persists across flushes so the top-K table accumulates
+        self.critical = CriticalPathObservatory()
+        #: (gang_key, bind_span_id) pairs already observed into the
+        #: observatory — flush_critical_paths is idempotent per bind
+        self._paths_flushed: set[tuple[str, int]] = set()
 
     # -- span lifecycle ----------------------------------------------------
     def _now_v(self) -> float:
@@ -278,6 +299,7 @@ class Tracer:
             by_name[sp.name] = by_name.get(sp.name, 0) + 1
         out = {
             "enabled": True,
+            "mode": self.mode,
             "spans_started": self.spans_started,
             "spans_retained": len(self.finished),
             "max_spans": self.max_spans,
@@ -337,6 +359,133 @@ class Tracer:
                 for phase, dur in tl["phases"].items():
                     hist.observe(dur, phase=phase)
         return report
+
+    def flush_critical_paths(self, metrics=None) -> dict:
+        """Reconstruct per-gang critical paths from the retained spans,
+        observe every not-yet-flushed one into the fleet observatory (and
+        grove_trace_critical_path_seconds{segment} when `metrics` is
+        given), and return the observatory report. Idempotent per bind —
+        repeated debug dumps never double-count; the flush-marker set is
+        pruned against the live ring so it stays bounded."""
+        paths: list[dict] = []
+        folder = CriticalPathFolder(sink=paths.append)
+        folder.fold_all(self.finished)
+        live = {(p["gang"], p["bind_span_id"]) for p in paths}
+        self._paths_flushed &= live
+        for p in paths:
+            fk = (p["gang"], p["bind_span_id"])
+            if fk in self._paths_flushed:
+                continue
+            self._paths_flushed.add(fk)
+            self.critical.observe(p, metrics)
+        return self.critical.report()
+
+    def gang_path(self, key: str, created_at: float | None = None,
+                  now: float | None = None) -> Optional[dict]:
+        """One gang's reconstructed critical path ("ns/name" key):
+        complete if the gang finished inside the retained ring, else the
+        partial held/admission/handoff waits so far (the wedged-gang
+        postmortem view), else None."""
+        found: dict[str, dict] = {}
+        folder = CriticalPathFolder(
+            sink=lambda p: found.__setitem__(p["gang"], p)
+        )
+        folder.fold_all(self.finished)
+        if key in found:
+            return found[key]
+        if now is None:
+            now = self._now_v()
+        return folder.pending_path(key, created_at=created_at, now=now)
+
+
+class AggregateTracer(Tracer):
+    """The always-on low-overhead mode (`tracing.mode: aggregate`): the
+    span ring is SKIPPED entirely — every finished span folds straight
+    into the bounded critical-path folder and per-segment observatory
+    sketches, so memory is O(1) at any run length and production keeps
+    the latency observatory on while full-ring tracing stays opt-in.
+
+    Consequences, by design: no span dump / Chrome export (the ring is
+    empty), no per-span flight-recorder feed (errors and events still
+    record), and flush_gang_phases has no ring to reconstruct from — the
+    critical-path report IS the aggregate surface. Finalized paths
+    observe into `metrics` immediately at fold time."""
+
+    mode = "aggregate"
+
+    #: the only span names the critical-path folder consumes. Everything
+    #: else — notably manager.reconcile, the bulk of a settle's spans —
+    #: gets the shared no-op span back: no allocation, no fold, which is
+    #: what keeps the always-on mode inside its <5% overhead acceptance
+    #: (bench.py --aggregate-overhead). scheduler.solve stays real so it
+    #: sits on the live stack while its engine children resolve their
+    #: enclosing solve id.
+    _FOLD_NAMES = frozenset((
+        "engine.fused", "engine.encode", "engine.device", "engine.repair",
+        "engine.hierarchical", "engine.fine_solve",
+        "scheduler.solve", "scheduler.hold", "scheduler.stream_admit",
+        "scheduler.bind", "kubelet.pod_start", "kubelet.pod_ready",
+    ))
+
+    def __init__(self, clock=None, metrics=None, flight=None,
+                 top_k: int = 10):
+        super().__init__(clock=clock, max_spans=1, flight=flight)
+        self.finished = deque(maxlen=0)  # fold, never retain
+        self.metrics = metrics
+        self.critical = CriticalPathObservatory(top_k=top_k)
+        self.folder = CriticalPathFolder(sink=self._on_path)
+
+    def span(self, name: str, /, **attrs: Any) -> "Span | _NoopSpan":
+        if name not in self._FOLD_NAMES:
+            return _NOOP_SPAN
+        return super().span(name, **attrs)
+
+    def _on_path(self, path: dict) -> None:
+        # finalize happens exactly once per bind (the folder drops the
+        # pending entry), so no flush-marker dedup is needed here
+        self.critical.observe(path, self.metrics)
+
+    def _finish(self, span: Span) -> None:
+        span.v1 = self._now_v()
+        span.t1 = time.perf_counter() - self._t_base
+        while self._stack:
+            if self._stack.pop() is span:
+                break
+        # ancestry resolves against the LIVE stack: children finish
+        # while their scheduler.solve parent is still open
+        self.folder.fold(span, stack=self._stack)
+
+    def point(self, name: str, /, **attrs: Any) -> "Span | _NoopSpan":
+        if name not in self._FOLD_NAMES:
+            return _NOOP_SPAN
+        sid = self._next_id
+        self._next_id += 1
+        parent = self._stack[-1].span_id if self._stack else None
+        self.spans_started += 1
+        sp = Span(self, name, sid, parent, self._now_v(),
+                  time.perf_counter() - self._t_base, attrs)
+        self.folder.fold(sp, stack=self._stack)
+        return sp
+
+    def flush_gang_phases(self, metrics) -> dict:
+        return {"aggregate": True, "paths": self.critical.paths}
+
+    def flush_critical_paths(self, metrics=None) -> dict:
+        # observation already happened at fold time
+        return self.critical.report()
+
+    def gang_path(self, key: str, created_at: float | None = None,
+                  now: float | None = None) -> Optional[dict]:
+        if now is None:
+            now = self._now_v()
+        return self.folder.pending_path(key, created_at=created_at,
+                                        now=now)
+
+    def summary(self) -> dict:
+        out = super().summary()
+        out["paths_folded"] = self.critical.paths
+        out["folder"] = self.folder.summary()
+        return out
 
 
 class FlightRecorder:
@@ -605,7 +754,15 @@ def chrome_trace_events(spans: Iterable[Span], pid: int = 1,
     ts/dur are wall microseconds (single-threaded execution means stack
     containment holds on one tid). Virtual times ride in args. `shift`
     (seconds) is added to every ts — chrome_trace uses it to put groups
-    recorded by different tracers onto one shared time axis."""
+    recorded by different tracers onto one shared time axis.
+
+    Causal edges (observability/causal.py): a span whose attrs carry
+    causal_emit becomes a flow START ("s") and causal_link a flow END
+    ("f", bp="e"), one event per token, sharing the token as the flow
+    `id`. Token ids are process-globally unique, so arrows connect
+    producer and consumer even across tracer groups (pids) in a merged
+    dump — a multi-tracer, multi-shard trace renders as connected
+    arrows in Perfetto."""
     events: list[dict] = []
     if label:
         events.append({
@@ -619,12 +776,13 @@ def chrome_trace_events(spans: Iterable[Span], pid: int = 1,
         args["span_id"] = sp.span_id
         if sp.parent_id is not None:
             args["parent_id"] = sp.parent_id
+        ts = round((sp.t0 + shift) * 1e6, 3)
         ev = {
             "name": sp.name,
             "cat": sp.name.split(".", 1)[0],
             "pid": pid,
             "tid": 1,
-            "ts": round((sp.t0 + shift) * 1e6, 3),
+            "ts": ts,
             "args": args,
         }
         if sp.t1 > sp.t0:
@@ -634,6 +792,16 @@ def chrome_trace_events(spans: Iterable[Span], pid: int = 1,
             ev["ph"] = "i"
             ev["s"] = "t"
         events.append(ev)
+        for tok in tokens_of(sp.attrs.get("causal_link")):
+            events.append({
+                "name": "causal", "cat": "causal", "ph": "f", "bp": "e",
+                "id": tok, "pid": pid, "tid": 1, "ts": ts,
+            })
+        for tok in tokens_of(sp.attrs.get("causal_emit")):
+            events.append({
+                "name": "causal", "cat": "causal", "ph": "s",
+                "id": tok, "pid": pid, "tid": 1, "ts": ts,
+            })
     return events
 
 

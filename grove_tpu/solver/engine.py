@@ -750,6 +750,10 @@ class PlacementEngine:
         if tracer is None:
             tracer = NOOP_TRACER
         self.tracer = tracer
+        #: causal token of the current hierarchical round (the
+        #: engine.fine_solve points emitted at collect time link it so
+        #: the dispatch/collect split renders as connected flow arrows)
+        self._hier_token = None
         #: device-resident free-state cache (config solver.device_state_cache
         #: via GangScheduler). Off: every solve re-ships the full masked
         #: free matrix and dispatch adoption falls back to the legacy
@@ -1668,6 +1672,29 @@ class PlacementEngine:
         res = shard.engine.solve(
             work.proxies, free=work.sub_free, dispatch=work.handle
         )
+        if self.tracer.enabled:
+            # per-domain fine-solve point on the PARENT tracer (collect
+            # runs on the main thread in deterministic domain order —
+            # sub-engines stay tracer-less for thread safety). Carries
+            # the sub-solve's wall decomposition for the critical-path
+            # folder and links the hierarchical round's causal token.
+            self.tracer.point(
+                "engine.fine_solve",
+                domain=work.dom, gangs=len(work.gangs),
+                encode_seconds=round(
+                    res.stats.get("encode_seconds", 0.0), 6
+                ),
+                device_seconds=round(
+                    res.stats.get("device_seconds", 0.0), 6
+                ),
+                repair_seconds=round(
+                    res.stats.get("repair_seconds", 0.0), 6
+                ),
+                **(
+                    {"causal_link": self._hier_token}
+                    if self._hier_token is not None else {}
+                ),
+            )
         free[idx] = work.sub_free
         placed_here: dict[str, GangPlacement] = {}
         failed = []
@@ -2036,9 +2063,17 @@ class PlacementEngine:
         epoch/content guard) replays the delta in O(changed rows); any
         staleness falls back to a fresh solve, exactly like the flat
         dispatch contract."""
+        if self.tracer.enabled:
+            from ..observability.causal import next_token
+
+            self._hier_token = next_token()
         with self.tracer.span(
             "engine.hierarchical", gangs=len(order), level=level,
             dispatch=True,
+            **(
+                {"causal_emit": self._hier_token}
+                if self._hier_token is not None else {}
+            ),
         ) as hsp:
             epoch = self._sync_free(free) if self.state_cache else 0
             free_h = free.copy()
@@ -2149,8 +2184,16 @@ class PlacementEngine:
         # the same path.
         hier_level = self._hier_plan(order)
         if hier_level is not None:
+            if self.tracer.enabled:
+                from ..observability.causal import next_token
+
+                self._hier_token = next_token()
             with self.tracer.span(
-                "engine.hierarchical", gangs=len(order), level=hier_level
+                "engine.hierarchical", gangs=len(order), level=hier_level,
+                **(
+                    {"causal_emit": self._hier_token}
+                    if self._hier_token is not None else {}
+                ),
             ) as hsp:
                 placed_map, fallbacks = self._hier_middle(
                     order, free, dispatch, result, hier_level, hsp

@@ -577,6 +577,61 @@ def _run_seed_inner(seed, nodes, baseline, plan, config, trace_path,
     return result
 
 
+def run_aggregate_ab(seed: int, nodes: int, stream: bool = False) -> dict:
+    """One seed run TWICE — full-ring tracing vs `tracing.mode:
+    aggregate` — asserting the always-on mode is bit-identical: same
+    settled workload fingerprint and the exact same fault-plan draw
+    counts (the causal ledger and critical-path folder do no store
+    writes and consume no RNG, so enabling them may not perturb a single
+    decision). The CI streaming-chaos smoke runs this on pre-existing
+    seeds."""
+    overrides: dict = {}
+    config: dict = {}
+    if stream:
+        overrides.update(burst_storm_rate=0.3, arrival_stall_rate=0.15)
+        config.update(STREAM_CONFIG)
+
+    def once(mode: str):
+        plan = FaultPlan.from_seed(seed, **overrides)
+        cfg = {**config, "tracing": {"enabled": True, "mode": mode}}
+        ch = ChaosHarness(plan, nodes=make_nodes(nodes), config=cfg)
+        quiet_io = io.StringIO()
+        ch.harness.cluster.logger.stream = quiet_io
+        ch.harness.manager.logger.stream = quiet_io
+        ch.harness.scheduler.log.stream = quiet_io
+        ch.harness.defrag.log.stream = quiet_io
+        ch.apply(sweep_workload())
+        ch.run_chaos()
+        return (
+            settled_fingerprint(ch.raw_store),
+            dict(sorted(plan.counts.items())),
+            ch.harness.cluster.tracer.mode,
+        )
+
+    t0 = time.perf_counter()
+    error = None
+    fp_same = draws_same = False
+    counts: dict = {}
+    try:
+        fp_full, draws_full, mode_full = once("full")
+        fp_agg, draws_agg, mode_agg = once("aggregate")
+        assert mode_full == "full" and mode_agg == "aggregate"
+        fp_same = fp_full == fp_agg
+        draws_same = draws_full == draws_agg
+        counts = draws_full
+    except Exception as exc:  # a failing seed must not stop the sweep
+        error = f"{type(exc).__name__}: {exc}"
+    return {
+        "seed": seed,
+        "ok": fp_same and draws_same and error is None,
+        "fingerprint_identical": fp_same,
+        "fault_draws_identical": draws_same,
+        "faults_injected": counts,
+        "error": error,
+        "wall_seconds": round(time.perf_counter() - t0, 3),
+    }
+
+
 def federation_workload() -> list:
     """The federation sweep's workload: a fan of independent gangs (one
     routing decision each) across two namespaces — enough of them that a
@@ -900,6 +955,18 @@ def main(argv=None) -> int:
                          "({'seeds': {seed: card}}) — the CI artifact; "
                          "render with python -m "
                          "grove_tpu.observability.slo")
+    ap.add_argument("--aggregate-ab", dest="aggregate_ab",
+                    action="store_true",
+                    help="sweep the ALWAYS-ON TRACING bit-identity "
+                         "contract instead of the convergence matrix: "
+                         "each seed runs twice — full-ring tracing vs "
+                         "tracing.mode aggregate — and must produce the "
+                         "same settled workload fingerprint with the "
+                         "exact same fault-plan draw counts (the causal "
+                         "ledger and critical-path folder do no store "
+                         "writes and consume no RNG). Composes with "
+                         "--stream (the CI streaming-chaos smoke) but "
+                         "not with the other single-cluster axes")
     ap.add_argument("--federation", action="store_true",
                     help="sweep the FEDERATION fault axis instead of the "
                          "single-cluster matrix: a 3-member federation "
@@ -930,6 +997,13 @@ def main(argv=None) -> int:
     if args.replication and not args.durability:
         ap.error("--replication requires --durability (the standby "
                  "tails the leader's WAL stream)")
+    if args.aggregate_ab and (
+        args.federation or args.durability or args.replication
+        or args.shards > 1 or args.serving or args.hierarchical
+        or args.defrag or args.tenant_skew or args.slo
+    ):
+        ap.error("--aggregate-ab composes only with --stream (it is an "
+                 "A/B of the SAME run, not another fault axis)")
     trace_dir = None
     if args.trace_dir:
         trace_dir = Path(args.trace_dir)
@@ -938,6 +1012,34 @@ def main(argv=None) -> int:
     if args.explain_dir:
         explain_dir = Path(args.explain_dir)
         explain_dir.mkdir(parents=True, exist_ok=True)
+
+    if args.aggregate_ab:
+        results = []
+        failed = []
+        for seed in range(args.start, args.start + args.seeds):
+            result = run_aggregate_ab(seed, args.nodes,
+                                      stream=args.stream)
+            print(json.dumps(result), flush=True)
+            results.append(result)
+            if not result["ok"]:
+                failed.append(seed)
+        summary = {
+            "swept": args.seeds,
+            "start": args.start,
+            "nodes": args.nodes,
+            "aggregate_ab": True,
+            "stream": args.stream,
+            "failed_seeds": failed,
+            "ok": not failed,
+        }
+        print(json.dumps(summary), flush=True)
+        if args.json_path:
+            with open(args.json_path, "w") as fh:
+                json.dump(
+                    {"summary": summary, "results": results}, fh, indent=2
+                )
+                fh.write("\n")
+        return 1 if failed else 0
 
     if args.federation:
         baseline = federation_baseline(args.nodes)

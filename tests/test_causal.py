@@ -1,0 +1,576 @@
+"""Causal critical-path tracing (observability/causal.py + the tracing
+integration): ledger token handoff, the telescoping guarantee (segments
+sum EXACTLY to created->running), the seeded e2e across stream front +
+sharded control plane + hierarchical solve, aggregate-mode agreement
+with full tracing, Perfetto flow arrows crossing tracer groups, the
+surfaces (debug dump / SLO scorecard / wedged postmortem) agreeing on
+the dominating segment, and chaos bit-identity with aggregate mode on.
+"""
+
+import json
+
+import pytest
+
+from grove_tpu.chaos import ChaosHarness, FaultPlan, settled_fingerprint
+from grove_tpu.cluster import make_nodes
+from grove_tpu.controller import Harness
+from grove_tpu.observability.causal import (
+    SEGMENTS,
+    CausalLedger,
+    CriticalPathFolder,
+    CriticalPathObservatory,
+    next_token,
+    tokens_of,
+)
+from grove_tpu.observability.tracing import (
+    AggregateTracer,
+    Span,
+    Tracer,
+    chrome_trace,
+)
+
+from test_e2e_basic import clique, simple_pcs
+
+_TICK = 1e-9  # "exactly, within one virtual-clock tick" (acceptance)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+
+def gang_life(tr, clock, key="default/g-0", created=0.0, hold_at=None):
+    """One gang's synthetic life through every hop, at pinned virtual
+    times: admit@1, solve 2->3 (interior walls 0.1/0.3/0.1), bind@3,
+    started@4, ready@5."""
+    ns, name = key.split("/")
+    if hold_at is not None:
+        clock.t = hold_at
+        tr.point("scheduler.hold", gang=key, code="Insufficient")
+    clock.t = 1.0
+    tr.point("scheduler.stream_admit", gang=key, queue_wait=0.75)
+    clock.t = 2.0
+    with tr.span("scheduler.solve"):
+        tr.point("engine.fused", encode_seconds=0.1, device_seconds=0.3,
+                 repair_seconds=0.1)
+        clock.t = 3.0
+        tr.point("scheduler.bind", gang=key, created_at=created, pods=2)
+    clock.t = 4.0
+    for p in ("p0", "p1"):
+        tr.point("kubelet.pod_start", namespace=ns, gang=name, pod=p)
+    clock.t = 5.0
+    for p in ("p0", "p1"):
+        tr.point("kubelet.pod_ready", namespace=ns, gang=name, pod=p)
+
+
+# -- ledger -------------------------------------------------------------------
+
+class TestCausalLedger:
+    def test_tokens_are_unique_and_monotonic(self):
+        a, b = next_token(), next_token()
+        assert b > a
+
+    def test_emit_follow_handoff(self):
+        led = CausalLedger()
+        assert led.follow(("gang", "ns", "g")) is None
+        tok = led.emit(("gang", "ns", "g"))
+        assert led.follow(("gang", "ns", "g")) == tok
+        prev, nxt = led.handoff(("gang", "ns", "g"))
+        assert prev == tok and nxt != tok
+        assert led.follow(("gang", "ns", "g")) == nxt
+        assert led.summary()["emitted"] == 2
+
+    def test_fifo_eviction_bounds_memory(self):
+        led = CausalLedger(capacity=4)
+        for i in range(10):
+            led.emit(("gang", "ns", f"g{i}"))
+        assert led.summary()["tracked"] == 4
+        # oldest evicted: following it yields None (a broken arrow)
+        assert led.follow(("gang", "ns", "g0")) is None
+        assert led.follow(("gang", "ns", "g9")) is not None
+
+    def test_tokens_of_normalizes(self):
+        assert tokens_of(None) == ()
+        assert tokens_of(7) == (7,)
+        assert tokens_of([1, None, 3]) == (1, 3)
+
+
+# -- telescoping (the load-bearing contract) ----------------------------------
+
+class TestTelescoping:
+    def _flush(self, tr):
+        paths = []
+        folder = CriticalPathFolder(sink=paths.append)
+        folder.fold_all(tr.finished)
+        return paths
+
+    def test_segments_sum_exactly_to_created_to_running(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        (path,) = self._flush(tr)
+        assert path["complete"]
+        assert set(path["segments"]) == set(SEGMENTS)
+        assert sum(path["segments"].values()) == pytest.approx(
+            5.0, abs=_TICK
+        )
+        cp = path["checkpoints"]
+        assert sum(path["segments"].values()) == pytest.approx(
+            cp["running"] - cp["created"], abs=_TICK
+        )
+        assert path["total"] == pytest.approx(5.0, abs=_TICK)
+        assert path["bind_latency"] == pytest.approx(3.0, abs=_TICK)
+
+    def test_interior_split_follows_wall_weights(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        (path,) = self._flush(tr)
+        seg = path["segments"]
+        # solve window [2,3] split by 0.1/0.3/0.1 wall weights
+        assert seg["encode"] == pytest.approx(0.2, abs=_TICK)
+        assert seg["device"] == pytest.approx(0.6, abs=_TICK)
+        assert seg["repair"] == pytest.approx(0.2, abs=_TICK)
+        assert seg["admission"] == pytest.approx(1.0, abs=_TICK)
+        assert seg["handoff"] == pytest.approx(1.0, abs=_TICK)
+        assert seg["pod_startup"] == pytest.approx(1.0, abs=_TICK)
+        assert seg["barrier_wait"] == pytest.approx(1.0, abs=_TICK)
+        assert path["wall"]["device"] == pytest.approx(0.3, abs=_TICK)
+        assert path["queue_wait"] == pytest.approx(0.75)
+
+    def test_held_gang_bills_the_hold(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock, hold_at=0.5)
+        (path,) = self._flush(tr)
+        assert path["segments"]["held"] == pytest.approx(0.5, abs=_TICK)
+        assert path["held_reason"] == "Insufficient"
+        assert sum(path["segments"].values()) == pytest.approx(
+            5.0, abs=_TICK
+        )
+
+    def test_rebind_after_preemption_wins_last(self):
+        # two binds for the same gang: pod points before the second bind
+        # are ignored and the FINAL path anchors on the last bind
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)  # first complete life, ready@5
+        clock.t = 6.0
+        with tr.span("scheduler.solve"):
+            tr.point("scheduler.bind", gang="default/g-0",
+                     created_at=0.0, pods=2)
+        clock.t = 8.0
+        for p in ("p0", "p1"):
+            tr.point("kubelet.pod_start", namespace="default", gang="g-0",
+                     pod=p)
+            tr.point("kubelet.pod_ready", namespace="default", gang="g-0",
+                     pod=p)
+        paths = self._flush(tr)
+        assert len(paths) == 2
+        last = paths[-1]
+        assert last["checkpoints"]["bound"] == pytest.approx(6.0)
+        assert last["checkpoints"]["running"] == pytest.approx(8.0)
+        assert sum(last["segments"].values()) == pytest.approx(
+            8.0, abs=_TICK
+        )
+
+    def test_pending_path_for_wedged_gang(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        clock.t = 0.5
+        tr.point("scheduler.hold", gang="default/stuck-0",
+                 code="Insufficient")
+        folder = CriticalPathFolder()
+        folder.fold_all(tr.finished)
+        p = folder.pending_path("default/stuck-0", created_at=0.0, now=9.5)
+        assert not p["complete"]
+        assert p["held_reason"] == "Insufficient"
+        assert p["segments"]["held"] == pytest.approx(9.0, abs=_TICK)
+        assert p["total"] == pytest.approx(9.5, abs=_TICK)
+        assert p["dominant"] == "held"
+        assert folder.pending_path("default/never-seen-0") is None
+
+    def test_folder_state_is_bounded(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        for i in range(40):
+            tr.point("scheduler.hold", gang=f"default/g-{i}", code="X")
+        folder = CriticalPathFolder(max_marks=16)
+        folder.fold_all(tr.finished)
+        assert folder.summary()["pending_holds"] == 16
+        assert folder.dropped > 0
+
+
+# -- observatory --------------------------------------------------------------
+
+class TestObservatory:
+    def test_report_and_topk(self):
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        paths = []
+        CriticalPathFolder(sink=paths.append).fold_all(tr.finished)
+        obs = CriticalPathObservatory(top_k=2)
+        for p in paths:
+            obs.observe(p)
+        rep = obs.report()
+        assert rep["paths"] == 1
+        assert rep["segments"]["device"]["sum"] == pytest.approx(0.6)
+        assert rep["top"][0]["gang"] == "default/g-0"
+        assert rep["dominant_segment"] in SEGMENTS
+
+    def test_histogram_series_per_segment(self):
+        from grove_tpu.observability.metrics import MetricsRegistry
+
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        reg = MetricsRegistry()
+        tr.flush_critical_paths(reg)
+        hist = reg.get("grove_trace_critical_path_seconds")
+        for seg in SEGMENTS:
+            assert hist.series_count(segment=seg) == 1
+
+    def test_flush_is_idempotent_per_bind(self):
+        from grove_tpu.observability.metrics import MetricsRegistry
+
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        reg = MetricsRegistry()
+        tr.flush_critical_paths(reg)
+        tr.flush_critical_paths(reg)
+        hist = reg.get("grove_trace_critical_path_seconds")
+        assert hist.series_count(segment="device") == 1
+        assert tr.critical.paths == 1
+
+
+# -- aggregate mode -----------------------------------------------------------
+
+class TestAggregateMode:
+    def test_ring_is_skipped_but_paths_fold(self):
+        clock = FakeClock()
+        tr = AggregateTracer(clock=clock)
+        gang_life(tr, clock)
+        assert len(tr.finished) == 0  # no span ring at all
+        rep = tr.flush_critical_paths()
+        assert rep["paths"] == 1
+        assert rep["segments"]["device"]["sum"] == pytest.approx(0.6)
+        assert tr.summary()["paths_folded"] == 1
+
+    def test_matches_full_mode_exactly(self):
+        c1, c2 = FakeClock(), FakeClock()
+        full, agg = Tracer(clock=c1), AggregateTracer(clock=c2)
+        gang_life(full, c1)
+        gang_life(agg, c2)
+        rf, ra = full.flush_critical_paths(), agg.flush_critical_paths()
+        assert rf["dominant_segment"] == ra["dominant_segment"]
+        for seg in SEGMENTS:
+            assert rf["segments"][seg]["sum"] == pytest.approx(
+                ra["segments"][seg]["sum"], abs=_TICK
+            )
+        assert rf["top"][0]["segments"] == ra["top"][0]["segments"]
+
+    def test_gang_path_reports_pending_waits(self):
+        clock = FakeClock()
+        tr = AggregateTracer(clock=clock)
+        clock.t = 1.0
+        tr.point("scheduler.stream_admit", gang="default/g-9",
+                 queue_wait=1.0)
+        clock.t = 4.0
+        p = tr.gang_path("default/g-9", created_at=0.0)
+        assert not p["complete"]
+        assert p["segments"]["admission"] == pytest.approx(1.0)
+        assert p["segments"]["handoff"] == pytest.approx(3.0)
+
+
+# -- flow events --------------------------------------------------------------
+
+class TestFlowEvents:
+    def test_arrows_cross_tracer_groups(self):
+        # the acceptance criterion: a merged dump renders CONNECTED flow
+        # arrows across >= 2 tracer groups (pids) via shared token ids
+        a, b = Tracer(), Tracer()
+        led = CausalLedger()
+        a.point("federation.route", pcs="ns/p",
+                causal_emit=led.emit(("pcs", "ns", "p")))
+        b.point("pcs.gang_create", gang="ns/p-0",
+                causal_link=led.follow(("pcs", "ns", "p")))
+        events = chrome_trace({"fed": a, "member": b})["traceEvents"]
+        starts = [e for e in events if e["ph"] == "s"]
+        ends = [e for e in events if e["ph"] == "f"]
+        assert len(starts) == 1 and len(ends) == 1
+        assert starts[0]["id"] == ends[0]["id"]
+        assert starts[0]["pid"] != ends[0]["pid"]
+        assert ends[0]["bp"] == "e"
+        assert starts[0]["cat"] == ends[0]["cat"] == "causal"
+
+    def test_span_roundtrip_preserves_causal_tokens(self):
+        sp = Span(None, "scheduler.bind", 3, 1, 1.0, 2.0,
+                  {"causal_link": 7, "causal_emit": [8, 9]})
+        sp.v1, sp.t1 = 1.0, 2.0
+        back = Span.from_dict(json.loads(json.dumps(sp.to_dict())))
+        assert back.attrs["causal_link"] == 7
+        assert back.attrs["causal_emit"] == [8, 9]
+        assert back.to_dict() == sp.to_dict()
+
+    def test_folder_accepts_dumped_dict_spans(self):
+        # the trace-CLI path: fold to_dict() spans, not Span objects
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        paths = []
+        folder = CriticalPathFolder(sink=paths.append)
+        folder.fold_all(json.loads(json.dumps(tr.dump()))["spans"])
+        assert len(paths) == 1
+        assert sum(paths[0]["segments"].values()) == pytest.approx(
+            5.0, abs=_TICK
+        )
+
+    def test_trace_cli_prints_critical_path(self, tmp_path, capsys):
+        from grove_tpu.observability.trace import main as trace_main
+
+        clock = FakeClock()
+        tr = Tracer(clock=clock)
+        gang_life(tr, clock)
+        dump = tmp_path / "dump.json"
+        dump.write_text(json.dumps(tr.dump()))
+        assert trace_main([str(dump), "--critical-path"]) == 0
+        cap = capsys.readouterr()
+        doc = json.loads(cap.out)  # chrome json on stdout
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        side = json.loads(cap.err)  # breakdown on stderr
+        assert side["critical_path"]["paths"] == 1
+        assert side["paths"][0]["gang"] == "default/g-0"
+
+
+# -- seeded e2e: stream front + sharded control plane + hierarchical solve ----
+
+E2E_CONFIG = {
+    "tracing": {"enabled": True},
+    "stream": {
+        "enabled": True, "slo_seconds": 10.0,
+        "window_min_seconds": 0.5, "window_max_seconds": 2.0,
+        "max_batch_gangs": 4, "queue_cap_gangs": 16,
+    },
+    "controllers": {"shards": 4, "shard_lease_duration_seconds": 10.0},
+    "solver": {"hierarchical_min_nodes": 4},
+}
+
+
+def e2e_harness(mode="full"):
+    cfg = {k: dict(v) for k, v in E2E_CONFIG.items()}
+    cfg["tracing"]["mode"] = mode
+    return Harness(nodes=make_nodes(16, hosts_per_rack=4), config=cfg)
+
+
+def packed_pcs(replicas=2):
+    """A PCS whose gangs REQUIRE rack-packing: with >= 2 rack domains
+    the scheduler takes the hierarchical coarse-prune + per-domain
+    fine-solve path (solver/engine.py _hier_plan)."""
+    from grove_tpu.api.types import (
+        TopologyConstraintSpec,
+        TopologyPackConstraintSpec,
+    )
+
+    pcs = simple_pcs(replicas=replicas,
+                     cliques=[clique("w", replicas=2),
+                              clique("x", replicas=3)])
+    pcs.spec.template.topology_constraint = TopologyConstraintSpec(
+        pack_constraint=TopologyPackConstraintSpec(required="rack")
+    )
+    return pcs
+
+
+def run_spread(h, rounds=10, dt=0.5):
+    for _ in range(rounds):
+        h.clock.advance(dt)
+        h.manager.run_once()
+        h.clock.advance(dt)
+        h.kubelet.tick()
+    h.settle()
+
+
+class TestEndToEnd:
+    def _drive(self, mode="full"):
+        h = e2e_harness(mode)
+        h.apply(packed_pcs())
+        run_spread(h)
+        return h
+
+    def test_paths_telescope_exactly_across_all_hops(self):
+        h = self._drive()
+        tr = h.cluster.tracer
+        names = {sp.name for sp in tr.finished}
+        # every hop actually fired on this topology + config
+        assert {"scheduler.stream_admit", "scheduler.solve",
+                "engine.hierarchical", "engine.fine_solve",
+                "scheduler.bind", "kubelet.pod_ready"} <= names
+        path = tr.gang_path("default/simple1-0")
+        assert path is not None and path["complete"]
+        cp = path["checkpoints"]
+        assert sum(path["segments"].values()) == pytest.approx(
+            cp["running"] - cp["created"], abs=_TICK
+        )
+        assert path["total"] == pytest.approx(
+            cp["running"] - cp["created"], abs=_TICK
+        )
+        report = tr.flush_critical_paths(h.cluster.metrics)
+        assert report["paths"] >= 1
+        assert report["dominant_segment"] in SEGMENTS
+
+    def test_causal_chain_links_admission_to_bind_to_pods(self):
+        h = self._drive()
+        by_name = {}
+        for sp in h.cluster.tracer.finished:
+            by_name.setdefault(sp.name, []).append(sp)
+        emits = {
+            t for sp in by_name["scheduler.stream_admit"]
+            for t in tokens_of(sp.attrs.get("causal_emit"))
+        }
+        gang_creates = {
+            t for sp in by_name["pcs.gang_create"]
+            for t in tokens_of(sp.attrs.get("causal_emit"))
+        }
+        binds = by_name["scheduler.bind"]
+        bind_links = {
+            t for sp in binds for t in tokens_of(sp.attrs.get("causal_link"))
+        }
+        # the bind consumed a token minted by the admit hop (or the gang
+        # create, for a gang bound in the same round it was admitted)
+        assert bind_links & (emits | gang_creates)
+        bind_emits = {
+            t for sp in binds for t in tokens_of(sp.attrs.get("causal_emit"))
+        }
+        pod_links = {
+            t for sp in by_name.get("kubelet.pod_start", [])
+            for t in tokens_of(sp.attrs.get("causal_link"))
+        }
+        assert pod_links <= bind_emits and pod_links
+
+    def test_aggregate_mode_agrees_with_full(self):
+        full = self._drive("full")
+        agg = self._drive("aggregate")
+        assert agg.cluster.tracer.mode == "aggregate"
+        assert len(agg.cluster.tracer.finished) == 0
+        rf = full.cluster.tracer.flush_critical_paths()
+        ra = agg.cluster.tracer.flush_critical_paths()
+        assert rf["paths"] == ra["paths"] >= 2
+        assert rf["dominant_segment"] == ra["dominant_segment"]
+        for seg in SEGMENTS:
+            assert rf["segments"][seg]["sum"] == pytest.approx(
+                ra["segments"][seg]["sum"], abs=1e-6
+            )
+
+    def test_debug_dump_and_histogram_agree_on_dominant(self):
+        h = self._drive()
+        dump = h.debug_dump()
+        cp = dump["tracing"]["critical_path"]
+        assert cp["paths"] >= 1
+        hist = h.cluster.metrics.get("grove_trace_critical_path_seconds")
+        assert hist is not None
+        for seg, agg in cp["segments"].items():
+            assert hist.series_count(segment=seg) == agg["count"]
+        # every per-gang dominant names a real segment, and the fleet
+        # dominant is one of them
+        tops = dump["tracing"]["critical_path"]["top"]
+        assert all(t["dominant"] in SEGMENTS for t in tops)
+
+
+# -- surfaces: scorecard + postmortem ----------------------------------------
+
+class TestSurfaces:
+    def test_firing_bind_slo_attaches_worst_offenders(self):
+        from grove_tpu.api.config import load_operator_config
+        from grove_tpu.observability.metrics import MetricsRegistry
+        from grove_tpu.observability.slo import SLOEngine, VERDICT_OK
+
+        cfg = load_operator_config({"slo": {
+            "enabled": True, "sync_interval_seconds": 5.0,
+            "budget_window_seconds": 120.0, "pending_for_seconds": 0.0,
+            "page_short_seconds": 5.0, "page_long_seconds": 30.0,
+            "page_burn_threshold": 5.0, "ticket_short_seconds": 30.0,
+            "ticket_long_seconds": 90.0, "ticket_burn_threshold": 2.0,
+            "objectives": [{"name": "bind-p99", "kind": "bind_latency_p99",
+                            "target": 0.9, "threshold_seconds": 1.0}],
+        }}).slo
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        eng = SLOEngine(cfg, reg, clock)
+        tr = Tracer(clock=FakeClock())
+        gang_life(tr, tr.clock)
+        eng.path_source = tr
+        hist = reg.histogram("grove_scheduler_gang_bind_latency_seconds")
+        eng.sweep()  # baseline
+        for _ in range(4):
+            hist.observe(5.0)  # way over the 1s threshold
+        clock.t = 5.0
+        eng.sweep()
+        (entry,) = eng.scorecard()["slos"]
+        assert entry["verdict"] != VERDICT_OK
+        attach = entry["critical_path"]
+        assert attach["dominant_segment"] == \
+            tr.flush_critical_paths()["dominant_segment"]
+        assert attach["worst_offenders"][0]["gang"] == "default/g-0"
+
+    def test_healthy_bind_slo_attaches_nothing(self):
+        from grove_tpu.api.config import load_operator_config
+        from grove_tpu.observability.metrics import MetricsRegistry
+        from grove_tpu.observability.slo import SLOEngine
+
+        cfg = load_operator_config({"slo": {
+            "enabled": True,
+            "objectives": [{"name": "bind-p99", "kind": "bind_latency_p99",
+                            "target": 0.9, "threshold_seconds": 30.0}],
+        }}).slo
+        eng = SLOEngine(cfg, MetricsRegistry(), FakeClock())
+        eng.path_source = Tracer()
+        eng.sweep()
+        (entry,) = eng.scorecard()["slos"]
+        assert "critical_path" not in entry
+
+    def test_wedged_postmortem_attaches_partial_path(self):
+        # a gang that can never place: the flight dump's wedged section
+        # must carry its reconstructed (partial) critical path
+        ch = ChaosHarness(
+            FaultPlan.from_seed(1, chaos_steps=0),
+            nodes=make_nodes(2, allocatable={"cpu": 1.0, "memory": 1.0,
+                                             "tpu": 0.0}),
+            config={"tracing": {"enabled": True}},
+        )
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2, cpu=5.0)]))
+        ch.settle()
+        dump = ch.dump_flight()
+        (stuck,) = dump["wedged"]["unscheduled_gangs"]
+        assert stuck["name"] == "default/simple1-0"
+        path = stuck["critical_path"]
+        assert path is not None and not path["complete"]
+        assert path["dominant"] in SEGMENTS
+        assert path["total"] >= 0.0
+
+
+# -- chaos bit-identity -------------------------------------------------------
+
+class TestChaosBitIdentity:
+    def _run(self, tracing):
+        plan = FaultPlan.from_seed(11, chaos_steps=4)
+        config = {"tracing": tracing} if tracing else {}
+        ch = ChaosHarness(plan, nodes=make_nodes(8), config=config)
+        ch.apply(simple_pcs(cliques=[clique("w", replicas=2)]))
+        ch.run_chaos()
+        return settled_fingerprint(ch.harness.store), dict(plan.counts)
+
+    def test_aggregate_mode_is_bit_identical_on_chaos_seeds(self):
+        # the ledger/folder do no store writes and draw no RNG: a chaos
+        # seed must converge to the SAME fingerprint with the same
+        # fault-plan draw counts whether tracing is off, full, or
+        # aggregate (the satellite CI smoke pins this on real seeds)
+        fp_off, counts_off = self._run(None)
+        fp_full, counts_full = self._run({"enabled": True})
+        fp_agg, counts_agg = self._run({"enabled": True,
+                                        "mode": "aggregate"})
+        assert fp_off == fp_full == fp_agg
+        assert counts_off == counts_full == counts_agg

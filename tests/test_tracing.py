@@ -295,17 +295,23 @@ class TestChromeTrace:
         spans = self._spans()
         doc = chrome_trace({"grove": spans})
         events = doc["traceEvents"]
-        # metadata + one event per span
-        assert len(events) == len(spans) + 1
+        flows = [ev for ev in events if ev.get("cat") == "causal"]
+        # metadata + one event per span (+ flow arrows along causal edges)
+        assert len(events) - len(flows) == len(spans) + 1
         for ev in events:
             assert set(ev) >= {"name", "ph", "pid", "tid"}
-            assert ev["ph"] in ("X", "i", "M")
+            assert ev["ph"] in ("X", "i", "M", "s", "f")
             if ev["ph"] == "X":
                 assert ev["dur"] >= 0.0
                 assert ev["ts"] >= 0.0
             if ev["ph"] == "i":
                 assert ev["s"] == "t"
-            if ev["ph"] != "M":
+            if ev["ph"] in ("s", "f"):
+                assert isinstance(ev["id"], int)
+                assert ev["ts"] >= 0.0
+                if ev["ph"] == "f":
+                    assert ev["bp"] == "e"  # bind at enclosing slice end
+            elif ev["ph"] != "M":
                 assert isinstance(ev["args"]["span_id"], int)
                 for v in ev["args"].values():
                     assert isinstance(v, (str, int, float, bool, type(None)))
@@ -323,7 +329,10 @@ class TestChromeTrace:
         out = tmp_path / "chrome.json"
         assert trace_main([str(tr_dump), "-o", str(out), "--summary"]) == 0
         doc = json.loads(out.read_text())
-        assert len(doc["traceEvents"]) == len(spans) + 1
+        plain = [
+            ev for ev in doc["traceEvents"] if ev.get("cat") != "causal"
+        ]
+        assert len(plain) == len(spans) + 1
 
         fr = FlightRecorder(capacity=64)
         for sp in spans:
